@@ -1,0 +1,79 @@
+"""North-star benchmark (BASELINE.md ★): KMeans iter/sec on 1M×100, k=10.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline is measured against a NumPy single-node implementation of the
+same blocked Lloyd iteration, run in-process — the CPU-proxy rule from
+BASELINE.md "Measurement rules" (no dislib+COMPSs install exists in this
+environment; the proxy is labeled as such in the metric string).
+Correctness is gated first: device centers after 1 iteration must match the
+NumPy oracle.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+M, N, K = 1_000_000, 100, 10
+ITERS = 10
+
+
+def _numpy_iter(x, centers):
+    d = (x * x).sum(1)[:, None] - 2.0 * (x @ centers.T) + (centers * centers).sum(1)[None]
+    labels = d.argmin(1)
+    onehot = np.zeros((x.shape[0], centers.shape[0]), x.dtype)
+    onehot[np.arange(x.shape[0]), labels] = 1.0
+    counts = onehot.sum(0)
+    sums = onehot.T @ x
+    return np.where(counts[:, None] > 0, sums / np.maximum(counts, 1)[:, None], centers)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x_host = rng.rand(M, N).astype(np.float32)
+    init = x_host[rng.choice(M, K, replace=False)].copy()
+
+    # --- CPU proxy baseline (NumPy blocked Lloyd, single node) ---
+    t0 = time.perf_counter()
+    c = init.copy()
+    for _ in range(2):
+        c = _numpy_iter(x_host, c)
+    cpu_iter_sec = 2.0 / (time.perf_counter() - t0)
+
+    # --- TPU path ---
+    import jax
+    import dislib_tpu as ds
+    from dislib_tpu.cluster import KMeans
+    from dislib_tpu.cluster.kmeans import _kmeans_fit
+
+    ds.init()
+    a = ds.array(x_host, block_size=(M // max(1, len(jax.devices())), N))
+
+    # correctness gate: 1 iteration vs the NumPy oracle
+    km_check = KMeans(n_clusters=K, init=init.copy(), max_iter=1, tol=0.0)
+    km_check.fit(a)
+    oracle = _numpy_iter(x_host, init.copy())
+    np.testing.assert_allclose(km_check.centers_, oracle, rtol=2e-3, atol=2e-3)
+
+    centers0 = __import__("jax.numpy", fromlist=["asarray"]).asarray(init)
+    # warmup/compile (excluded from timing)
+    _kmeans_fit(a._data, a.shape, centers0, ITERS, 0.0)[0].block_until_ready()
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _kmeans_fit(a._data, a.shape, centers0, ITERS, 0.0)[0].block_until_ready()
+        times.append(time.perf_counter() - t0)
+    tpu_iter_sec = ITERS / float(np.median(times))
+
+    print(json.dumps({
+        "metric": "kmeans_1Mx100_k10_iter_per_sec (baseline: numpy single-node proxy)",
+        "value": round(tpu_iter_sec, 3),
+        "unit": "iter/s",
+        "vs_baseline": round(tpu_iter_sec / cpu_iter_sec, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

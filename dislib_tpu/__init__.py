@@ -1,0 +1,37 @@
+"""dislib_tpu — a TPU-native distributed machine-learning library.
+
+Capabilities of the reference (Alfredu/dislib — sklearn-style estimators over
+one block-partitioned distributed 2-D array; see SURVEY.md), rebuilt TPU-first
+on JAX/XLA: the ds-array is a sharded ``jax.Array`` on a named device mesh,
+per-block NumPy kernels become jitted sharded compute, COMPSs arity-tree
+reductions become ``lax.psum``/``all_gather`` over ICI, and convergence loops
+run on-device in ``lax.while_loop``.
+
+Public API parity contract: SURVEY.md §8 "API parity contract".
+"""
+
+from dislib_tpu.parallel.mesh import init, get_mesh, set_mesh
+from dislib_tpu.data.array import (
+    Array, array, random_array, zeros, full, ones, identity, eye,
+    apply_along_axis, concat_rows, concat_cols,
+)
+from dislib_tpu.data.io import (
+    load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
+)
+from dislib_tpu.math import matmul, kron, svd, qr
+from dislib_tpu.decomposition import tsqr, random_svd, lanczos_svd, PCA
+from dislib_tpu.utils.base import shuffle, train_test_split
+from dislib_tpu.utils.saving import save_model, load_model
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init", "get_mesh", "set_mesh",
+    "Array", "array", "random_array", "zeros", "full", "ones", "identity",
+    "eye", "apply_along_axis", "concat_rows", "concat_cols",
+    "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
+    "save_txt",
+    "matmul", "kron", "svd", "qr",
+    "tsqr", "random_svd", "lanczos_svd", "PCA",
+    "shuffle", "train_test_split", "save_model", "load_model",
+]

@@ -1,0 +1,47 @@
+"""sklearn-style estimator plumbing: get_params / set_params / clone.
+
+The reference inherits this behavior from scikit-learn conventions (SURVEY.md
+§1: "scikit-learn's estimator API ... constructor hyperparameters,
+trailing-underscore fitted attributes").  Implemented natively so the library
+has no sklearn dependency in its compute path; GridSearchCV and save_model
+rely on it.
+"""
+
+from __future__ import annotations
+
+import inspect
+from copy import deepcopy
+
+
+class BaseEstimator:
+    """Minimal sklearn-compatible base: constructor args are hyperparameters."""
+
+    @classmethod
+    def _param_names(cls):
+        sig = inspect.signature(cls.__init__)
+        return [p.name for p in sig.parameters.values()
+                if p.name != "self" and p.kind not in (p.VAR_POSITIONAL, p.VAR_KEYWORD)]
+
+    def get_params(self, deep: bool = True) -> dict:
+        return {name: getattr(self, name) for name in self._param_names()
+                if hasattr(self, name)}
+
+    def set_params(self, **params):
+        valid = set(self._param_names())
+        for k, v in params.items():
+            if k not in valid:
+                raise ValueError(f"invalid parameter {k!r} for {type(self).__name__}")
+            setattr(self, k, v)
+        return self
+
+    def _fitted_attrs(self) -> dict:
+        return {k: v for k, v in vars(self).items() if k.endswith("_") and not k.startswith("_")}
+
+    def __repr__(self):
+        params = ", ".join(f"{k}={v!r}" for k, v in self.get_params().items())
+        return f"{type(self).__name__}({params})"
+
+
+def clone(estimator):
+    """Fresh unfitted copy with the same hyperparameters (sklearn.clone)."""
+    return type(estimator)(**deepcopy(estimator.get_params()))

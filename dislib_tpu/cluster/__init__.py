@@ -1,0 +1,3 @@
+from dislib_tpu.cluster.kmeans import KMeans
+
+__all__ = ["KMeans"]

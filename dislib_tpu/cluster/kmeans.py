@@ -1,0 +1,173 @@
+"""KMeans — the north-star estimator (reference: `dislib/cluster/kmeans` —
+`_partial_sum` per row block, arity-tree `_merge`, per-iteration host sync;
+SURVEY.md §3.3 and §4.2; BASELINE configs 1 and ★).
+
+TPU-native redesign (the survey's §4.2 TPU mapping, verbatim):
+
+- The whole Lloyd's iteration is ONE jitted step inside a `lax.while_loop`
+  that runs ON DEVICE — the host syncs once per *fit*, not once per
+  iteration.  The reference pays B task submissions + a tree of merge tasks
+  + one worker→master sync every iteration; here an iteration is one fused
+  XLA program over the row-sharded data.
+- `_partial_sum`'s per-block (distances → argmin → per-cluster Σx/count)
+  becomes: a (m, k) distance matrix via one GEMM (‖x‖² − 2x·cᵀ + ‖c‖²,
+  MXU-bound), argmin, and the per-cluster sums as `onehotᵀ @ x` — another
+  GEMM.  The arity-tree `_merge` is the row-axis partial-sum reduction XLA
+  emits as a `psum` over ICI.  The `arity` knob is gone: reduction topology
+  belongs to the compiler (SURVEY §6).
+- Padded (zero) rows carry weight 0 so they never perturb sums or counts.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.data.array import Array, _pad_mask
+from dislib_tpu.parallel import mesh as _mesh
+
+
+class KMeans(BaseEstimator):
+    """Lloyd's K-means.
+
+    Parameters (reference parity; `arity` accepted and ignored — reduction
+    topology is XLA's job now)
+    ----------
+    n_clusters : int, default 8
+    init : 'random' or ndarray (n_clusters, n_features)
+    max_iter : int, default 10
+    tol : float, default 1e-4 — convergence on ‖Δcenters‖².
+    arity : int — ignored (reference reduction-tree fan-in).
+    random_state : int or None
+
+    Attributes
+    ----------
+    centers_ : ndarray (n_clusters, n_features)
+    n_iter_ : int
+    inertia_ : float — within-cluster sum of squared distances.
+    """
+
+    def __init__(self, n_clusters=8, init="random", max_iter=10, tol=1e-4,
+                 arity=50, random_state=None):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.max_iter = max_iter
+        self.tol = tol
+        self.arity = arity
+        self.random_state = random_state
+
+    # -- fitting -------------------------------------------------------------
+
+    def _init_centers(self, x: Array):
+        k, n = self.n_clusters, x.shape[1]
+        if isinstance(self.init, (np.ndarray, list)):
+            c = np.asarray(self.init, dtype=np.float32)
+            if c.shape != (k, n):
+                raise ValueError(f"init centers must be {(k, n)}, got {c.shape}")
+            return jnp.asarray(c)
+        if self.init != "random":
+            raise ValueError(f"unsupported init {self.init!r}")
+        rng = np.random.RandomState(self.random_state)
+        # sample k distinct rows — the reference inits from data rows too
+        idx = rng.choice(x.shape[0], size=min(k, x.shape[0]), replace=False)
+        rows = x[np.sort(idx), :]._data[: len(idx), : n]
+        if len(idx) < k:  # fewer samples than clusters: top up with jitter
+            extra = rows[rng.randint(0, len(idx), k - len(idx))] + 1e-3
+            rows = jnp.concatenate([rows, extra], axis=0)
+        return rows
+
+    def fit(self, x: Array, y=None):
+        centers0 = self._init_centers(x)
+        centers, n_iter, inertia = _kmeans_fit(
+            x._data, x.shape, centers0, self.max_iter, float(self.tol))
+        self.centers_ = np.asarray(jax.device_get(centers))
+        self.n_iter_ = int(n_iter)
+        self.inertia_ = float(inertia)
+        return self
+
+    def fit_predict(self, x: Array, y=None) -> Array:
+        return self.fit(x).predict(x)
+
+    def predict(self, x: Array) -> Array:
+        self._check_fitted()
+        labels = _kmeans_predict(x._data, x.shape, jnp.asarray(self.centers_))
+        return Array._from_logical_padded(labels, (x.shape[0], 1))
+
+    def score(self, x: Array, y=None) -> float:
+        """Negative inertia on x (sklearn convention)."""
+        self._check_fitted()
+        return float(_kmeans_score(x._data, x.shape, jnp.asarray(self.centers_)))
+
+    def _check_fitted(self):
+        if not hasattr(self, "centers_"):
+            raise RuntimeError("KMeans is not fitted")
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+# ---------------------------------------------------------------------------
+
+def _distances_sq(xv, centers):
+    """Squared euclidean distances (m_pad, k): one GEMM + norms (MXU)."""
+    x_sq = jnp.sum(xv * xv, axis=1, keepdims=True)
+    c_sq = jnp.sum(centers * centers, axis=1)
+    cross = xv @ centers.T
+    return x_sq - 2.0 * cross + c_sq[None, :]
+
+
+@partial(jax.jit, static_argnames=("shape", "max_iter"))
+def _kmeans_fit(xp, shape, centers0, max_iter, tol):
+    m, n = shape
+    xv = xp[:, :n]  # crop padded cols; padded rows stay (weighted 0)
+    xv = lax.with_sharding_constraint(xv, _mesh.row_sharding())
+    w = (lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m).astype(xv.dtype)
+    k = centers0.shape[0]
+
+    def step(carry):
+        centers, _, it, _ = carry
+        d = _distances_sq(xv, centers)
+        labels = jnp.argmin(d, axis=1)
+        onehot = jax.nn.one_hot(labels, k, dtype=xv.dtype) * w[:, None]
+        sums = onehot.T @ xv                 # (k, n) — row-axis psum under SPMD
+        counts = jnp.sum(onehot, axis=0)     # (k,)
+        new_centers = jnp.where(counts[:, None] > 0,
+                                sums / jnp.maximum(counts, 1.0)[:, None],
+                                centers)
+        shift = jnp.sum((new_centers - centers) ** 2)
+        inertia = jnp.sum(jnp.min(d, axis=1) * w)
+        return new_centers, shift, it + 1, inertia
+
+    def cond(carry):
+        _, shift, it, _ = carry
+        return (it < max_iter) & (shift >= tol)
+
+    init = (centers0, jnp.asarray(jnp.inf, xv.dtype), jnp.int32(0),
+            jnp.asarray(0.0, xv.dtype))
+    centers, _, n_iter, inertia = lax.while_loop(cond, step, init)
+    return centers, n_iter, inertia
+
+
+@partial(jax.jit, static_argnames=("shape",))
+def _kmeans_predict(xp, shape, centers):
+    m, n = shape
+    xv = xp[:, :n]
+    d = _distances_sq(xv, centers)
+    labels = jnp.argmin(d, axis=1).astype(jnp.float32)
+    # zero out padded rows to keep the Array invariant
+    valid = lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m
+    labels = jnp.where(valid, labels, 0.0)
+    return labels[:, None]
+
+
+@partial(jax.jit, static_argnames=("shape",))
+def _kmeans_score(xp, shape, centers):
+    m, n = shape
+    xv = xp[:, :n]
+    w = (lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m).astype(xv.dtype)
+    d = _distances_sq(xv, centers)
+    return -jnp.sum(jnp.min(d, axis=1) * w)

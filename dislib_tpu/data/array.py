@@ -1,0 +1,578 @@
+"""The distributed array (ds-array) — dislib_tpu's single data structure.
+
+Reference capability (SURVEY.md §3.1, `dislib/data/array.py :: class Array`):
+a dense or sparse 2-D matrix partitioned into a grid of rectangular blocks,
+each block a NumPy/CSR chunk held as a PyCOMPSs future; block-level ops are
+``@task`` functions and nothing computes until an explicit sync
+(``collect()`` / ``compss_wait_on``).
+
+TPU-native redesign — NOT a block-of-futures translation:
+
+- The whole matrix is ONE global :class:`jax.Array`, laid out on the library
+  mesh with ``NamedSharding(P('rows', 'cols'))``.  Placement, inter-device
+  movement and overlap come from XLA SPMD + async dispatch, which already
+  plays the role the COMPSs task graph plays for the reference (SURVEY.md §8
+  "Design stance").
+- The reference's irregular top-left block / arbitrary ``block_size`` becomes
+  *pad-and-mask metadata*: ``_data`` is padded so every dimension is a
+  multiple of the mesh pad quantum, and the region outside the logical
+  ``shape`` is ALWAYS ZERO.  That invariant makes contractions (matmul, sum,
+  norm) correct with no masking, while min/max/mean mask or rescale
+  explicitly.  Ops that could make padding non-zero re-zero it.
+- ``block_size`` survives as a *hint* (`_reg_shape`) for API parity and for
+  algorithms whose blocking is semantic (QR panels, tsQR tree arity); it no
+  longer dictates physical layout — XLA tiles for the MXU itself.
+- The "cheap to build, pay on sync" contract (SURVEY.md §4.6) is preserved by
+  JAX's async dispatch: every method returns immediately with a live
+  ``jax.Array``; ``collect()`` is the only host sync.
+
+Sparse support: ``_sparse=True`` arrays keep a BCOO backing for memory-honest
+storage where it pays (see `dislib_tpu/data/sparse.py`), with a dense+mask
+fallback — the decision recorded per estimator as SURVEY §8 directs.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from numbers import Number
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dislib_tpu.parallel import mesh as _mesh
+
+__all__ = [
+    "Array",
+    "array",
+    "random_array",
+    "zeros",
+    "full",
+    "ones",
+    "identity",
+    "eye",
+    "apply_along_axis",
+    "concat_rows",
+    "concat_cols",
+]
+
+
+# ---------------------------------------------------------------------------
+# padding helpers
+# ---------------------------------------------------------------------------
+
+def _padded_dim(n: int, quantum: int) -> int:
+    return max(quantum, int(math.ceil(n / quantum)) * quantum)
+
+
+def _padded_shape(shape, quantum):
+    return tuple(_padded_dim(int(s), quantum) for s in shape)
+
+
+def _pad_mask(padded_shape, logical_shape, dtype=jnp.bool_):
+    """Boolean mask: True inside the logical region."""
+    r = lax.broadcasted_iota(jnp.int32, padded_shape, 0) < logical_shape[0]
+    c = lax.broadcasted_iota(jnp.int32, padded_shape, 1) < logical_shape[1]
+    return (r & c).astype(dtype)
+
+
+def _zero_pad(data, logical_shape):
+    """Force the padding region to zero (the core Array invariant)."""
+    if data.shape == tuple(logical_shape):
+        return data
+    return jnp.where(_pad_mask(data.shape, logical_shape), data, jnp.zeros((), data.dtype))
+
+
+@partial(jax.jit, static_argnames=("padded_shape", "logical_shape"))
+def _place(data, padded_shape, logical_shape):
+    """Pad `data` (logical region) up to padded_shape with zeros."""
+    out = jnp.zeros(padded_shape, data.dtype)
+    out = lax.dynamic_update_slice(out, data.astype(out.dtype), (0, 0))
+    del logical_shape
+    return out
+
+
+def _default_block_size(shape, mesh):
+    r, c = _mesh.mesh_shape(mesh)
+    return (max(1, -(-shape[0] // r)), max(1, -(-shape[1] // c)))
+
+
+# ---------------------------------------------------------------------------
+# the Array
+# ---------------------------------------------------------------------------
+
+class Array:
+    """A 2-D matrix sharded over the device mesh.
+
+    Parameters are internal; users build Arrays with :func:`array`,
+    :func:`random_array`, the loaders in :mod:`dislib_tpu.data.io`, or as
+    results of dislib_tpu operations.
+    """
+
+    def __init__(self, data: jax.Array, shape, reg_shape=None, sparse=False,
+                 _skip_zero_check=True):
+        self._data = data                       # padded, zero-outside-logical
+        self._shape = (int(shape[0]), int(shape[1]))
+        if reg_shape is None:
+            reg_shape = _default_block_size(self._shape, None)
+        self._reg_shape = (int(reg_shape[0]), int(reg_shape[1]))
+        self._sparse = bool(sparse)
+
+    # -- construction helpers ------------------------------------------------
+
+    @classmethod
+    def _from_logical(cls, data: jax.Array, reg_shape=None, sparse=False) -> "Array":
+        """Wrap a logically-shaped (unpadded) device/host array."""
+        shape = data.shape
+        q = _mesh.pad_quantum()
+        pshape = _padded_shape(shape, q)
+        if tuple(shape) != pshape:
+            data = _place(data, pshape, tuple(shape))
+        data = jax.device_put(data, _mesh.data_sharding())
+        return cls(data, shape, reg_shape=reg_shape, sparse=sparse)
+
+    # -- metadata ------------------------------------------------------------
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def _n_blocks(self) -> tuple[int, int]:
+        return (-(-self._shape[0] // self._reg_shape[0]),
+                -(-self._shape[1] // self._reg_shape[1]))
+
+    @property
+    def block_size(self) -> tuple[int, int]:
+        return self._reg_shape
+
+    def __repr__(self):
+        return (f"dslib.Array(shape={self._shape}, block_size={self._reg_shape}, "
+                f"dtype={self.dtype}, sparse={self._sparse})")
+
+    # -- sync points ---------------------------------------------------------
+
+    def collect(self) -> np.ndarray:
+        """Materialise on host — the analog of compss_wait_on + merge (SURVEY §4.6)."""
+        out = np.asarray(jax.device_get(self._data))
+        out = out[: self._shape[0], : self._shape[1]]
+        if self._sparse:
+            import scipy.sparse as sp
+            return sp.csr_matrix(out)
+        return out
+
+    def block_until_ready(self) -> "Array":
+        self._data.block_until_ready()
+        return self
+
+    # -- layout --------------------------------------------------------------
+
+    def rechunk(self, block_size) -> "Array":
+        """Change the block-size hint.  Physical layout is mesh-determined, so
+        this is metadata-only — the reference's data-movement rechunk
+        (SURVEY §3.1) collapses to a no-op on a global jax.Array."""
+        return Array(self._data, self._shape, reg_shape=block_size, sparse=self._sparse)
+
+    def astype(self, dtype) -> "Array":
+        return Array(self._data.astype(dtype), self._shape, self._reg_shape, self._sparse)
+
+    def copy(self) -> "Array":
+        return Array(self._data, self._shape, self._reg_shape, self._sparse)
+
+    # -- transpose -----------------------------------------------------------
+
+    def transpose(self) -> "Array":
+        data = _transpose_op(self._data, self._shape)
+        return Array._from_logical_padded(
+            data, (self._shape[1], self._shape[0]),
+            (self._reg_shape[1], self._reg_shape[0]), self._sparse)
+
+    @property
+    def T(self) -> "Array":
+        return self.transpose()
+
+    @classmethod
+    def _from_logical_padded(cls, padded_data, shape, reg_shape=None, sparse=False):
+        """Wrap data already padded+zeroed for `shape`."""
+        padded_data = jax.device_put(padded_data, _mesh.data_sharding())
+        return cls(padded_data, shape, reg_shape=reg_shape, sparse=sparse)
+
+    # -- elementwise ---------------------------------------------------------
+
+    def _coerce(self, other):
+        if isinstance(other, Array):
+            if other._shape != self._shape:
+                # allow (1, n) / (n, 1) broadcasting
+                if not _broadcastable(other._shape, self._shape):
+                    raise ValueError(f"shape mismatch {self._shape} vs {other._shape}")
+            return other
+        if isinstance(other, Number):
+            return other
+        return NotImplemented
+
+    def _ew(self, other, op):
+        other = self._coerce(other)
+        if other is NotImplemented:
+            return NotImplemented
+        if isinstance(other, Array):
+            out_shape = _broadcast_shape(self._shape, other._shape)
+            data = _ew_array_op(self._data, other._data, self._shape, other._shape,
+                                out_shape, op)
+            return Array(data, out_shape, self._reg_shape, False)
+        data = _ew_scalar_op(self._data, float(other) if not isinstance(other, bool) else other,
+                             self._shape, op)
+        return Array(data, self._shape, self._reg_shape, False)
+
+    def __add__(self, o):  return self._ew(o, "add")
+    def __radd__(self, o): return self._ew(o, "add")
+    def __sub__(self, o):  return self._ew(o, "sub")
+    def __rsub__(self, o): return self._ew(o, "rsub")
+    def __mul__(self, o):  return self._ew(o, "mul")
+    def __rmul__(self, o): return self._ew(o, "mul")
+    def __truediv__(self, o):  return self._ew(o, "div")
+    def __rtruediv__(self, o): return self._ew(o, "rdiv")
+    def __pow__(self, o):  return self._ew(o, "pow")
+    def __neg__(self):     return self._ew(-1.0, "mul")
+
+    def __abs__(self):
+        return Array(jnp.abs(self._data), self._shape, self._reg_shape, self._sparse)
+
+    def sqrt(self) -> "Array":
+        return Array(_zero_pad(jnp.sqrt(self._data), self._shape),
+                     self._shape, self._reg_shape, self._sparse)
+
+    def exp(self) -> "Array":
+        return self._ew(0.0, "exp_")
+
+    # -- matmul --------------------------------------------------------------
+
+    def __matmul__(self, other):
+        from dislib_tpu.math import matmul
+        return matmul(self, other)
+
+    # -- reductions ----------------------------------------------------------
+
+    def _reduce(self, kind: str, axis=0):
+        if axis not in (0, 1, None):
+            raise ValueError("axis must be 0, 1 or None")
+        data = _reduce_op(self._data, self._shape, kind, axis)
+        if axis is None:
+            shape = (1, 1)
+        elif axis == 0:
+            shape = (1, self._shape[1])
+        else:
+            shape = (self._shape[0], 1)
+        return Array._from_logical_padded(_repad(data, shape), shape, None, False)
+
+    def sum(self, axis=0):  return self._reduce("sum", axis)
+    def mean(self, axis=0): return self._reduce("mean", axis)
+    def min(self, axis=0):  return self._reduce("min", axis)
+    def max(self, axis=0):  return self._reduce("max", axis)
+
+    def norm(self, axis=0):
+        return self._reduce("norm", axis)
+
+    # -- indexing ------------------------------------------------------------
+
+    def __getitem__(self, key):
+        rows, cols = _split_key(key)
+        r_idx, r_len = _normalize_index(rows, self._shape[0])
+        c_idx, c_len = _normalize_index(cols, self._shape[1])
+        data = _gather_op(self._data, r_idx, c_idx)
+        new_shape = (r_len, c_len)
+        return Array._from_logical_padded(_repad(data, new_shape), new_shape,
+                                          None, self._sparse)
+
+    # -- iteration over logical blocks (parity: Array._iterator) -------------
+
+    def iterator(self, axis=0):
+        """Yield row-block (axis=0) or col-block (axis=1) sub-arrays, one per
+        `block_size` stripe — reference `Array._iterator` (SURVEY §3.1)."""
+        n = self._shape[axis]
+        step = self._reg_shape[axis]
+        for start in range(0, n, step):
+            stop = min(start + step, n)
+            if axis == 0:
+                yield self[start:stop, :]
+            else:
+                yield self[:, start:stop]
+
+
+def _broadcastable(a, b):
+    return all(x == y or x == 1 or y == 1 for x, y in zip(a, b))
+
+
+def _broadcast_shape(a, b):
+    return tuple(max(x, y) for x, y in zip(a, b))
+
+
+# ---------------------------------------------------------------------------
+# jitted kernels (module-level so jit caches by shape)
+# ---------------------------------------------------------------------------
+
+_BINOPS = {
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "rsub": lambda a, b: b - a,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b,
+    "rdiv": lambda a, b: b / a,
+    "pow": lambda a, b: a ** b,
+    "exp_": lambda a, b: jnp.exp(a),
+}
+
+
+@partial(jax.jit, static_argnames=("a_shape", "b_shape", "out_shape", "op"))
+def _ew_array_op(a, b, a_shape, b_shape, out_shape, op):
+    # crop each operand to its logical region, broadcast, then re-pad. The
+    # crop/pad pair fuses to a masked op under XLA; it keeps broadcasting
+    # semantics exact when a (1, n) operand's padded rows would otherwise
+    # collide with the other operand's rows.
+    av = a[: a_shape[0], : a_shape[1]]
+    bv = b[: b_shape[0], : b_shape[1]]
+    out = _BINOPS[op](av, bv)
+    res = jnp.zeros(_padded_shape_like(a, b, out_shape), out.dtype)
+    res = lax.dynamic_update_slice(res, out, (0, 0))
+    return res
+
+
+def _padded_shape_like(a, b, out_shape):
+    # the padded canvas big enough for out_shape under the current quantum
+    q_r = max(a.shape[0], b.shape[0])
+    q_c = max(a.shape[1], b.shape[1])
+    # out_shape is the broadcast of the logical shapes; the matching padded
+    # canvas is the max of operand canvases in each dim.
+    return (q_r, q_c)
+
+
+@partial(jax.jit, static_argnames=("shape", "op"))
+def _ew_scalar_op(a, scalar, shape, op):
+    out = _BINOPS[op](a, jnp.asarray(scalar, a.dtype))
+    return _zero_pad(out, shape)
+
+
+@partial(jax.jit, static_argnames=("shape",))
+def _transpose_op(a, shape):
+    return a.T
+
+
+@partial(jax.jit, static_argnames=("shape", "kind", "axis"))
+def _reduce_op(a, shape, kind, axis):
+    mask = _pad_mask(a.shape, shape)
+    if kind in ("sum", "norm", "mean"):
+        x = jnp.where(mask, a, 0)
+        if kind == "norm":
+            x = x * x
+        red = jnp.sum(x, axis=axis, keepdims=True) if axis is not None else \
+            jnp.sum(x, keepdims=True).reshape(1, 1)
+        if kind == "mean":
+            n = shape[axis] if axis is not None else shape[0] * shape[1]
+            red = red / n
+        if kind == "norm":
+            red = jnp.sqrt(red)
+    else:
+        fill = jnp.asarray(jnp.inf if kind == "min" else -jnp.inf, a.dtype)
+        x = jnp.where(mask, a, fill)
+        fn = jnp.min if kind == "min" else jnp.max
+        red = fn(x, axis=axis, keepdims=True) if axis is not None else \
+            fn(x, keepdims=True).reshape(1, 1)
+    return red
+
+
+def _repad(logical_data, shape):
+    """Pad logical(-region) data out to the current quantum and zero-fill."""
+    q = _mesh.pad_quantum()
+    pshape = _padded_shape(shape, q)
+    cropped = logical_data[: shape[0], : shape[1]]
+    if cropped.shape == pshape:
+        return jax.device_put(cropped, _mesh.data_sharding())
+    out = _place(cropped, pshape, shape)
+    return jax.device_put(out, _mesh.data_sharding())
+
+
+def _gather_op(a, r_idx, c_idx):
+    if isinstance(r_idx, slice) and isinstance(c_idx, slice):
+        return a[r_idx, c_idx]
+    if isinstance(r_idx, slice):
+        return a[r_idx, :][:, c_idx]
+    if isinstance(c_idx, slice):
+        return a[r_idx, :][:, c_idx]
+    return a[r_idx, :][:, c_idx]
+
+
+def _split_key(key):
+    if isinstance(key, tuple):
+        if len(key) != 2:
+            raise IndexError("ds-arrays are 2-D: index with at most two axes")
+        return key
+    return key, slice(None)
+
+
+def _normalize_index(idx, dim):
+    """Return (index object over the padded array, result length)."""
+    if isinstance(idx, (int, np.integer)):
+        i = int(idx)
+        if i < 0:
+            i += dim
+        if not 0 <= i < dim:
+            raise IndexError(f"index {idx} out of bounds for dim {dim}")
+        return slice(i, i + 1), 1
+    if isinstance(idx, slice):
+        start, stop, step = idx.indices(dim)
+        if step <= 0:
+            raise IndexError("negative slice steps not supported")
+        length = max(0, -(-(stop - start) // step))
+        return slice(start, stop, step), length
+    # fancy indexing with a list / ndarray of ints (or bools)
+    arr = np.asarray(idx)
+    if arr.dtype == bool:
+        if arr.shape[0] != dim:
+            raise IndexError("boolean index length mismatch")
+        arr = np.nonzero(arr)[0]
+    arr = arr.astype(np.int64)
+    arr = np.where(arr < 0, arr + dim, arr)
+    if arr.size and (arr.min() < 0 or arr.max() >= dim):
+        raise IndexError("fancy index out of bounds")
+    return arr, int(arr.shape[0])
+
+
+# ---------------------------------------------------------------------------
+# public constructors  (parity: dislib.data.array constructors, SURVEY §3.1)
+# ---------------------------------------------------------------------------
+
+def array(x, block_size=None) -> Array:
+    """Build a ds-array from host data (ndarray, nested lists, or scipy sparse)."""
+    import scipy.sparse as sp
+    sparse = sp.issparse(x)
+    if sparse:
+        x = x.toarray()
+    x = np.asarray(x)
+    if x.ndim == 1:
+        x = x.reshape(1, -1)
+    if x.ndim != 2:
+        raise ValueError("ds-arrays are 2-dimensional")
+    if x.dtype == np.float64:
+        x = x.astype(np.float32)
+    if block_size is None:
+        block_size = _default_block_size(x.shape, None)
+    _check_block_size(x.shape, block_size)
+    return Array._from_logical(jnp.asarray(x), reg_shape=block_size, sparse=sparse)
+
+
+def _check_block_size(shape, block_size):
+    br, bc = block_size
+    if br <= 0 or bc <= 0:
+        raise ValueError("block_size entries must be positive")
+    if br > shape[0] and shape[0] > 0 or bc > shape[1] and shape[1] > 0:
+        # reference allows block_size larger than shape only when it equals it;
+        # we accept and clamp (layout is mesh-determined anyway).
+        pass
+
+
+def random_array(shape, block_size=None, random_state=None) -> Array:
+    """Uniform [0, 1) ds-array; deterministic per seed, seeded per the whole
+    array (the reference seeds per block — an implementation artifact of
+    task-parallel generation, not an API contract)."""
+    seed = _seed_from(random_state)
+    q = _mesh.pad_quantum()
+    pshape = _padded_shape(shape, q)
+    data = _random_uniform(jax.random.PRNGKey(seed), pshape, tuple(int(s) for s in shape))
+    data = jax.device_put(data, _mesh.data_sharding())
+    return Array(data, shape, reg_shape=block_size)
+
+
+@partial(jax.jit, static_argnames=("pshape", "shape"))
+def _random_uniform(key, pshape, shape):
+    vals = jax.random.uniform(key, pshape, dtype=jnp.float32)
+    return _zero_pad(vals, shape)
+
+
+def _seed_from(random_state):
+    if random_state is None:
+        return np.random.randint(0, 2**31 - 1)
+    if isinstance(random_state, (int, np.integer)):
+        return int(random_state)
+    if isinstance(random_state, np.random.RandomState):
+        return int(random_state.randint(0, 2**31 - 1))
+    raise TypeError(f"bad random_state: {random_state!r}")
+
+
+def zeros(shape, block_size=None, dtype=jnp.float32) -> Array:
+    q = _mesh.pad_quantum()
+    pshape = _padded_shape(shape, q)
+    data = jax.device_put(jnp.zeros(pshape, dtype), _mesh.data_sharding())
+    return Array(data, shape, reg_shape=block_size)
+
+
+def full(shape, fill_value, block_size=None, dtype=jnp.float32) -> Array:
+    q = _mesh.pad_quantum()
+    pshape = _padded_shape(shape, q)
+    data = _full_op(pshape, tuple(int(s) for s in shape), float(fill_value), dtype)
+    data = jax.device_put(data, _mesh.data_sharding())
+    return Array(data, shape, reg_shape=block_size)
+
+
+@partial(jax.jit, static_argnames=("pshape", "shape", "dtype"))
+def _full_op(pshape, shape, fill_value, dtype):
+    return _zero_pad(jnp.full(pshape, fill_value, dtype), shape)
+
+
+def ones(shape, block_size=None, dtype=jnp.float32) -> Array:
+    return full(shape, 1.0, block_size, dtype)
+
+
+def identity(n, block_size=None, dtype=jnp.float32) -> Array:
+    return eye(n, n, block_size, dtype)
+
+
+def eye(n, m=None, block_size=None, dtype=jnp.float32) -> Array:
+    m = n if m is None else m
+    q = _mesh.pad_quantum()
+    pshape = _padded_shape((n, m), q)
+    data = jax.device_put(_eye_op(pshape, (int(n), int(m)), dtype), _mesh.data_sharding())
+    return Array(data, (n, m), reg_shape=block_size)
+
+
+@partial(jax.jit, static_argnames=("pshape", "shape", "dtype"))
+def _eye_op(pshape, shape, dtype):
+    r = lax.broadcasted_iota(jnp.int32, pshape, 0)
+    c = lax.broadcasted_iota(jnp.int32, pshape, 1)
+    return jnp.where((r == c) & (r < min(shape)), jnp.ones((), dtype), jnp.zeros((), dtype))
+
+
+def apply_along_axis(func, axis, x: Array, *args, **kwargs) -> Array:
+    """Apply ``func`` to 1-D slices of ``x`` along ``axis`` (reference:
+    `dislib.data.array.apply_along_axis`, the generic user-level block map).
+
+    ``func`` is first attempted as a JAX-traceable function (vmapped on
+    device, so the map runs sharded); if tracing fails it falls back to
+    ``np.apply_along_axis`` on host."""
+    logical = x._data[: x._shape[0], : x._shape[1]]
+    try:
+        out = jnp.apply_along_axis(func, axis, logical, *args, **kwargs)
+    except Exception:
+        out = np.apply_along_axis(func, axis, np.asarray(jax.device_get(logical)),
+                                  *args, **kwargs)
+        out = jnp.asarray(out)
+    if out.ndim == 1:
+        out = out.reshape(1, -1) if axis == 0 else out.reshape(-1, 1)
+    return Array._from_logical(out, reg_shape=None)
+
+
+def concat_rows(arrays) -> Array:
+    """Stack ds-arrays vertically (logical concatenation)."""
+    datas = [a._data[: a._shape[0], : a._shape[1]] for a in arrays]
+    out = jnp.concatenate(datas, axis=0)
+    return Array._from_logical(out, reg_shape=arrays[0]._reg_shape)
+
+
+def concat_cols(arrays) -> Array:
+    datas = [a._data[: a._shape[0], : a._shape[1]] for a in arrays]
+    out = jnp.concatenate(datas, axis=1)
+    return Array._from_logical(out, reg_shape=arrays[0]._reg_shape)

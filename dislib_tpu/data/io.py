@@ -1,0 +1,111 @@
+"""Data ingest / export.
+
+Reference capability (SURVEY.md §3.1 "I/O", `dislib/data/io.py`): per-block
+reader tasks over a shared filesystem so loading is itself parallel —
+`load_txt_file`, `load_svmlight_file` (sparse-capable), `load_npy_file`,
+`load_mdcrd_file` (AMBER mdcrd MD trajectories), `save_txt`.
+
+TPU-native shape: in a multi-host job each host parses only the byte-range /
+row-range that lands in its local shards and the global array is assembled
+with `jax.make_array_from_process_local_data`; single-host (this build's test
+rig) parses locally and `device_put`s with the canonical sharding.  Parsing
+itself is host-side C-speed (numpy loadtxt / buffer ops), matching the
+reference where parsing was also CPU-side inside tasks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dislib_tpu.data.array import Array as _Array, array as _ds_array
+
+
+def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32):
+    """Load a delimited text file into a ds-array (reference: load_txt_file)."""
+    data = np.loadtxt(path, delimiter=delimiter, dtype=dtype, ndmin=2)
+    return _ds_array(data, block_size=block_size)
+
+
+def load_npy_file(path, block_size=None):
+    """Load a .npy file into a ds-array (reference: load_npy_file)."""
+    data = np.load(path, allow_pickle=False)
+    if data.ndim != 2:
+        raise ValueError("load_npy_file expects a 2-D array")
+    return _ds_array(data, block_size=block_size)
+
+
+def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True):
+    """Load a svmlight/libsvm file -> (x, y) ds-arrays (reference parity).
+
+    Hand-rolled parser (no sklearn dependency in the library path)."""
+    rows, labels = [], []
+    max_feat = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            feats = {}
+            for tok in parts[1:]:
+                if tok.startswith("#"):
+                    break
+                k, v = tok.split(":")
+                feats[int(k)] = float(v)
+            if feats:
+                max_feat = max(max_feat, max(feats))
+            rows.append(feats)
+    n = len(rows)
+    m = n_features if n_features is not None else max_feat
+    dense = np.zeros((n, m), dtype=np.float32)
+    for i, feats in enumerate(rows):
+        for k, v in feats.items():
+            dense[i, k - 1] = v  # svmlight is 1-indexed
+    if store_sparse:
+        import scipy.sparse as sp
+        x = _ds_array(sp.csr_matrix(dense), block_size=block_size)
+    else:
+        x = _ds_array(dense, block_size=block_size)
+    y = _ds_array(np.asarray(labels, dtype=np.float32).reshape(-1, 1),
+                   block_size=(block_size[0], 1) if block_size else None)
+    return x, y
+
+
+def load_mdcrd_file(path, block_size=None, n_atoms=None, copy_first=False):
+    """Load an AMBER .mdcrd trajectory: one row per frame, 3*n_atoms coords
+    (reference: load_mdcrd_file for the Daura/MD pipeline)."""
+    if n_atoms is None:
+        raise ValueError("n_atoms is required for mdcrd parsing")
+    values = []
+    with open(path) as f:
+        next(f)  # title line
+        for line in f:
+            values.extend(float(line[i:i + 8]) for i in range(0, len(line.rstrip("\n")), 8)
+                          if line[i:i + 8].strip())
+    per_frame = 3 * n_atoms
+    n_frames = len(values) // per_frame
+    data = np.asarray(values[: n_frames * per_frame], dtype=np.float32)
+    data = data.reshape(n_frames, per_frame)
+    if copy_first and n_frames > 0:
+        data = np.vstack([data, data[:1]])
+    return _ds_array(data, block_size=block_size)
+
+
+def save_txt(x, path, merge_rows=True, delimiter=","):
+    """Save a ds-array to text (reference: save_txt). ``merge_rows=True``
+    writes one file; ``False`` writes one file per row-block stripe, the
+    reference's per-block layout."""
+    data = x.collect()
+    import scipy.sparse as sp
+    if sp.issparse(data):
+        data = data.toarray()
+    if merge_rows:
+        np.savetxt(path, data, delimiter=delimiter)
+    else:
+        import os
+        os.makedirs(path, exist_ok=True)
+        step = x._reg_shape[0]
+        for bi, start in enumerate(range(0, data.shape[0], step)):
+            np.savetxt(os.path.join(path, f"{bi}"), data[start:start + step],
+                       delimiter=delimiter)

@@ -1,0 +1,51 @@
+"""Array padding/trimming utilities (reference: `dislib/data/util/` —
+`pad`, `pad_last_blocks_with_zeros`, `compute_bottom_right_shape`,
+`remove_last_rows`, `remove_last_columns`; SURVEY.md §3.1).
+
+In the TPU rebuild physical padding is automatic (every Array carries a
+zero-padded canvas), so these helpers operate on the *logical* shape — they
+exist for API parity and for QR-style algorithms that want logically-square
+block grids.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dislib_tpu.data.array import Array as _Array, array as _ds_array
+
+
+def pad(x: _Array, pad_width, value=0.0) -> _Array:
+    """Grow the logical shape by ((top, bottom), (left, right)) filled with
+    ``value``."""
+    (top, bottom), (left, right) = pad_width
+    import jax.numpy as jnp
+    logical = x._data[: x.shape[0], : x.shape[1]]
+    out = jnp.pad(logical, ((top, bottom), (left, right)), constant_values=value)
+    return _Array._from_logical(out, reg_shape=x._reg_shape, sparse=x._sparse)
+
+
+def pad_last_blocks_with_zeros(x: _Array) -> _Array:
+    """Pad so the logical shape is an exact multiple of the block size."""
+    br, bc = x._reg_shape
+    bottom = (-x.shape[0]) % br
+    right = (-x.shape[1]) % bc
+    if bottom == 0 and right == 0:
+        return x
+    return pad(x, ((0, bottom), (0, right)), 0.0)
+
+
+def compute_bottom_right_shape(x: _Array):
+    """Shape of the bottom-right (possibly ragged) block."""
+    br, bc = x._reg_shape
+    r = x.shape[0] % br or br
+    c = x.shape[1] % bc or bc
+    return r, c
+
+
+def remove_last_rows(x: _Array, n: int) -> _Array:
+    return x[: x.shape[0] - n, :]
+
+
+def remove_last_columns(x: _Array, n: int) -> _Array:
+    return x[:, : x.shape[1] - n]

@@ -1,0 +1,6 @@
+from dislib_tpu.decomposition.tsqr import tsqr
+from dislib_tpu.decomposition.randomsvd import random_svd
+from dislib_tpu.decomposition.lanczos import lanczos_svd
+from dislib_tpu.decomposition.pca import PCA
+
+__all__ = ["tsqr", "random_svd", "lanczos_svd", "PCA"]

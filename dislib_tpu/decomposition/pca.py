@@ -1,0 +1,89 @@
+"""PCA (reference: `dislib/decomposition/pca` — SURVEY.md §3.2: covariance
+path = blocked mean-centering → scatter-matrix partial sums → eigh in one
+task; svd path delegates to dislib's SVD).
+
+TPU-native: the scatter matrix XᵀX is one sharded GEMM whose partial-sum
+reduction over the row axis IS the reference's arity-tree of partial-sum
+tasks, emitted by XLA as a psum over ICI.  The (n_features, n_features) eigh
+runs replicated.  The svd path uses one-sided Jacobi (dislib_tpu.math.svd).
+The reference's ``arity`` knob (reduction-tree fan-in) is intentionally
+dropped: reduction topology is the compiler's job now (SURVEY §6 config row).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.data.array import Array
+
+
+class PCA(BaseEstimator):
+    """Principal component analysis.
+
+    Parameters
+    ----------
+    n_components : int or None — defaults to n_features.
+    arity : int — accepted for reference API parity; ignored (reduction
+        topology is XLA's).
+    method : 'eig' | 'svd' — covariance+eigh path or SVD path.
+
+    Attributes
+    ----------
+    components_ : Array (n_components, n_features)
+    explained_variance_ : Array (1, n_components)
+    mean_ : Array (1, n_features)
+    """
+
+    def __init__(self, n_components=None, arity=50, method="eig", eps=1e-9):
+        self.n_components = n_components
+        self.arity = arity
+        self.method = method
+        self.eps = eps
+
+    def fit(self, x: Array, y=None):
+        m, n = x.shape
+        k = self.n_components or n
+        if self.method not in ("eig", "svd"):
+            raise ValueError(f"unknown method {self.method!r}")
+        xv = x._data  # padded; zero rows don't perturb sums
+        mean, comps, var = _pca_fit(xv, x.shape, self.method == "svd")
+        self.mean_ = Array._from_logical(mean.reshape(1, -1))
+        self.components_ = Array._from_logical(comps[:k])
+        self.explained_variance_ = Array._from_logical(var[:k].reshape(1, -1))
+        return self
+
+    def fit_transform(self, x: Array, y=None) -> Array:
+        return self.fit(x).transform(x)
+
+    def transform(self, x: Array) -> Array:
+        from dislib_tpu.math import matmul
+        xc = x - self.mean_
+        return matmul(xc, self.components_, transpose_b=True)
+
+    def inverse_transform(self, y: Array) -> Array:
+        from dislib_tpu.math import matmul
+        return matmul(y, self.components_) + self.mean_
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("shape", "use_svd"))
+def _pca_fit(xp, shape, use_svd):
+    m, n = shape
+    xv = xp[:, :n]  # crop cols; padded rows are zero
+    total = jnp.sum(xv, axis=0)
+    mean = total / m
+    # centered scatter without materialising centered X for padded rows:
+    # Σ (x-μ)(x-μ)ᵀ over logical rows = XᵀX - m μμᵀ   (padded zero rows add 0 to XᵀX)
+    scatter = xv.T @ xv - m * jnp.outer(mean, mean)
+    cov = scatter / (m - 1)
+    if use_svd:
+        # SVD of covariance (symmetric PSD): singular values = eigenvalues
+        u, s, _ = jnp.linalg.svd(cov)
+        return mean, u.T, s
+    w, v = jnp.linalg.eigh(cov)
+    order = jnp.argsort(-w)
+    return mean, v[:, order].T, w[order]

@@ -1,0 +1,59 @@
+"""Randomized SVD (reference: `dislib/decomposition/randomsvd` — Gaussian
+test matrix, power iterations with QR re-orthonormalisation, small dense SVD
+of the projected matrix; SURVEY.md §3.2, BASELINE config 4).
+
+TPU-native: the sketch Y = A Ω and the power iterations are sharded GEMMs
+(MXU-bound); re-orthonormalisation uses the tsQR tree so the only collective
+per iteration is the all_gather(R) + the GEMM's own partial-sum psum — the
+survey's "power-iteration psum" pattern.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dislib_tpu.data.array import Array, random_array
+from dislib_tpu.math import matmul
+from dislib_tpu.decomposition.tsqr import tsqr
+
+
+def random_svd(a: Array, iters: int = 2, epsilon: float | None = None,
+               tol: float = 1e-3, nsv: int | None = None, k: int | None = None,
+               oversample: int = 10, random_state=None, verbose: bool = False):
+    """Truncated randomized SVD of ``a``.
+
+    Returns (U, S, V) with U (m, k), S (1, k), V (n, k); ``k`` defaults to
+    ``nsv`` (number of singular values) + oversampling, truncated to nsv.
+    """
+    m, n = a.shape
+    nsv = nsv if nsv is not None else (k if k is not None else min(m, n, 6))
+    sketch = min(n, nsv + oversample)
+    seed = 0 if random_state is None else int(np.random.RandomState(random_state).randint(2**31 - 1)) \
+        if not isinstance(random_state, (int, np.integer)) else int(random_state)
+
+    omega_h = jax.random.normal(jax.random.PRNGKey(seed), (n, sketch), dtype=jnp.float32)
+    omega = Array._from_logical(omega_h)
+
+    y = matmul(a, omega)                     # (m, sketch) sharded GEMM
+    q, _ = tsqr(y) if m >= sketch else _qr_fallback(y)
+    for _ in range(iters):
+        z = matmul(a, q, transpose_a=True)   # (n, sketch)
+        qz, _ = tsqr(z) if n >= sketch else _qr_fallback(z)
+        y = matmul(a, qz)
+        q, _ = tsqr(y) if m >= sketch else _qr_fallback(y)
+
+    b = matmul(q, a, transpose_a=True)       # (sketch, n) small projected matrix
+    bv = b._data[: b.shape[0], : b.shape[1]]
+    ub, s, vt = jnp.linalg.svd(bv, full_matrices=False)
+    u = matmul(q, Array._from_logical(ub))
+    u = u[:, :nsv]
+    v = Array._from_logical(vt.T[:, :nsv])
+    s_arr = Array._from_logical(s[:nsv].reshape(1, -1))
+    return u, s_arr, v
+
+
+def _qr_fallback(y: Array):
+    from dislib_tpu.math.qr import qr as _qr
+    return _qr(y, mode="economic")

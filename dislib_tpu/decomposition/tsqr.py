@@ -1,0 +1,94 @@
+"""Tall-skinny QR (reference: `dislib/decomposition/tsqr` — per-block local QR
+plus a pairwise tree reduction of R factors; SURVEY.md §3.2).
+
+TPU-native design (BASELINE config 3: "tsQR on 65536x256 — _little_qr +
+all_gather(R) over ICI"): one `shard_map` over the mesh 'rows' axis.
+
+    per shard:  A_i = Q1_i R_i           (local Householder QR, MXU)
+    collective: R_stack = all_gather(R_i)  — ONE all_gather over ICI; with
+                n cols small this is the whole communication volume
+    per shard:  R_stack = Q2 R ;  Q_i = Q1_i @ Q2[i]   (local GEMM)
+
+The reference's arity-2 reduction tree is log2(p) rounds of pairwise R
+merges shipped between workers; the all_gather collapses that tree into a
+single ICI collective, after which every shard redundantly factors the tiny
+(p·n, n) stack — redundant FLOPs are free next to saved latency hops.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dislib_tpu.data.array import Array
+from dislib_tpu.parallel import mesh as _mesh
+
+
+def tsqr(a: Array, mode: str = "reduced", indexes=None):
+    """Tall-skinny QR.
+
+    mode='reduced' → (Q (m,n), R (n,n));  mode='r' → R only.
+    ``indexes`` (reference parity): restrict the returned Q to these column
+    indices after factorisation.
+    """
+    if mode not in ("reduced", "r"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    m, n = a.shape
+    if m < n:
+        raise ValueError("tsqr requires a tall-skinny array (m >= n)")
+    mesh = _mesh.get_mesh()
+    p = mesh.shape[_mesh.ROWS]
+    av = a._data[:, :n].astype(jnp.float32)  # keep padded rows (zeros), crop cols
+    # each shard must be at least n tall for its local R to be (n, n);
+    # grow with zero rows if not (zero rows leave Q's logical rows and R exact)
+    if av.shape[0] // p < n:
+        extra = p * n - av.shape[0]
+        av = jnp.pad(av, ((0, extra), (0, 0)))
+        av = jax.device_put(av, _mesh.row_sharding())
+    q_pad, r = _tsqr_shardmap(av, mesh, p)
+    if mode == "r":
+        return Array._from_logical(r)
+    q = Array._from_logical_padded(_col_repad(q_pad), (m, n), a._reg_shape)
+    if indexes is not None:
+        q = q[:, list(indexes)]
+    return q, Array._from_logical(r)
+
+
+@partial(jax.jit, static_argnames=("mesh", "p"))
+def _tsqr_shardmap(av, mesh, p):
+    n = av.shape[1]
+
+    def local(a_shard):
+        q1, r1 = jnp.linalg.qr(a_shard, mode="reduced")      # (m/p, n), (n, n)
+        r_stack = lax.all_gather(r1, _mesh.ROWS)             # (p, n, n) — ICI
+        r_stack = r_stack.reshape(p * n, n)
+        q2, r = jnp.linalg.qr(r_stack, mode="reduced")       # redundant per shard
+        idx = lax.axis_index(_mesh.ROWS)
+        q2_i = lax.dynamic_slice(q2, (idx * n, 0), (n, n))
+        return q1 @ q2_i, r
+
+    # check_vma=False: R comes out of an identical computation on the
+    # all_gathered stack on every shard — replicated in fact, but the static
+    # varying-axes analysis can't prove it. Tests assert QR == A.
+    q, r = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=P(_mesh.ROWS, None),
+        out_specs=(P(_mesh.ROWS, None), P(None, None)),
+        check_vma=False,
+    )(av)
+    return q, r
+
+
+def _col_repad(q_pad):
+    """Pad Q's column dim back to the mesh quantum (rows already padded)."""
+    import math
+    q = _mesh.pad_quantum()
+    n = q_pad.shape[1]
+    target = max(q, int(math.ceil(n / q)) * q)
+    if target != n:
+        q_pad = jnp.pad(q_pad, ((0, 0), (0, target - n)))
+    return jax.device_put(q_pad, _mesh.data_sharding())

@@ -1,0 +1,4 @@
+from dislib_tpu.math.base import matmul, kron, svd
+from dislib_tpu.math.qr import qr
+
+__all__ = ["matmul", "kron", "svd", "qr"]

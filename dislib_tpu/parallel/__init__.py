@@ -1,0 +1,11 @@
+"""Mesh, sharding and collective infrastructure (the COMPSs-runtime role)."""
+
+from dislib_tpu.parallel.mesh import (
+    ROWS, COLS, init, get_mesh, set_mesh, mesh_shape, pad_quantum,
+    data_sharding, row_sharding, replicated,
+)
+
+__all__ = [
+    "ROWS", "COLS", "init", "get_mesh", "set_mesh", "mesh_shape",
+    "pad_quantum", "data_sharding", "row_sharding", "replicated",
+]

@@ -1,0 +1,108 @@
+"""Device-mesh management for dislib_tpu.
+
+The reference (dislib) describes cluster topology outside the library, in the
+COMPSs resource files (``project.xml``/``resources.xml``) and the ``runcompss``
+launcher (SURVEY.md §6 "Config / flag system").  In the TPU-native rebuild the
+topology is a :class:`jax.sharding.Mesh` with two named axes:
+
+- ``"rows"`` — the data axis.  Row blocks of every ds-array live here; all the
+  map-over-row-blocks estimators (KMeans, GMM, scalers, ...) shard along it.
+- ``"cols"`` — the model/feature axis, used by 2-D blocked linear algebra
+  (matmul / QR trailing updates) the way the reference partitions its block
+  grid in two dimensions.
+
+``init()`` builds the default mesh; ``get_mesh()`` returns it (building a
+1-D-over-all-devices default lazily).  Multi-host jobs call
+:func:`dislib_tpu.parallel.distributed.initialize` first so ``jax.devices()``
+spans hosts and the outer mesh dimension rides DCN while the inner rides ICI.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+ROWS = "rows"
+COLS = "cols"
+AXIS_NAMES = (ROWS, COLS)
+
+_default_mesh: Mesh | None = None
+
+
+def init(mesh_shape: tuple[int, int] | None = None, devices=None) -> Mesh:
+    """Initialise (or re-initialise) the library-wide default mesh.
+
+    Parameters
+    ----------
+    mesh_shape : (rows, cols) or None
+        Device grid shape.  ``None`` reads the ``DSLIB_MESH`` env var
+        (``"4,2"``) and otherwise defaults to ``(n_devices, 1)`` — pure data
+        parallelism, the reference's dominant pattern (SURVEY.md §3.6).
+    devices : sequence of jax devices, optional
+        Defaults to ``jax.devices()``.
+    """
+    global _default_mesh
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if mesh_shape is None:
+        env = os.environ.get("DSLIB_MESH")
+        if env:
+            mesh_shape = tuple(int(s) for s in env.split(","))  # type: ignore
+        else:
+            mesh_shape = (n, 1)
+    r, c = mesh_shape
+    if r * c > n:
+        raise ValueError(f"mesh_shape {mesh_shape} needs {r * c} devices, have {n}")
+    dev_grid = np.asarray(devices[: r * c]).reshape(r, c)
+    _default_mesh = Mesh(dev_grid, AXIS_NAMES)
+    return _default_mesh
+
+
+def get_mesh() -> Mesh:
+    """Return the default mesh, creating the (n_devices, 1) default lazily."""
+    global _default_mesh
+    if _default_mesh is None:
+        init()
+    return _default_mesh
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def mesh_shape(mesh: Mesh | None = None) -> tuple[int, int]:
+    mesh = mesh or get_mesh()
+    return (mesh.shape[ROWS], mesh.shape[COLS])
+
+
+def pad_quantum(mesh: Mesh | None = None) -> int:
+    """Every ds-array dimension is padded to a multiple of this.
+
+    lcm(rows, cols) so that either logical dimension can be sharded over
+    either mesh axis without remainder — required by ``shard_map`` and it
+    keeps XLA's SPMD partitioner from introducing halo/pad ops of its own.
+    """
+    r, c = mesh_shape(mesh)
+    return r * c // math.gcd(r, c)
+
+
+def data_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    """The canonical 2-D ds-array sharding: rows over 'rows', cols over 'cols'."""
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P(ROWS, COLS))
+
+
+def row_sharding(mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P(ROWS, None))
+
+
+def replicated(mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    return NamedSharding(mesh, P(None, None))

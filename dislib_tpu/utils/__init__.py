@@ -1,0 +1,4 @@
+from dislib_tpu.utils.base import shuffle, train_test_split
+from dislib_tpu.utils.saving import save_model, load_model
+
+__all__ = ["shuffle", "train_test_split", "save_model", "load_model"]

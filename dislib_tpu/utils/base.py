@@ -1,0 +1,42 @@
+"""Global shuffle and train/test helpers (reference: `dislib/utils` —
+`shuffle(x, y, random_state)` is a random global permutation via
+partition-and-rebuild tasks; SURVEY.md §3.3).
+
+TPU-native: a global permutation of a row-sharded array is an all-to-all over
+shards.  We express it as a gather with a permuted index vector — XLA lowers
+the cross-shard gather to its collective machinery (ppermute/all-to-all) —
+rather than re-building the reference's partition/merge task pipeline.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dislib_tpu.data.array import Array
+
+
+def shuffle(x: Array, y: Array | None = None, random_state=None):
+    """Randomly permute rows of ``x`` (and ``y`` with the same permutation)."""
+    rng = random_state if isinstance(random_state, np.random.RandomState) \
+        else np.random.RandomState(random_state)
+    perm = rng.permutation(x.shape[0])
+    xs = x[perm, :]
+    if y is None:
+        return xs
+    if y.shape[0] != x.shape[0]:
+        raise ValueError("x and y must have the same number of rows")
+    return xs, y[perm, :]
+
+
+def train_test_split(x: Array, y: Array | None = None, test_size: float = 0.25,
+                     train_size: float | None = None, random_state=None):
+    """Split rows into train/test ds-arrays (sklearn-style convenience)."""
+    n = x.shape[0]
+    n_test = int(round(n * test_size))
+    n_train = n - n_test if train_size is None else int(round(n * train_size))
+    rng = np.random.RandomState(random_state)
+    perm = rng.permutation(n)
+    tr, te = perm[:n_train], perm[n_train:n_train + n_test]
+    if y is None:
+        return x[tr, :], x[te, :]
+    return x[tr, :], x[te, :], y[tr, :], y[te, :]

@@ -1,0 +1,154 @@
+"""Fitted-model checkpointing (reference: `dislib/utils/saving.py` —
+`save_model`/`load_model` with JSON or CBOR encodings of fitted estimators,
+syncing all futures first; SURVEY.md §3.3 and §6 "Checkpoint / resume").
+
+TPU-native: same semantics — save syncs device state to host (`collect()`)
+and encodes hyperparameters + trailing-underscore fitted attributes.  No
+pickle (portability, same stance as the reference's JSON/CBOR choice).
+Formats: 'json' (reference parity), 'cbor' (reference parity, needs cbor2),
+'npz' (compact binary, numpy-native).
+
+Mid-fit checkpointing of iterative estimators (TPU preemption reality) lives
+in `dislib_tpu.utils.checkpoint`.
+"""
+
+from __future__ import annotations
+
+import base64
+import importlib
+import json
+
+import numpy as np
+
+from dislib_tpu.data.array import Array, array as _make_array
+
+_ALLOWED_MODULES = ("dislib_tpu.",)
+
+
+def _encode(obj):
+    if isinstance(obj, Array):
+        coll = obj.collect()
+        import scipy.sparse as sp
+        if sp.issparse(coll):
+            coll = coll.toarray()
+        return {"__dsarray__": _np_payload(coll), "block_size": list(obj._reg_shape)}
+    if isinstance(obj, np.ndarray):
+        return {"__ndarray__": _np_payload(obj)}
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (list, tuple)):
+        return {"__seq__": [_encode(o) for o in obj], "tuple": isinstance(obj, tuple)}
+    if isinstance(obj, dict):
+        return {"__dict__": {k: _encode(v) for k, v in obj.items()}}
+    if hasattr(obj, "get_params") and hasattr(obj, "_fitted_attrs"):
+        return {"__estimator__": _estimator_state(obj)}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    try:
+        import jax
+        if isinstance(obj, jax.Array):
+            return {"__ndarray__": _np_payload(np.asarray(obj))}
+    except Exception:
+        pass
+    raise TypeError(f"cannot serialise {type(obj).__name__}")
+
+
+def _np_payload(a):
+    a = np.ascontiguousarray(a)
+    return {"dtype": str(a.dtype), "shape": list(a.shape),
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def _np_restore(p):
+    a = np.frombuffer(base64.b64decode(p["data"]), dtype=np.dtype(p["dtype"]))
+    return a.reshape(p["shape"]).copy()
+
+
+def _decode(obj):
+    if isinstance(obj, dict):
+        if "__dsarray__" in obj:
+            return _make_array(_np_restore(obj["__dsarray__"]),
+                               block_size=tuple(obj["block_size"]))
+        if "__ndarray__" in obj:
+            return _np_restore(obj["__ndarray__"])
+        if "__seq__" in obj:
+            seq = [_decode(o) for o in obj["__seq__"]]
+            return tuple(seq) if obj.get("tuple") else seq
+        if "__dict__" in obj:
+            return {k: _decode(v) for k, v in obj["__dict__"].items()}
+        if "__estimator__" in obj:
+            return _estimator_restore(obj["__estimator__"])
+    return obj
+
+
+def _estimator_state(model):
+    cls = type(model)
+    return {
+        "module": cls.__module__,
+        "cls": cls.__qualname__,
+        "params": {k: _encode(v) for k, v in model.get_params().items()},
+        "fitted": {k: _encode(v) for k, v in model._fitted_attrs().items()},
+    }
+
+
+def _estimator_restore(state):
+    module = state["module"]
+    if not module.startswith(_ALLOWED_MODULES):
+        raise ValueError(f"refusing to load estimator from module {module!r}")
+    cls = getattr(importlib.import_module(module), state["cls"])
+    model = cls(**{k: _decode(v) for k, v in state["params"].items()})
+    for k, v in state["fitted"].items():
+        setattr(model, k, _decode(v))
+    return model
+
+
+def save_model(model, filepath: str, overwrite: bool = True,
+               save_format: str = "json") -> None:
+    """Persist a fitted dislib_tpu estimator (reference: utils.saving.save_model)."""
+    import os
+    if os.path.exists(filepath) and not overwrite:
+        raise FileExistsError(filepath)
+    state = {"__estimator__": _estimator_state(model)}
+    if save_format == "json":
+        with open(filepath, "w") as f:
+            json.dump(state, f)
+    elif save_format == "cbor":
+        try:
+            import cbor2
+        except ImportError as e:  # pragma: no cover - env-dependent
+            raise ImportError("cbor format requires the cbor2 package") from e
+        with open(filepath, "wb") as f:
+            cbor2.dump(state, f)
+    elif save_format == "npz":
+        flat = json.dumps(state).encode()
+        np.savez_compressed(filepath, state=np.frombuffer(flat, dtype=np.uint8))
+    else:
+        raise ValueError(f"unknown save_format {save_format!r}")
+
+
+def load_model(filepath: str, load_format: str | None = None):
+    """Load a model saved by :func:`save_model` (reference parity)."""
+    if load_format is None:
+        load_format = "json"
+        if filepath.endswith(".cbor"):
+            load_format = "cbor"
+        elif filepath.endswith(".npz"):
+            load_format = "npz"
+    if load_format == "json":
+        with open(filepath) as f:
+            state = json.load(f)
+    elif load_format == "cbor":
+        try:
+            import cbor2
+        except ImportError as e:  # pragma: no cover
+            raise ImportError("cbor format requires the cbor2 package") from e
+        with open(filepath, "rb") as f:
+            state = cbor2.load(f)
+    elif load_format == "npz":
+        raw = np.load(filepath)["state"].tobytes()
+        state = json.loads(raw.decode())
+    else:
+        raise ValueError(f"unknown load_format {load_format!r}")
+    return _decode(state)

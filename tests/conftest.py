@@ -1,0 +1,41 @@
+"""Test rig: 8 virtual CPU devices — the analog of the reference's
+"COMPSs workers as local processes" CI trick (SURVEY.md §5).
+
+The suite runs on the CPU platform with 8 virtual devices so every sharding /
+collective path executes for real.  Set ``DSLIB_TEST_TPU=1`` to run the same
+tests unmodified on the real TPU backend instead (SURVEY §5 implication (c)).
+
+XLA_FLAGS must be set before the first backend initialisation; the platform
+override must happen before any jax computation (this file is imported by
+pytest ahead of all test modules).
+"""
+
+import os
+
+_ON_TPU = os.environ.get("DSLIB_TEST_TPU") == "1"
+
+if not _ON_TPU:
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    """Each test starts from the default (n_devices, 1) mesh unless it sets its own."""
+    import dislib_tpu as ds
+    ds.init()
+    yield
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

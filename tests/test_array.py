@@ -1,0 +1,201 @@
+"""ds-array tests — mirrors the reference's `tests/test_array.py` strategy
+(SURVEY.md §5): small arrays, deliberately irregular block sizes, dense and
+(later) sparse variants, NumPy as the oracle, determinism via random_state."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+
+
+def _mk(rng, shape, bs=None):
+    x = rng.rand(*shape)
+    return ds.array(x, block_size=bs), x.astype(np.float32)
+
+
+class TestConstruction:
+    def test_from_numpy(self, rng):
+        a, x = _mk(rng, (25, 13), (4, 5))
+        assert a.shape == (25, 13)
+        assert a.block_size == (4, 5)
+        np.testing.assert_allclose(a.collect(), x)
+
+    def test_from_list(self):
+        a = ds.array([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(a.collect(), [[1, 2], [3, 4]])
+
+    def test_1d_promotes_to_row(self):
+        a = ds.array(np.arange(5.0))
+        assert a.shape == (1, 5)
+
+    def test_3d_rejected(self):
+        with pytest.raises(ValueError):
+            ds.array(np.zeros((2, 2, 2)))
+
+    def test_irregular_blocks(self, rng):
+        # shapes that don't divide the mesh or block size evenly
+        for shape in [(1, 1), (7, 3), (17, 19), (8, 64), (100, 1)]:
+            a, x = _mk(rng, shape, (3, 2))
+            np.testing.assert_allclose(a.collect(), x)
+
+    def test_zeros_full_identity_eye(self):
+        np.testing.assert_allclose(ds.zeros((5, 3)).collect(), np.zeros((5, 3)))
+        np.testing.assert_allclose(ds.full((4, 6), 2.5).collect(), np.full((4, 6), 2.5))
+        np.testing.assert_allclose(ds.identity(7).collect(), np.eye(7))
+        np.testing.assert_allclose(ds.eye(5, 9).collect(), np.eye(5, 9))
+        np.testing.assert_allclose(ds.eye(9, 5).collect(), np.eye(9, 5))
+
+    def test_random_array_deterministic(self):
+        a = ds.random_array((20, 10), random_state=7).collect()
+        b = ds.random_array((20, 10), random_state=7).collect()
+        c = ds.random_array((20, 10), random_state=8).collect()
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+        assert a.min() >= 0.0 and a.max() < 1.0
+
+
+class TestElementwise:
+    def test_binary_ops(self, rng):
+        a, x = _mk(rng, (9, 11))
+        b, y = _mk(rng, (9, 11))
+        np.testing.assert_allclose((a + b).collect(), x + y, rtol=1e-6)
+        np.testing.assert_allclose((a - b).collect(), x - y, rtol=1e-6)
+        np.testing.assert_allclose((a * b).collect(), x * y, rtol=1e-6)
+        np.testing.assert_allclose((a / (b + 1.0)).collect(), x / (y + 1), rtol=1e-5)
+
+    def test_scalar_ops(self, rng):
+        a, x = _mk(rng, (6, 5))
+        np.testing.assert_allclose((a + 3).collect(), x + 3, rtol=1e-6)
+        np.testing.assert_allclose((3 + a).collect(), x + 3, rtol=1e-6)
+        np.testing.assert_allclose((a - 1.5).collect(), x - 1.5, rtol=1e-6)
+        np.testing.assert_allclose((2.0 - a).collect(), 2 - x, rtol=1e-6)
+        np.testing.assert_allclose((a * 2).collect(), x * 2, rtol=1e-6)
+        np.testing.assert_allclose((a / 2).collect(), x / 2, rtol=1e-6)
+        np.testing.assert_allclose((2.0 / (a + 1)).collect(), 2 / (x + 1), rtol=1e-5)
+        np.testing.assert_allclose((a ** 2).collect(), x ** 2, rtol=1e-5)
+        np.testing.assert_allclose((-a).collect(), -x, rtol=1e-6)
+        np.testing.assert_allclose(abs(a - 0.5).collect(), abs(x - 0.5), rtol=1e-5)
+
+    def test_broadcast_row(self, rng):
+        a, x = _mk(rng, (12, 5))
+        m = a.mean(axis=0)
+        np.testing.assert_allclose((a - m).collect(), x - x.mean(0, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_shape_mismatch_raises(self, rng):
+        a, _ = _mk(rng, (4, 5))
+        b, _ = _mk(rng, (5, 4))
+        with pytest.raises(ValueError):
+            a + b
+
+
+class TestReductions:
+    @pytest.mark.parametrize("axis", [0, 1, None])
+    @pytest.mark.parametrize("kind", ["sum", "mean", "min", "max"])
+    def test_reductions(self, rng, axis, kind):
+        a, x = _mk(rng, (23, 17), (5, 5))
+        got = getattr(a, kind)(axis=axis).collect()
+        want = getattr(x, kind)(axis=axis, keepdims=True)
+        if axis is None:
+            want = want.reshape(1, 1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_norm(self, rng):
+        a, x = _mk(rng, (14, 9))
+        np.testing.assert_allclose(a.norm(axis=0).collect().ravel(),
+                                   np.linalg.norm(x, axis=0), rtol=1e-5)
+        np.testing.assert_allclose(a.norm(axis=1).collect().ravel(),
+                                   np.linalg.norm(x, axis=1), rtol=1e-5)
+
+
+class TestIndexing:
+    def test_int_row(self, rng):
+        a, x = _mk(rng, (10, 6))
+        np.testing.assert_allclose(a[3].collect(), x[3:4])
+        np.testing.assert_allclose(a[-1].collect(), x[-1:])
+
+    def test_single_element(self, rng):
+        a, x = _mk(rng, (10, 6))
+        assert a[2, 4].shape == (1, 1)
+        np.testing.assert_allclose(a[2, 4].collect()[0, 0], x[2, 4])
+
+    def test_slices(self, rng):
+        a, x = _mk(rng, (20, 15))
+        np.testing.assert_allclose(a[2:9, :].collect(), x[2:9])
+        np.testing.assert_allclose(a[:, 3:11].collect(), x[:, 3:11])
+        np.testing.assert_allclose(a[5:, 10:].collect(), x[5:, 10:])
+        np.testing.assert_allclose(a[::2, ::3].collect(), x[::2, ::3])
+        np.testing.assert_allclose(a[18:200, :].collect(), x[18:200])
+
+    def test_fancy(self, rng):
+        a, x = _mk(rng, (20, 15))
+        np.testing.assert_allclose(a[[1, 5, 5, 19], :].collect(), x[[1, 5, 5, 19]])
+        np.testing.assert_allclose(a[:, [0, 14, 7]].collect(), x[:, [0, 14, 7]])
+        mask = np.zeros(20, bool); mask[[2, 4]] = True
+        np.testing.assert_allclose(a[mask, :].collect(), x[mask])
+
+    def test_out_of_bounds(self, rng):
+        a, _ = _mk(rng, (5, 5))
+        with pytest.raises(IndexError):
+            a[7]
+        with pytest.raises(IndexError):
+            a[:, [9]]
+
+
+class TestLayoutOps:
+    def test_transpose(self, rng):
+        a, x = _mk(rng, (13, 7))
+        np.testing.assert_allclose(a.T.collect(), x.T)
+        np.testing.assert_allclose(a.transpose().collect(), x.T)
+        assert a.T.shape == (7, 13)
+
+    def test_rechunk_metadata_only(self, rng):
+        a, x = _mk(rng, (16, 16), (4, 4))
+        b = a.rechunk((8, 2))
+        assert b.block_size == (8, 2)
+        np.testing.assert_allclose(b.collect(), x)
+
+    def test_astype_copy(self, rng):
+        a, x = _mk(rng, (6, 6))
+        assert a.astype(np.float32).dtype == np.float32
+        np.testing.assert_allclose(a.copy().collect(), x)
+
+    def test_iterator(self, rng):
+        a, x = _mk(rng, (11, 8), (4, 3))
+        rows = list(a.iterator(axis=0))
+        assert len(rows) == 3
+        np.testing.assert_allclose(np.vstack([r.collect() for r in rows]), x)
+        cols = list(a.iterator(axis=1))
+        assert len(cols) == 3
+        np.testing.assert_allclose(np.hstack([c.collect() for c in cols]), x)
+
+    def test_concat(self, rng):
+        a, x = _mk(rng, (5, 4))
+        b, y = _mk(rng, (3, 4))
+        np.testing.assert_allclose(ds.concat_rows([a, b]).collect(), np.vstack([x, y]))
+        c, z = _mk(rng, (5, 6))
+        np.testing.assert_allclose(ds.concat_cols([a, c]).collect(), np.hstack([x, z]))
+
+
+class TestApplyAlongAxis:
+    def test_jax_traceable(self, rng):
+        import jax.numpy as jnp
+        a, x = _mk(rng, (9, 6))
+        got = ds.apply_along_axis(jnp.sum, 0, a).collect()
+        np.testing.assert_allclose(got, x.sum(0, keepdims=True), rtol=1e-5)
+        got = ds.apply_along_axis(jnp.mean, 1, a).collect()
+        np.testing.assert_allclose(got, x.mean(1, keepdims=True), rtol=1e-5)
+
+
+class TestMeshes:
+    def test_2d_mesh(self, rng):
+        ds.init((4, 2))
+        a, x = _mk(rng, (19, 23), (5, 5))
+        np.testing.assert_allclose(a.collect(), x)
+        b = ds.matmul(a, a, transpose_b=True)
+        np.testing.assert_allclose(b.collect(), x @ x.T, rtol=1e-4)
+
+    def test_1x1_mesh(self, rng):
+        ds.init((1, 1))
+        a, x = _mk(rng, (9, 4))
+        np.testing.assert_allclose((a + a).collect(), 2 * x, rtol=1e-6)

@@ -1,0 +1,76 @@
+"""I/O loader tests (reference: data loaders, SURVEY.md §3.1)."""
+
+import os
+
+import numpy as np
+
+import dislib_tpu as ds
+
+
+class TestTxt:
+    def test_roundtrip(self, rng, tmp_path):
+        x = rng.rand(12, 5)
+        path = os.path.join(tmp_path, "x.csv")
+        np.savetxt(path, x, delimiter=",")
+        a = ds.load_txt_file(path, block_size=(4, 5))
+        np.testing.assert_allclose(a.collect(), x.astype(np.float32), rtol=1e-6)
+        out = os.path.join(tmp_path, "y.csv")
+        ds.save_txt(a, out)
+        np.testing.assert_allclose(np.loadtxt(out, delimiter=","), x, rtol=1e-5)
+
+    def test_save_per_block(self, rng, tmp_path):
+        x = rng.rand(10, 3)
+        a = ds.array(x, block_size=(4, 3))
+        out = os.path.join(tmp_path, "blocks")
+        ds.save_txt(a, out, merge_rows=False)
+        parts = [np.loadtxt(os.path.join(out, str(i)), delimiter=",", ndmin=2)
+                 for i in range(3)]
+        np.testing.assert_allclose(np.vstack(parts), x, rtol=1e-5)
+
+
+class TestNpy:
+    def test_load(self, rng, tmp_path):
+        x = rng.rand(8, 6).astype(np.float32)
+        path = os.path.join(tmp_path, "x.npy")
+        np.save(path, x)
+        a = ds.load_npy_file(path, block_size=(3, 3))
+        np.testing.assert_allclose(a.collect(), x)
+
+
+class TestSvmlight:
+    def test_load(self, tmp_path):
+        path = os.path.join(tmp_path, "data.svm")
+        with open(path, "w") as f:
+            f.write("1 1:0.5 3:1.5\n")
+            f.write("-1 2:2.0\n")
+            f.write("1 1:1.0 2:1.0 3:1.0\n")
+        x, y = ds.load_svmlight_file(path, block_size=(2, 3), n_features=3,
+                                     store_sparse=False)
+        want = np.array([[0.5, 0, 1.5], [0, 2.0, 0], [1, 1, 1]], np.float32)
+        np.testing.assert_allclose(x.collect(), want)
+        np.testing.assert_allclose(y.collect().ravel(), [1, -1, 1])
+
+    def test_load_sparse(self, tmp_path):
+        import scipy.sparse as sp
+        path = os.path.join(tmp_path, "data.svm")
+        with open(path, "w") as f:
+            f.write("0 1:1.0\n0 2:1.0\n")
+        x, _ = ds.load_svmlight_file(path, n_features=2, store_sparse=True)
+        got = x.collect()
+        assert sp.issparse(got)
+        np.testing.assert_allclose(got.toarray(), np.eye(2, dtype=np.float32))
+
+
+class TestMdcrd:
+    def test_load(self, tmp_path):
+        # 2 frames, 2 atoms → 6 coords/frame, AMBER fixed-width 8.3f, 10/line
+        path = os.path.join(tmp_path, "traj.mdcrd")
+        coords = [float(i) / 10 for i in range(12)]
+        with open(path, "w") as f:
+            f.write("test trajectory\n")
+            for i in range(0, 12, 10):
+                line = "".join(f"{c:8.3f}" for c in coords[i:i + 10])
+                f.write(line + "\n")
+        a = ds.load_mdcrd_file(path, n_atoms=2)
+        assert a.shape == (2, 6)
+        np.testing.assert_allclose(a.collect().ravel(), coords, atol=1e-3)

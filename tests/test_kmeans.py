@@ -1,0 +1,76 @@
+"""KMeans tests (reference: tests/test_kmeans.py; oracle = sklearn KMeans on
+the same data/init, SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import KMeans
+
+
+def _blobs(rng, n=300, d=4, k=3, spread=0.15):
+    centers = rng.rand(k, d) * 10
+    x = np.vstack([centers[i] + spread * rng.randn(n // k, d) for i in range(k)])
+    labels = np.repeat(np.arange(k), n // k)
+    return x.astype(np.float32), labels, centers.astype(np.float32)
+
+
+class TestKMeans:
+    def test_converges_on_blobs(self, rng):
+        x, true_labels, _ = _blobs(rng)
+        km = KMeans(n_clusters=3, max_iter=50, tol=1e-6, random_state=0)
+        labels = km.fit_predict(ds.array(x)).collect().ravel().astype(int)
+        # clustering equals ground truth up to label permutation
+        for c in range(3):
+            assert len(np.unique(labels[true_labels == c])) == 1
+        assert km.n_iter_ <= 50
+        assert km.inertia_ > 0
+
+    def test_vs_sklearn_same_init(self, rng):
+        from sklearn.cluster import KMeans as SkKMeans
+        x, _, _ = _blobs(rng, n=240, d=5, k=4)
+        init = x[rng.choice(len(x), 4, replace=False)]
+        km = KMeans(n_clusters=4, init=init.copy(), max_iter=30, tol=0.0)
+        km.fit(ds.array(x))
+        sk = SkKMeans(n_clusters=4, init=init.copy(), n_init=1, max_iter=30,
+                      tol=0.0, algorithm="lloyd").fit(x)
+        # same init + Lloyd's ⇒ same final centers (order preserved)
+        np.testing.assert_allclose(km.centers_, sk.cluster_centers_, atol=1e-3)
+        np.testing.assert_allclose(km.inertia_, sk.inertia_, rtol=1e-4)
+
+    def test_predict_matches_assignment(self, rng):
+        x, _, _ = _blobs(rng, n=120)
+        a = ds.array(x)
+        km = KMeans(n_clusters=3, max_iter=20, random_state=1).fit(a)
+        labels = km.predict(a).collect().ravel().astype(int)
+        d = ((x[:, None, :] - km.centers_[None]) ** 2).sum(-1)
+        np.testing.assert_array_equal(labels, d.argmin(1))
+
+    def test_deterministic_with_seed(self, rng):
+        x, _, _ = _blobs(rng)
+        a = ds.array(x)
+        c1 = KMeans(n_clusters=3, random_state=5).fit(a).centers_
+        c2 = KMeans(n_clusters=3, random_state=5).fit(a).centers_
+        np.testing.assert_array_equal(c1, c2)
+
+    def test_score_is_negative_inertia(self, rng):
+        x, _, _ = _blobs(rng, n=90)
+        a = ds.array(x)
+        km = KMeans(n_clusters=3, max_iter=20, random_state=2).fit(a)
+        assert km.score(a) == pytest.approx(-km.inertia_, rel=1e-4)
+
+    def test_explicit_init_bad_shape(self, rng):
+        with pytest.raises(ValueError):
+            KMeans(n_clusters=3, init=np.zeros((2, 2))).fit(ds.array(rng.rand(10, 4)))
+
+    def test_irregular_rows(self, rng):
+        # row count not divisible by mesh: padded rows must not perturb centers
+        x, _, _ = _blobs(rng, n=231, d=3, k=3)
+        x = x[:231]
+        init = x[:3]
+        km = KMeans(n_clusters=3, init=init.copy(), max_iter=10, tol=0.0)
+        km.fit(ds.array(x))
+        from sklearn.cluster import KMeans as SkKMeans
+        sk = SkKMeans(n_clusters=3, init=init.copy(), n_init=1, max_iter=10,
+                      tol=0.0, algorithm="lloyd").fit(x)
+        np.testing.assert_allclose(km.centers_, sk.cluster_centers_, atol=1e-3)

@@ -1,0 +1,161 @@
+"""Blocked-math tests (reference: test_matmul/test_kron/test_svd/test_qr/
+test_tsqr/test_randomsvd/test_lanczos/test_pca — SURVEY.md §5 oracle pattern)."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+
+
+class TestMatmul:
+    @pytest.mark.parametrize("shapes", [((8, 8), (8, 8)), ((17, 5), (5, 9)),
+                                        ((1, 7), (7, 1)), ((33, 65), (65, 12))])
+    def test_matmul(self, rng, shapes):
+        (m, k), (_, n) = shapes
+        x, y = rng.rand(m, k), rng.rand(k, n)
+        got = ds.matmul(ds.array(x), ds.array(y)).collect()
+        np.testing.assert_allclose(got, x @ y, rtol=1e-4, atol=1e-5)
+
+    def test_transposes(self, rng):
+        x, y = rng.rand(12, 7), rng.rand(12, 9)
+        got = ds.matmul(ds.array(x), ds.array(y), transpose_a=True).collect()
+        np.testing.assert_allclose(got, x.T @ y, rtol=1e-4)
+        x, y = rng.rand(7, 12), rng.rand(9, 12)
+        got = ds.matmul(ds.array(x), ds.array(y), transpose_b=True).collect()
+        np.testing.assert_allclose(got, x @ y.T, rtol=1e-4)
+        got = ds.matmul(ds.array(x.T), ds.array(y), transpose_a=True,
+                        transpose_b=True).collect()
+        np.testing.assert_allclose(got, x @ y.T, rtol=1e-4)
+
+    def test_operator(self, rng):
+        x, y = rng.rand(6, 4), rng.rand(4, 5)
+        np.testing.assert_allclose((ds.array(x) @ ds.array(y)).collect(), x @ y,
+                                   rtol=1e-4)
+
+    def test_mismatch_raises(self, rng):
+        with pytest.raises(ValueError):
+            ds.matmul(ds.array(rng.rand(3, 4)), ds.array(rng.rand(3, 4)))
+
+
+class TestKron:
+    def test_kron(self, rng):
+        a, b = rng.rand(3, 4), rng.rand(5, 2)
+        np.testing.assert_allclose(ds.kron(ds.array(a), ds.array(b)).collect(),
+                                   np.kron(a, b), rtol=1e-5)
+
+
+class TestQR:
+    @pytest.mark.parametrize("shape", [(16, 16), (20, 8), (9, 9)])
+    def test_full(self, rng, shape):
+        x = rng.rand(*shape)
+        q, r = ds.qr(ds.array(x), mode="full")
+        qc, rc = q.collect(), r.collect()
+        assert qc.shape == (shape[0], shape[0])
+        np.testing.assert_allclose(qc @ rc, x, atol=1e-4)
+        np.testing.assert_allclose(qc.T @ qc, np.eye(shape[0]), atol=1e-4)
+        np.testing.assert_allclose(np.tril(rc[:, :shape[1]], -1), 0, atol=1e-5)
+
+    def test_economic(self, rng):
+        x = rng.rand(20, 6)
+        q, r = ds.qr(ds.array(x), mode="economic")
+        assert q.collect().shape == (20, 6)
+        assert r.collect().shape == (6, 6)
+        np.testing.assert_allclose(q.collect() @ r.collect(), x, atol=1e-4)
+
+    def test_r_mode(self, rng):
+        x = rng.rand(10, 4)
+        r = ds.qr(ds.array(x), mode="r").collect()
+        rn = np.linalg.qr(x, mode="r")
+        np.testing.assert_allclose(np.abs(r), np.abs(rn), atol=1e-4)
+
+    def test_bad_mode(self, rng):
+        with pytest.raises(ValueError):
+            ds.qr(ds.array(rng.rand(4, 4)), mode="zzz")
+
+
+class TestTSQR:
+    @pytest.mark.parametrize("shape", [(64, 8), (100, 13), (8, 8), (1000, 3)])
+    def test_reduced(self, rng, shape):
+        x = rng.rand(*shape)
+        q, r = ds.tsqr(ds.array(x))
+        qc, rc = q.collect(), r.collect()
+        assert qc.shape == shape and rc.shape == (shape[1], shape[1])
+        np.testing.assert_allclose(qc @ rc, x, atol=1e-4)
+        np.testing.assert_allclose(qc.T @ qc, np.eye(shape[1]), atol=1e-4)
+
+    def test_r_mode(self, rng):
+        x = rng.rand(64, 4)
+        r = ds.tsqr(ds.array(x), mode="r").collect()
+        # R unique up to row signs
+        rn = np.linalg.qr(x, mode="r")
+        np.testing.assert_allclose(np.abs(r), np.abs(rn), atol=1e-4)
+
+    def test_wide_raises(self, rng):
+        with pytest.raises(ValueError):
+            ds.tsqr(ds.array(rng.rand(4, 8)))
+
+
+class TestSVD:
+    @pytest.mark.parametrize("shape", [(16, 8), (30, 30), (50, 7)])
+    def test_svd(self, rng, shape):
+        x = rng.rand(*shape)
+        u, s, v = ds.svd(ds.array(x))
+        uc, sc, vc = u.collect(), s.collect().ravel(), v.collect()
+        sn = np.linalg.svd(x, compute_uv=False)
+        np.testing.assert_allclose(sc, sn, rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(uc * sc @ vc.T, x, atol=1e-3)
+        np.testing.assert_allclose(uc.T @ uc, np.eye(shape[1]), atol=1e-3)
+        np.testing.assert_allclose(vc.T @ vc, np.eye(shape[1]), atol=1e-3)
+
+    def test_values_only(self, rng):
+        x = rng.rand(12, 6)
+        s = ds.svd(ds.array(x), compute_uv=False).collect().ravel()
+        np.testing.assert_allclose(s, np.linalg.svd(x, compute_uv=False),
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestRandomSVD:
+    def test_low_rank_recovery(self, rng):
+        # rank-5 matrix: randomized SVD should nail the spectrum
+        a = rng.rand(60, 5) @ rng.rand(5, 40)
+        u, s, v = ds.random_svd(ds.array(a), nsv=5, random_state=0)
+        sn = np.linalg.svd(a, compute_uv=False)[:5]
+        np.testing.assert_allclose(s.collect().ravel(), sn, rtol=1e-3)
+        np.testing.assert_allclose((u.collect() * s.collect().ravel()) @ v.collect().T,
+                                   a, atol=1e-2)
+
+
+class TestLanczosSVD:
+    def test_spectrum(self, rng):
+        x = rng.rand(40, 20)
+        _, s, _ = ds.lanczos_svd(ds.array(x), k=4)
+        sn = np.linalg.svd(x, compute_uv=False)[:4]
+        np.testing.assert_allclose(s.collect().ravel(), sn, rtol=1e-2)
+
+
+class TestPCA:
+    def test_vs_sklearn(self, rng):
+        from sklearn.decomposition import PCA as SkPCA
+        x = rng.rand(100, 10).astype(np.float32)
+        p = ds.PCA(n_components=4).fit(ds.array(x))
+        sk = SkPCA(n_components=4).fit(x)
+        np.testing.assert_allclose(p.explained_variance_.collect().ravel(),
+                                   sk.explained_variance_, rtol=1e-3)
+        np.testing.assert_allclose(np.abs(p.components_.collect()),
+                                   np.abs(sk.components_), atol=1e-3)
+        np.testing.assert_allclose(p.mean_.collect().ravel(), sk.mean_, rtol=1e-4)
+
+    def test_transform_roundtrip(self, rng):
+        x = rng.rand(50, 8).astype(np.float32)
+        p = ds.PCA()  # all components
+        t = p.fit_transform(ds.array(x))
+        back = p.inverse_transform(t).collect()
+        np.testing.assert_allclose(back, x, atol=1e-3)
+
+    def test_svd_method(self, rng):
+        x = rng.rand(60, 6).astype(np.float32)
+        p = ds.PCA(n_components=3, method="svd").fit(ds.array(x))
+        from sklearn.decomposition import PCA as SkPCA
+        sk = SkPCA(n_components=3).fit(x)
+        np.testing.assert_allclose(p.explained_variance_.collect().ravel(),
+                                   sk.explained_variance_, rtol=1e-3)

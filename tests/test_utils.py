@@ -1,0 +1,104 @@
+"""shuffle / split / saving tests (reference: test_utils, test_saving*)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.base import clone
+from dislib_tpu.cluster import KMeans
+
+
+class TestShuffle:
+    def test_permutes_rows(self, rng):
+        x = rng.rand(40, 5)
+        y = np.arange(40.0).reshape(-1, 1)
+        xs, ys = ds.shuffle(ds.array(x), ds.array(y), random_state=0)
+        xc, yc = xs.collect(), ys.collect()
+        perm = yc.ravel().astype(int)
+        assert not np.array_equal(perm, np.arange(40))
+        assert sorted(perm) == list(range(40))
+        np.testing.assert_allclose(xc, x[perm].astype(np.float32))
+
+    def test_deterministic(self, rng):
+        x = ds.array(rng.rand(20, 3))
+        a = ds.shuffle(x, random_state=3).collect()
+        b = ds.shuffle(x, random_state=3).collect()
+        np.testing.assert_array_equal(a, b)
+
+    def test_mismatched_rows_raise(self, rng):
+        with pytest.raises(ValueError):
+            ds.shuffle(ds.array(rng.rand(5, 2)), ds.array(rng.rand(4, 1)))
+
+
+class TestTrainTestSplit:
+    def test_sizes_and_content(self, rng):
+        x = rng.rand(40, 3)
+        y = np.arange(40.0).reshape(-1, 1)
+        xtr, xte, ytr, yte = ds.train_test_split(ds.array(x), ds.array(y),
+                                                 test_size=0.25, random_state=0)
+        assert xtr.shape == (30, 3) and xte.shape == (10, 3)
+        all_idx = np.concatenate([ytr.collect().ravel(), yte.collect().ravel()])
+        assert sorted(all_idx.astype(int)) == list(range(40))
+
+
+class TestSaving:
+    @pytest.mark.parametrize("fmt,ext", [("json", "json"), ("npz", "npz")])
+    def test_roundtrip_kmeans(self, rng, tmp_path, fmt, ext):
+        x = rng.rand(60, 4).astype(np.float32)
+        a = ds.array(x)
+        km = KMeans(n_clusters=3, max_iter=10, random_state=0).fit(a)
+        path = os.path.join(tmp_path, f"model.{ext}")
+        ds.save_model(km, path, save_format=fmt)
+        km2 = ds.load_model(path)
+        assert isinstance(km2, KMeans)
+        assert km2.n_clusters == 3
+        np.testing.assert_allclose(km2.centers_, km.centers_)
+        assert km2.n_iter_ == km.n_iter_
+        np.testing.assert_array_equal(km2.predict(a).collect(),
+                                      km.predict(a).collect())
+
+    def test_no_overwrite(self, rng, tmp_path):
+        km = KMeans(n_clusters=2).fit(ds.array(rng.rand(10, 2)))
+        path = os.path.join(tmp_path, "m.json")
+        ds.save_model(km, path)
+        with pytest.raises(FileExistsError):
+            ds.save_model(km, path, overwrite=False)
+
+    def test_refuses_foreign_module(self, tmp_path):
+        import json
+        path = os.path.join(tmp_path, "evil.json")
+        with open(path, "w") as f:
+            json.dump({"__estimator__": {"module": "os", "cls": "system",
+                                         "params": {}, "fitted": {}}}, f)
+        with pytest.raises(ValueError):
+            ds.load_model(path)
+
+
+class TestBaseEstimator:
+    def test_get_set_params_clone(self):
+        km = KMeans(n_clusters=5, tol=1e-3)
+        p = km.get_params()
+        assert p["n_clusters"] == 5 and p["tol"] == 1e-3
+        km.set_params(n_clusters=7)
+        assert km.n_clusters == 7
+        with pytest.raises(ValueError):
+            km.set_params(bogus=1)
+        km2 = clone(km)
+        assert km2.n_clusters == 7 and not hasattr(km2, "centers_")
+
+
+class TestDataUtil:
+    def test_pad_helpers(self, rng):
+        from dislib_tpu.data import util as du
+        x = rng.rand(10, 7)
+        a = ds.array(x, block_size=(4, 4))
+        p = du.pad(a, ((1, 2), (0, 3)), value=5.0)
+        want = np.pad(x, ((1, 2), (0, 3)), constant_values=5.0)
+        np.testing.assert_allclose(p.collect(), want.astype(np.float32))
+        pz = du.pad_last_blocks_with_zeros(a)
+        assert pz.shape == (12, 8)
+        assert du.compute_bottom_right_shape(a) == (2, 3)
+        np.testing.assert_allclose(du.remove_last_rows(a, 3).collect(), x[:7].astype(np.float32))
+        np.testing.assert_allclose(du.remove_last_columns(a, 2).collect(), x[:, :5].astype(np.float32))
