@@ -23,6 +23,10 @@ from dislib_tpu.decomposition import tsqr, random_svd, lanczos_svd, PCA
 from dislib_tpu.utils.base import shuffle, train_test_split
 from dislib_tpu.utils.saving import save_model, load_model
 
+# subpackages (sklearn-style namespaces, reference parity)
+from dislib_tpu import cluster, classification, regression, neighbors, \
+    preprocessing, optimization, model_selection  # noqa: E402,F401
+
 __version__ = "0.1.0"
 
 __all__ = [

@@ -1,0 +1,3 @@
+from dislib_tpu.classification.knn import KNeighborsClassifier
+
+__all__ = ["KNeighborsClassifier"]

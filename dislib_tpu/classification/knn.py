@@ -1,0 +1,82 @@
+"""k-nearest-neighbors classifier (reference: `dislib/classification/knn` —
+vote over the k nearest, built on the NearestNeighbors machinery;
+SURVEY.md §3.3).
+
+TPU-native: neighbor search is the sharded distance GEMM + top_k of
+`dislib_tpu.neighbors`; the vote is a one-hot sum + argmax on device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.data.array import Array
+from dislib_tpu.neighbors.base import _kneighbors
+
+
+class KNeighborsClassifier(BaseEstimator):
+    """Majority-vote kNN classifier.
+
+    Attributes
+    ----------
+    classes_ : ndarray of unique labels.
+    """
+
+    def __init__(self, n_neighbors=5, weights="uniform"):
+        self.n_neighbors = n_neighbors
+        self.weights = weights
+
+    def fit(self, x: Array, y: Array):
+        if x.shape[0] != y.shape[0]:
+            raise ValueError("x and y row counts differ")
+        self._fit_x = x
+        yv = y.collect().ravel()
+        self.classes_ = np.unique(yv)
+        codes = np.searchsorted(self.classes_, yv).astype(np.int32)
+        self._codes = jnp.asarray(codes)
+        return self
+
+    def predict(self, x: Array) -> Array:
+        self._check_fitted()
+        if self.weights not in ("uniform", "distance"):
+            raise ValueError(f"bad weights {self.weights!r}")
+        if self.n_neighbors > self._fit_x.shape[0]:
+            raise ValueError(f"n_neighbors {self.n_neighbors} > fitted samples "
+                             f"{self._fit_x.shape[0]}")
+        labels = _knn_predict(x._data, self._fit_x._data, x.shape,
+                              self._fit_x.shape, self._codes,
+                              jnp.asarray(self.classes_, jnp.float32),
+                              self.n_neighbors, self.weights == "distance")
+        return Array._from_logical_padded(labels, (x.shape[0], 1))
+
+    def score(self, x: Array, y: Array) -> float:
+        pred = self.predict(x).collect().ravel()
+        return float((pred == y.collect().ravel()).mean())
+
+    def _check_fitted(self):
+        if not hasattr(self, "_fit_x"):
+            raise RuntimeError("KNeighborsClassifier is not fitted")
+
+
+@partial(jax.jit, static_argnames=("q_shape", "f_shape", "k", "use_dist"))
+def _knn_predict(qp, fp, q_shape, f_shape, codes, classes, k, use_dist):
+    dist_k, idx = _kneighbors(qp, fp, q_shape, f_shape, k)
+    neigh_codes = codes[idx]                                  # (mq_pad, k)
+    n_classes = classes.shape[0]
+    onehot = jax.nn.one_hot(neigh_codes, n_classes, dtype=jnp.float32)
+    if use_dist:
+        wts = 1.0 / jnp.maximum(dist_k, 1e-10)
+        votes = jnp.sum(onehot * wts[:, :, None], axis=1)
+    else:
+        votes = jnp.sum(onehot, axis=1)
+    winner = jnp.argmax(votes, axis=1)
+    labels = classes[winner]
+    mq = q_shape[0]
+    valid = lax.broadcasted_iota(jnp.int32, (labels.shape[0],), 0) < mq
+    return jnp.where(valid, labels, 0.0)[:, None]

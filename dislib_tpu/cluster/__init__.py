@@ -1,3 +1,4 @@
 from dislib_tpu.cluster.kmeans import KMeans
+from dislib_tpu.cluster.gm import GaussianMixture
 
-__all__ = ["KMeans"]
+__all__ = ["KMeans", "GaussianMixture"]

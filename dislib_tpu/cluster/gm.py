@@ -1,0 +1,273 @@
+"""Gaussian mixture via EM (reference: `dislib/cluster/gm` — per-block E-step
+responsibility tasks + M-step partial-sum tasks, Cholesky precisions per
+component, per-iteration host sync on log-likelihood; SURVEY.md §3.3,
+BASELINE config 5).
+
+TPU-native redesign, same shape as KMeans (§4.2 mapping): the whole EM loop
+is one jitted `lax.while_loop` on device.  The E-step's per-block
+log-prob/responsibility tasks become batched GEMMs over the row-sharded data
+(the Mahalanobis term is one (m, d) @ (d, d) matmul per component, vmapped);
+the M-step's arity-tree partial sums (weights / means / covariances) are the
+row-axis reductions XLA lowers to `psum` over ICI.  Convergence on the
+log-likelihood delta happens on device; the host syncs once per fit.
+
+All four covariance types of the reference are supported: full, tied, diag,
+spherical.  Padded (zero) rows carry weight 0 everywhere.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.data.array import Array
+from dislib_tpu.parallel import mesh as _mesh
+
+_LOG2PI = float(np.log(2.0 * np.pi))
+
+
+class GaussianMixture(BaseEstimator):
+    """Gaussian mixture model (reference parity: dislib.cluster.GaussianMixture).
+
+    Parameters
+    ----------
+    n_components : int, default 1
+    covariance_type : 'full' | 'tied' | 'diag' | 'spherical'
+    tol : float — convergence threshold on the lower-bound delta.
+    reg_covar : float — ridge added to covariance diagonals.
+    max_iter : int
+    init_params : 'kmeans' | 'random'
+    weights_init, means_init, precisions_init : optional explicit inits
+        (reference parity).
+    arity : int — accepted, ignored (reduction topology is XLA's).
+    random_state : int or None
+
+    Attributes
+    ----------
+    weights_, means_, covariances_ : ndarrays
+    converged_ : bool ;  n_iter_ : int ;  lower_bound_ : float
+    """
+
+    def __init__(self, n_components=1, covariance_type="full", tol=1e-3,
+                 reg_covar=1e-6, max_iter=100, init_params="kmeans",
+                 weights_init=None, means_init=None, precisions_init=None,
+                 arity=50, random_state=None):
+        self.n_components = n_components
+        self.covariance_type = covariance_type
+        self.tol = tol
+        self.reg_covar = reg_covar
+        self.max_iter = max_iter
+        self.init_params = init_params
+        self.weights_init = weights_init
+        self.means_init = means_init
+        self.precisions_init = precisions_init
+        self.arity = arity
+        self.random_state = random_state
+
+    # ------------------------------------------------------------------
+
+    def _init_resp(self, x: Array):
+        """Initial responsibilities (m_pad, k) — hard KMeans labels or random."""
+        m, n = x.shape
+        k = self.n_components
+        if self.init_params == "kmeans":
+            from dislib_tpu.cluster.kmeans import KMeans
+            km = KMeans(n_clusters=k, max_iter=10, tol=1e-4,
+                        random_state=self.random_state).fit(x)
+            labels = km.predict(x)._data[:, 0].astype(jnp.int32)
+            resp = jax.nn.one_hot(labels, k, dtype=jnp.float32)
+        elif self.init_params == "random":
+            seed = 0 if self.random_state is None else int(self.random_state)
+            resp = jax.random.uniform(jax.random.PRNGKey(seed),
+                                      (x._data.shape[0], k), dtype=jnp.float32)
+            resp = resp / jnp.sum(resp, axis=1, keepdims=True)
+        else:
+            raise ValueError(f"unsupported init_params {self.init_params!r}")
+        return resp
+
+    def fit(self, x: Array, y=None):
+        if self.covariance_type not in ("full", "tied", "diag", "spherical"):
+            raise ValueError(f"bad covariance_type {self.covariance_type!r}")
+        m, n = x.shape
+        k = self.n_components
+        resp0 = self._init_resp(x)
+        overrides = self._explicit_inits(n)
+        weights, means, covs, lb, n_iter, converged = _gm_fit(
+            x._data, x.shape, resp0, self.covariance_type,
+            float(self.reg_covar), float(self.tol), self.max_iter, overrides)
+        self.weights_ = np.asarray(jax.device_get(weights))
+        self.means_ = np.asarray(jax.device_get(means))
+        self.covariances_ = np.asarray(jax.device_get(covs))
+        self.lower_bound_ = float(lb)
+        self.n_iter_ = int(n_iter)
+        self.converged_ = bool(converged)
+        return self
+
+    def _explicit_inits(self, d):
+        """(weights, means, covs) overrides from the *_init params (reference
+        parity: weights_init / means_init / precisions_init)."""
+        w = None if self.weights_init is None else \
+            jnp.asarray(np.asarray(self.weights_init, np.float32))
+        mu = None if self.means_init is None else \
+            jnp.asarray(np.asarray(self.means_init, np.float32))
+        covs = None
+        if self.precisions_init is not None:
+            p = np.asarray(self.precisions_init, np.float64)
+            if self.covariance_type == "full":
+                covs = jnp.asarray(np.linalg.inv(p).astype(np.float32))
+            elif self.covariance_type == "tied":
+                covs = jnp.asarray(np.linalg.inv(p).astype(np.float32))
+            else:  # diag / spherical: precisions are 1/variances
+                covs = jnp.asarray((1.0 / p).astype(np.float32))
+        return (w, mu, covs)
+
+    def fit_predict(self, x: Array, y=None) -> Array:
+        return self.fit(x).predict(x)
+
+    def predict(self, x: Array) -> Array:
+        self._check_fitted()
+        labels = _gm_predict(x._data, x.shape, jnp.asarray(self.weights_),
+                             jnp.asarray(self.means_), jnp.asarray(self.covariances_),
+                             self.covariance_type, float(self.reg_covar))
+        return Array._from_logical_padded(labels, (x.shape[0], 1))
+
+    def _check_fitted(self):
+        if not hasattr(self, "means_"):
+            raise RuntimeError("GaussianMixture is not fitted")
+
+
+# ---------------------------------------------------------------------------
+# device kernels
+# ---------------------------------------------------------------------------
+
+def _chol_precisions(covs, cov_type, d):
+    """Cholesky factors of the precision matrices (sklearn-style)."""
+    if cov_type == "full":
+        chol = jnp.linalg.cholesky(covs)                      # (k, d, d)
+        prec = jax.vmap(lambda c: jax.scipy.linalg.solve_triangular(
+            c, jnp.eye(d, dtype=c.dtype), lower=True).T)(chol)
+        return prec                                           # (k, d, d) upper
+    if cov_type == "tied":
+        chol = jnp.linalg.cholesky(covs)                      # (d, d)
+        return jax.scipy.linalg.solve_triangular(
+            chol, jnp.eye(d, dtype=chol.dtype), lower=True).T
+    # diag (k, d) / spherical (k,)
+    return 1.0 / jnp.sqrt(covs)
+
+
+def _log_prob(xv, means, prec, cov_type, d):
+    """Weighted log N(x | mu_k, Sigma_k): (m, k)."""
+    if cov_type == "full":
+        def per_comp(mu, pc):
+            y = (xv - mu[None, :]) @ pc                       # (m, d) GEMM
+            return jnp.sum(y * y, axis=1), jnp.sum(jnp.log(jnp.diag(pc)))
+        maha, logdet = jax.vmap(per_comp)(means, prec)
+        return -0.5 * (d * _LOG2PI + maha.T) + logdet[None, :]
+    if cov_type == "tied":
+        y = xv @ prec                                         # (m, d)
+        mu_p = means @ prec                                   # (k, d)
+        maha = (jnp.sum(y * y, axis=1)[:, None] - 2.0 * y @ mu_p.T
+                + jnp.sum(mu_p * mu_p, axis=1)[None, :])
+        logdet = jnp.sum(jnp.log(jnp.diag(prec)))
+        return -0.5 * (d * _LOG2PI + maha) + logdet
+    if cov_type == "diag":
+        p2 = prec * prec                                      # (k, d)
+        maha = ((xv * xv) @ p2.T - 2.0 * xv @ (means * p2).T
+                + jnp.sum(means * means * p2, axis=1)[None, :])
+        logdet = jnp.sum(jnp.log(prec), axis=1)
+        return -0.5 * (d * _LOG2PI + maha) + logdet[None, :]
+    # spherical
+    p2 = prec * prec                                          # (k,)
+    sq = (jnp.sum(xv * xv, axis=1)[:, None] - 2.0 * xv @ means.T
+          + jnp.sum(means * means, axis=1)[None, :])
+    maha = sq * p2[None, :]
+    logdet = d * jnp.log(prec)
+    return -0.5 * (d * _LOG2PI + maha) + logdet[None, :]
+
+
+def _estimate_covs(xv, resp, nk, means, cov_type, reg_covar, w):
+    """M-step covariance update; resp already includes the row mask."""
+    d = xv.shape[1]
+    if cov_type == "full":
+        def per_comp(r_k, mu, n_k):
+            diff = xv - mu[None, :]
+            cov = (diff * r_k[:, None]).T @ diff / n_k
+            return cov + reg_covar * jnp.eye(d, dtype=xv.dtype)
+        return jax.vmap(per_comp)(resp.T, means, nk)
+    if cov_type == "tied":
+        # Σ_total = XᵀWX - Σ_k n_k μ_k μ_kᵀ, averaged
+        xw = xv * w[:, None]
+        avg_x2 = xw.T @ xv
+        avg_mu2 = (means * nk[:, None]).T @ means
+        cov = (avg_x2 - avg_mu2) / jnp.sum(nk)
+        return cov + reg_covar * jnp.eye(d, dtype=xv.dtype)
+    if cov_type == "diag":
+        avg_x2 = resp.T @ (xv * xv) / nk[:, None]
+        cov = avg_x2 - means * means
+        return cov + reg_covar
+    # spherical: mean of diag variances
+    avg_x2 = resp.T @ (xv * xv) / nk[:, None]
+    var = jnp.mean(avg_x2 - means * means, axis=1)
+    return var + reg_covar
+
+
+@partial(jax.jit, static_argnames=("shape", "cov_type", "max_iter"))
+def _gm_fit(xp, shape, resp0, cov_type, reg_covar, tol, max_iter, overrides=(None, None, None)):
+    m, n = shape
+    xv = xp[:, :n]
+    xv = lax.with_sharding_constraint(xv, _mesh.row_sharding())
+    w = (lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m).astype(xv.dtype)
+
+    def m_step(resp):
+        resp = resp * w[:, None]
+        nk = jnp.sum(resp, axis=0) + 1e-10                    # psum over rows
+        means = resp.T @ xv / nk[:, None]                     # GEMM + psum
+        covs = _estimate_covs(xv, resp, nk, means, cov_type, reg_covar, w)
+        weights = nk / m
+        return weights, means, covs
+
+    weights0, means0, covs0 = m_step(resp0)
+    w_o, mu_o, c_o = overrides
+    weights0 = weights0 if w_o is None else w_o
+    means0 = means0 if mu_o is None else mu_o
+    covs0 = covs0 if c_o is None else c_o
+
+    def e_step(weights, means, covs):
+        prec = _chol_precisions(covs, cov_type, n)
+        logp = _log_prob(xv, means, prec, cov_type, n) + jnp.log(weights)[None, :]
+        lse = jax.scipy.special.logsumexp(logp, axis=1)
+        resp = jnp.exp(logp - lse[:, None])
+        ll = jnp.sum(lse * w) / m                             # mean log-likelihood
+        return resp, ll
+
+    def step(carry):
+        weights, means, covs, prev_lb, _, it = carry
+        resp, lb = e_step(weights, means, covs)
+        weights, means, covs = m_step(resp)
+        conv = jnp.abs(lb - prev_lb) < tol
+        return weights, means, covs, lb, conv, it + 1
+
+    def cond(carry):
+        _, _, _, lb, conv, it = carry
+        return (~conv) & (it < max_iter)
+
+    init = (weights0, means0, covs0, jnp.asarray(-jnp.inf, xv.dtype),
+            jnp.asarray(False), jnp.int32(0))
+    weights, means, covs, lb, conv, n_iter = lax.while_loop(cond, step, init)
+    return weights, means, covs, lb, n_iter, conv
+
+
+@partial(jax.jit, static_argnames=("shape", "cov_type"))
+def _gm_predict(xp, shape, weights, means, covs, cov_type, reg_covar):
+    m, n = shape
+    xv = xp[:, :n]
+    prec = _chol_precisions(covs, cov_type, n)
+    logp = _log_prob(xv, means, prec, cov_type, n) + jnp.log(weights)[None, :]
+    labels = jnp.argmax(logp, axis=1).astype(jnp.float32)
+    valid = lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m
+    return jnp.where(valid, labels, 0.0)[:, None]

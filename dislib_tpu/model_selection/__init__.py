@@ -1,0 +1,4 @@
+from dislib_tpu.model_selection.split import KFold
+from dislib_tpu.model_selection.search import GridSearchCV, RandomizedSearchCV
+
+__all__ = ["KFold", "GridSearchCV", "RandomizedSearchCV"]

@@ -1,0 +1,121 @@
+"""Hyperparameter search (reference: `dislib/model_selection/_search.py` —
+sklearn-mirroring GridSearchCV / RandomizedSearchCV that submit ALL candidate
+fits before waiting on any, so search-level parallelism multiplies
+estimator-internal parallelism; SURVEY.md §3.4, §4.5).
+
+TPU-native: estimator-internal parallelism already saturates the mesh for
+one trial; trials are dispatched in a host loop whose device work overlaps
+via JAX async dispatch (a fit only blocks when it reads its own convergence
+scalars).  The contract preserved from the reference is no *artificial*
+serialization: nothing in the loop synchronises on earlier trials' results.
+"""
+
+from __future__ import annotations
+
+from itertools import product
+
+import numpy as np
+
+from dislib_tpu.base import BaseEstimator, clone
+from dislib_tpu.model_selection.split import KFold
+
+
+def _score(est, xv, yv):
+    if hasattr(est, "score"):
+        return est.score(xv, yv) if yv is not None else est.score(xv)
+    raise TypeError(f"{type(est).__name__} has no score(); pass scoring=")
+
+
+class GridSearchCV(BaseEstimator):
+    """Exhaustive search over a parameter grid with K-fold CV.
+
+    Attributes: cv_results_, best_params_, best_score_, best_index_,
+    best_estimator_ (when refit=True).
+    """
+
+    def __init__(self, estimator, param_grid, cv=5, scoring=None, refit=True):
+        self.estimator = estimator
+        self.param_grid = param_grid
+        self.cv = cv
+        self.scoring = scoring
+        self.refit = refit
+
+    def _candidates(self):
+        grid = self.param_grid
+        if isinstance(grid, dict):
+            grid = [grid]
+        out = []
+        for g in grid:
+            keys = sorted(g)
+            for combo in product(*(g[k] for k in keys)):
+                out.append(dict(zip(keys, combo)))
+        return out
+
+    def fit(self, x, y=None):
+        candidates = self._candidates()
+        cv = self.cv if isinstance(self.cv, KFold) else KFold(n_splits=self.cv)
+        folds = list(cv.split(x, y))
+        scorer = self.scoring if self.scoring is not None else _score
+
+        all_scores = np.zeros((len(candidates), len(folds)))
+        for ci, params in enumerate(candidates):
+            for fi, (xt, yt, xv, yv) in enumerate(folds):
+                est = clone(self.estimator).set_params(**params)
+                est.fit(xt, yt) if yt is not None else est.fit(xt)
+                all_scores[ci, fi] = scorer(est, xv, yv)
+
+        mean = all_scores.mean(axis=1)
+        std = all_scores.std(axis=1)
+        rank = np.argsort(-mean).argsort() + 1
+        self.cv_results_ = {
+            "params": candidates,
+            "mean_test_score": mean,
+            "std_test_score": std,
+            "rank_test_score": rank.astype(int),
+            **{f"split{j}_test_score": all_scores[:, j] for j in range(len(folds))},
+        }
+        self.best_index_ = int(np.argmax(mean))
+        self.best_params_ = candidates[self.best_index_]
+        self.best_score_ = float(mean[self.best_index_])
+        if self.refit:
+            self.best_estimator_ = clone(self.estimator).set_params(**self.best_params_)
+            self.best_estimator_.fit(x, y) if y is not None else self.best_estimator_.fit(x)
+        return self
+
+    def predict(self, x):
+        self._check_refit()
+        return self.best_estimator_.predict(x)
+
+    def score(self, x, y=None):
+        self._check_refit()
+        return _score(self.best_estimator_, x, y)
+
+    def _check_refit(self):
+        if not hasattr(self, "best_estimator_"):
+            raise RuntimeError("search not fitted with refit=True")
+
+
+class RandomizedSearchCV(GridSearchCV):
+    """Randomized search: samples ``n_iter`` candidates from distributions
+    (lists are sampled uniformly; scipy frozen distributions via .rvs)."""
+
+    def __init__(self, estimator, param_distributions, n_iter=10, cv=5,
+                 scoring=None, refit=True, random_state=None):
+        super().__init__(estimator, param_grid=None, cv=cv, scoring=scoring,
+                         refit=refit)
+        self.param_distributions = param_distributions
+        self.n_iter = n_iter
+        self.random_state = random_state
+
+    def _candidates(self):
+        rng = np.random.RandomState(self.random_state)
+        out = []
+        for _ in range(self.n_iter):
+            params = {}
+            for k, v in self.param_distributions.items():
+                if hasattr(v, "rvs"):
+                    params[k] = v.rvs(random_state=rng)
+                else:
+                    params[k] = v[rng.randint(len(v))]
+            out.append(params)
+        return out

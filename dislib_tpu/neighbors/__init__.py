@@ -1,0 +1,3 @@
+from dislib_tpu.neighbors.base import NearestNeighbors
+
+__all__ = ["NearestNeighbors"]

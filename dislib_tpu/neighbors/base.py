@@ -1,0 +1,78 @@
+"""Nearest neighbors (reference: `dislib/neighbors` — per (query-block ×
+fitted-block) local kNN tasks, pairwise merge keeping the global k-best;
+SURVEY.md §3.3 "all-pairs block product then min-merge").
+
+TPU-native: the all-pairs block product is one distance GEMM on the sharded
+operands (‖q‖² − 2qᵀx + ‖x‖²) and the k-best merge is a single `lax.top_k`
+— the reference's merge tree exists because no worker sees all distances;
+on a mesh the row-axis reduction is XLA's problem.  Padded fit rows are
+masked to +inf so they can never be neighbors.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.data.array import Array
+
+
+class NearestNeighbors(BaseEstimator):
+    """Exact brute-force kNN index over a ds-array."""
+
+    def __init__(self, n_neighbors=5):
+        self.n_neighbors = n_neighbors
+
+    def fit(self, x: Array, y=None):
+        self._fit_data = x
+        return self
+
+    def kneighbors(self, x: Array, n_neighbors=None, return_distance=True):
+        """Distances/indices of the k nearest fitted rows for each query row.
+
+        Returns (distances (mq, k) Array, indices (mq, k) int32 Array) — the
+        ds-array being the library's single container (reference returns
+        ds-arrays too)."""
+        if not hasattr(self, "_fit_data"):
+            raise RuntimeError("NearestNeighbors is not fitted")
+        k = n_neighbors or self.n_neighbors
+        f = self._fit_data
+        if k > f.shape[0]:
+            raise ValueError(f"n_neighbors {k} > fitted samples {f.shape[0]}")
+        d, idx = _kneighbors(x._data, f._data, x.shape, f.shape, k)
+        d_arr = Array._from_logical_padded(_repad2(d, (x.shape[0], k)), (x.shape[0], k))
+        # indices stay int32 (exact for any realistic row count; float32 would
+        # corrupt indices past 2^24)
+        i_arr = Array._from_logical_padded(_repad2(idx, (x.shape[0], k)), (x.shape[0], k))
+        if return_distance:
+            return d_arr, i_arr
+        return i_arr
+
+
+def _repad2(data, shape):
+    from dislib_tpu.data.array import _repad
+    return _repad(data, shape)
+
+
+@partial(jax.jit, static_argnames=("q_shape", "f_shape", "k"))
+def _kneighbors(qp, fp, q_shape, f_shape, k):
+    mq, d = q_shape
+    mf = f_shape[0]
+    qv = qp[:, :d]
+    fv = fp[:, :d]
+    q_sq = jnp.sum(qv * qv, axis=1, keepdims=True)
+    f_sq = jnp.sum(fv * fv, axis=1)
+    dist = q_sq - 2.0 * (qv @ fv.T) + f_sq[None, :]           # (mq_pad, mf_pad)
+    invalid = lax.broadcasted_iota(jnp.int32, (1, fv.shape[0]), 1) >= mf
+    dist = jnp.where(invalid, jnp.inf, dist)
+    neg, idx = lax.top_k(-dist, k)
+    dist_k = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    valid_q = lax.broadcasted_iota(jnp.int32, (qv.shape[0], 1), 0) < mq
+    dist_k = jnp.where(valid_q, dist_k, 0.0)
+    idx = jnp.where(valid_q, idx, 0)
+    return dist_k, idx.astype(jnp.int32)

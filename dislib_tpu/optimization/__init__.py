@@ -1,0 +1,3 @@
+from dislib_tpu.optimization.admm import ADMM, soft_threshold
+
+__all__ = ["ADMM", "soft_threshold"]

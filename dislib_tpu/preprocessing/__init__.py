@@ -1,0 +1,3 @@
+from dislib_tpu.preprocessing.scalers import StandardScaler, MinMaxScaler
+
+__all__ = ["StandardScaler", "MinMaxScaler"]

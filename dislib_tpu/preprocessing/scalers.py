@@ -1,0 +1,109 @@
+"""Feature scalers (reference: `dislib/preprocessing` — blocked mean/var or
+min/max partial sums in fit, per-block affine transform tasks in transform /
+inverse_transform; SURVEY.md §3.3).
+
+TPU-native: fit statistics are the Array reductions (one psum over the row
+axis); transform is a broadcasted elementwise op on the sharded data — no
+communication at all.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.data.array import Array
+
+
+class StandardScaler(BaseEstimator):
+    """Standardise features to zero mean / unit variance.
+
+    Attributes: mean_ (Array 1×n), var_ (Array 1×n).
+    """
+
+    def __init__(self, with_mean=True, with_std=True):
+        self.with_mean = with_mean
+        self.with_std = with_std
+
+    def fit(self, x: Array, y=None):
+        m = x.shape[0]
+        mean = x.mean(axis=0)
+        # two-pass variance: mean((x-μ)²), biased (ddof=0) like the reference.
+        # (the one-pass E[x²]−μ² form cancels catastrophically in float32 when
+        # |μ| ≫ σ)
+        xc = x - mean
+        self.mean_ = mean
+        self.var_ = (xc * xc).sum(axis=0) * (1.0 / m)
+        return self
+
+    def fit_transform(self, x: Array, y=None) -> Array:
+        return self.fit(x).transform(x)
+
+    def transform(self, x: Array) -> Array:
+        self._check_fitted()
+        out = x
+        if self.with_mean:
+            out = out - self.mean_
+        if self.with_std:
+            out = out / _safe_sqrt(self.var_)
+        return out
+
+    def inverse_transform(self, x: Array) -> Array:
+        self._check_fitted()
+        out = x
+        if self.with_std:
+            out = out * _safe_sqrt(self.var_)
+        if self.with_mean:
+            out = out + self.mean_
+        return out
+
+    def _check_fitted(self):
+        if not hasattr(self, "mean_"):
+            raise RuntimeError("StandardScaler is not fitted")
+
+
+class MinMaxScaler(BaseEstimator):
+    """Scale features to a [lo, hi] range (reference parity: feature_range)."""
+
+    def __init__(self, feature_range=(0, 1)):
+        self.feature_range = feature_range
+
+    def fit(self, x: Array, y=None):
+        self.data_min_ = x.min(axis=0)
+        self.data_max_ = x.max(axis=0)
+        return self
+
+    def fit_transform(self, x: Array, y=None) -> Array:
+        return self.fit(x).transform(x)
+
+    def transform(self, x: Array) -> Array:
+        self._check_fitted()
+        lo, hi = self.feature_range
+        rng = self.data_max_ - self.data_min_
+        scaled = (x - self.data_min_) / _nonzero(rng)
+        return scaled * (hi - lo) + float(lo)
+
+    def inverse_transform(self, x: Array) -> Array:
+        self._check_fitted()
+        lo, hi = self.feature_range
+        rng = self.data_max_ - self.data_min_
+        return (x - float(lo)) / (hi - lo) * _nonzero(rng) + self.data_min_
+
+    def _check_fitted(self):
+        if not hasattr(self, "data_min_"):
+            raise RuntimeError("MinMaxScaler is not fitted")
+
+
+def _safe_sqrt(v: Array) -> Array:
+    import jax.numpy as jnp
+    from dislib_tpu.data.array import _zero_pad
+    d = jnp.sqrt(jnp.maximum(v._data, 0.0))
+    d = jnp.where(d == 0.0, 1.0, d)
+    return Array(_zero_pad(d, v._shape), v._shape, v._reg_shape)
+
+
+def _nonzero(v: Array) -> Array:
+    import jax.numpy as jnp
+    from dislib_tpu.data.array import _zero_pad
+    d = jnp.where(v._data == 0.0, 1.0, v._data)
+    return Array(_zero_pad(d, v._shape), v._shape, v._reg_shape)

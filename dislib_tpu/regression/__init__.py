@@ -1,0 +1,4 @@
+from dislib_tpu.regression.linear import LinearRegression
+from dislib_tpu.regression.lasso import Lasso
+
+__all__ = ["LinearRegression", "Lasso"]

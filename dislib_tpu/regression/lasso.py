@@ -1,0 +1,63 @@
+"""Lasso via consensus ADMM (reference: `dislib/regression/lasso` —
+`Lasso(lmbd, rho, max_iter, atol, rtol)`: distributed per-block ridge solves,
+global soft-threshold z-update, dual updates; SURVEY.md §3.3).
+
+TPU-native: delegates to :class:`dislib_tpu.optimization.ADMM` with the L1
+soft-threshold prox; the whole iteration loop runs on device (see admm.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.data.array import Array
+from dislib_tpu.optimization.admm import ADMM, soft_threshold
+
+
+class Lasso(BaseEstimator):
+    """L1-regularised least squares:  (1/2)‖Xw − y‖² + λ‖w‖₁.
+
+    Attributes
+    ----------
+    coef_ : ndarray (n_features,)
+    n_iter_ : int ;  converged_ : bool
+    """
+
+    def __init__(self, lmbd=1.0, rho=1.0, max_iter=100, atol=1e-4, rtol=1e-2):
+        self.lmbd = lmbd
+        self.rho = rho
+        self.max_iter = max_iter
+        self.atol = atol
+        self.rtol = rtol
+
+    def fit(self, x: Array, y: Array):
+        from dislib_tpu.parallel import mesh as _mesh
+        # global objective carries λ once; each of the p agents contributes ρ
+        p = _mesh.mesh_shape()[0]
+        kappa = float(self.lmbd) / (float(self.rho) * p)
+        admm = ADMM(z_prox=soft_threshold, prox_kappa=kappa, rho=self.rho,
+                    max_iter=self.max_iter, abstol=self.atol, reltol=self.rtol)
+        admm.fit(x, y)
+        self.coef_ = admm.z_
+        self.n_iter_ = admm.n_iter_
+        self.converged_ = admm.converged_
+        return self
+
+    def predict(self, x: Array) -> Array:
+        self._check_fitted()
+        from dislib_tpu.math import matmul
+        w = Array._from_logical(np.asarray(self.coef_, np.float32).reshape(-1, 1))
+        return matmul(x, w)
+
+    def score(self, x: Array, y: Array) -> float:
+        """R² (sklearn convention)."""
+        pred = self.predict(x).collect()
+        yv = y.collect()
+        u = ((yv - pred) ** 2).sum()
+        v = ((yv - yv.mean(0)) ** 2).sum()
+        return float(1.0 - u / v)
+
+    def _check_fitted(self):
+        if not hasattr(self, "coef_"):
+            raise RuntimeError("Lasso is not fitted")

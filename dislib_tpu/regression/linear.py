@@ -1,0 +1,94 @@
+"""Linear regression via normal equations (reference:
+`dislib/regression/linear` — blocked partial sums of XᵀX and Xᵀy, solve the
+small system on master; SURVEY.md §3.3).
+
+TPU-native: XᵀX and Xᵀy are sharded GEMMs whose row-axis reductions lower to
+psum; the (n+1)×(n+1) solve runs replicated on device.  Supports
+multi-output y (reference parity).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.data.array import Array
+from dislib_tpu.parallel import mesh as _mesh
+
+
+class LinearRegression(BaseEstimator):
+    """Ordinary least squares.
+
+    Attributes
+    ----------
+    coef_ : ndarray (n_features, n_targets)
+    intercept_ : ndarray (n_targets,)
+    """
+
+    def __init__(self, fit_intercept=True, arity=50):
+        self.fit_intercept = fit_intercept
+        self.arity = arity  # reference parity; ignored
+
+    def fit(self, x: Array, y: Array):
+        if x.shape[0] != y.shape[0]:
+            raise ValueError("x and y row counts differ")
+        coef, intercept = _linreg_fit(x._data, y._data, x.shape, y.shape,
+                                      self.fit_intercept)
+        self.coef_ = np.asarray(jax.device_get(coef))
+        self.intercept_ = np.asarray(jax.device_get(intercept))
+        return self
+
+    def predict(self, x: Array) -> Array:
+        self._check_fitted()
+        out = _linreg_predict(x._data, x.shape, jnp.asarray(self.coef_),
+                              jnp.asarray(self.intercept_))
+        return Array._from_logical_padded(out, (x.shape[0], self.coef_.shape[1]))
+
+    def score(self, x: Array, y: Array) -> float:
+        """R² score (sklearn convention)."""
+        self._check_fitted()
+        pred = self.predict(x).collect()
+        yv = y.collect()
+        u = ((yv - pred) ** 2).sum()
+        v = ((yv - yv.mean(0)) ** 2).sum()
+        return float(1.0 - u / v)
+
+    def _check_fitted(self):
+        if not hasattr(self, "coef_"):
+            raise RuntimeError("LinearRegression is not fitted")
+
+
+@partial(jax.jit, static_argnames=("x_shape", "y_shape", "fit_intercept"))
+def _linreg_fit(xp, yp, x_shape, y_shape, fit_intercept):
+    m, n = x_shape
+    t = y_shape[1]
+    xv = xp[:, :n]
+    yv = yp[:, :t]
+    xv = lax.with_sharding_constraint(xv, _mesh.row_sharding())
+    if fit_intercept:
+        # padded rows are zero: augmenting with a masked ones-column keeps them inert
+        valid = (lax.broadcasted_iota(jnp.int32, (xv.shape[0], 1), 0) < m).astype(xv.dtype)
+        xa = jnp.concatenate([xv, valid], axis=1)
+    else:
+        xa = xv
+    xtx = xa.T @ xa                                   # (n+1, n+1) psum over rows
+    xty = xa.T @ yv                                   # (n+1, t)
+    # small ridge for numerical safety on rank-deficient inputs
+    sol = jnp.linalg.solve(xtx + 1e-7 * jnp.eye(xa.shape[1], dtype=xv.dtype), xty)
+    if fit_intercept:
+        return sol[:-1], sol[-1]
+    return sol, jnp.zeros((t,), xv.dtype)
+
+
+@partial(jax.jit, static_argnames=("shape",))
+def _linreg_predict(xp, shape, coef, intercept):
+    m, n = shape
+    xv = xp[:, :n]
+    out = xv @ coef + intercept[None, :]
+    valid = lax.broadcasted_iota(jnp.int32, (xv.shape[0], 1), 0) < m
+    return jnp.where(valid, out, 0.0)

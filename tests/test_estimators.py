@@ -1,0 +1,233 @@
+"""Dense-estimator sweep tests (reference: test_gm, test_preprocessing,
+test_linear_regression, test_lasso, test_admm, test_knn,
+test_nearest_neighbors — SURVEY.md §5 oracle pattern vs sklearn/NumPy)."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import GaussianMixture
+from dislib_tpu.preprocessing import StandardScaler, MinMaxScaler
+from dislib_tpu.regression import LinearRegression, Lasso
+from dislib_tpu.neighbors import NearestNeighbors
+from dislib_tpu.classification import KNeighborsClassifier
+
+
+def _blobs(rng, n=300, d=4, k=3, spread=0.2):
+    centers = rng.rand(k, d) * 10
+    x = np.vstack([centers[i] + spread * rng.randn(n // k, d) for i in range(k)])
+    labels = np.repeat(np.arange(k), n // k)
+    return x.astype(np.float32), labels
+
+
+class TestGaussianMixture:
+    @pytest.mark.parametrize("cov_type", ["full", "tied", "diag", "spherical"])
+    def test_recovers_blobs(self, rng, cov_type):
+        x, true_labels = _blobs(rng, n=300, d=3, k=3)
+        gm = GaussianMixture(n_components=3, covariance_type=cov_type,
+                             max_iter=100, random_state=0)
+        labels = gm.fit_predict(ds.array(x)).collect().ravel().astype(int)
+        for c in range(3):
+            assert len(np.unique(labels[true_labels == c])) == 1, cov_type
+        assert gm.converged_
+        assert np.isclose(gm.weights_.sum(), 1.0, atol=1e-5)
+
+    def test_vs_sklearn_loglik(self, rng):
+        from sklearn.mixture import GaussianMixture as SkGM
+        x, _ = _blobs(rng, n=240, d=4, k=2)
+        gm = GaussianMixture(n_components=2, max_iter=200, tol=1e-6,
+                             random_state=0).fit(ds.array(x))
+        sk = SkGM(n_components=2, max_iter=200, tol=1e-6, n_init=1,
+                  random_state=0).fit(x)
+        # both should reach (nearly) the same optimum on well-separated blobs
+        assert gm.lower_bound_ == pytest.approx(sk.lower_bound_, rel=1e-3)
+
+    def test_explicit_means_init(self, rng):
+        x, _ = _blobs(rng, n=120, d=3, k=2)
+        means0 = x[[0, 60]]
+        gm = GaussianMixture(n_components=2, means_init=means0, max_iter=50,
+                             random_state=0).fit(ds.array(x))
+        assert gm.converged_
+
+    def test_bad_cov_type(self, rng):
+        with pytest.raises(ValueError):
+            GaussianMixture(covariance_type="nope").fit(ds.array(rng.rand(10, 2)))
+
+
+class TestScalers:
+    def test_standard_scaler_vs_sklearn(self, rng):
+        from sklearn.preprocessing import StandardScaler as SkSS
+        x = rng.rand(50, 7).astype(np.float32) * 5
+        a = ds.array(x, block_size=(9, 3))
+        got = StandardScaler().fit_transform(a).collect()
+        want = SkSS().fit_transform(x)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+    def test_standard_scaler_roundtrip(self, rng):
+        x = rng.rand(30, 4).astype(np.float32)
+        sc = StandardScaler()
+        t = sc.fit_transform(ds.array(x))
+        np.testing.assert_allclose(sc.inverse_transform(t).collect(), x,
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_minmax_scaler(self, rng):
+        from sklearn.preprocessing import MinMaxScaler as SkMM
+        x = rng.randn(40, 5).astype(np.float32)
+        got = MinMaxScaler().fit_transform(ds.array(x)).collect()
+        want = SkMM().fit_transform(x)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_minmax_range(self, rng):
+        x = rng.randn(40, 5).astype(np.float32)
+        sc = MinMaxScaler(feature_range=(-1, 1))
+        t = sc.fit_transform(ds.array(x)).collect()
+        assert t.min() >= -1 - 1e-5 and t.max() <= 1 + 1e-5
+        np.testing.assert_allclose(sc.inverse_transform(
+            sc.transform(ds.array(x))).collect(), x, rtol=1e-3, atol=1e-4)
+
+
+class TestLinearRegression:
+    def test_vs_numpy_lstsq(self, rng):
+        x = rng.rand(80, 6).astype(np.float32)
+        w = rng.randn(6, 1).astype(np.float32)
+        y = x @ w + 0.5 + 0.01 * rng.randn(80, 1).astype(np.float32)
+        lr = LinearRegression().fit(ds.array(x), ds.array(y))
+        xa = np.hstack([x, np.ones((80, 1), np.float32)])
+        sol = np.linalg.lstsq(xa, y, rcond=None)[0]
+        np.testing.assert_allclose(lr.coef_, sol[:-1], atol=1e-3)
+        np.testing.assert_allclose(lr.intercept_, sol[-1], atol=1e-3)
+        assert lr.score(ds.array(x), ds.array(y)) > 0.99
+
+    def test_no_intercept(self, rng):
+        x = rng.rand(50, 3).astype(np.float32)
+        y = (x @ np.array([[1.0], [2.0], [3.0]], np.float32))
+        lr = LinearRegression(fit_intercept=False).fit(ds.array(x), ds.array(y))
+        np.testing.assert_allclose(lr.coef_.ravel(), [1, 2, 3], atol=1e-3)
+        np.testing.assert_allclose(lr.intercept_, [0.0])
+
+    def test_multioutput(self, rng):
+        x = rng.rand(60, 4).astype(np.float32)
+        w = rng.randn(4, 3).astype(np.float32)
+        y = x @ w
+        lr = LinearRegression(fit_intercept=False).fit(ds.array(x), ds.array(y))
+        np.testing.assert_allclose(lr.coef_, w, atol=1e-3)
+        pred = lr.predict(ds.array(x)).collect()
+        np.testing.assert_allclose(pred, y, atol=1e-3)
+
+
+class TestLasso:
+    def test_sparse_recovery(self, rng):
+        # y depends on 3 of 20 features; lasso should zero most others
+        n, d = 200, 20
+        x = rng.randn(n, d).astype(np.float32)
+        w = np.zeros((d, 1), np.float32)
+        w[[2, 7, 15]] = [[2.0], [-3.0], [1.5]]
+        y = x @ w + 0.01 * rng.randn(n, 1).astype(np.float32)
+        las = Lasso(lmbd=5.0, rho=1.0, max_iter=300, atol=1e-5, rtol=1e-4)
+        las.fit(ds.array(x), ds.array(y))
+        coef = las.coef_
+        assert abs(coef[2] - 2.0) < 0.3
+        assert abs(coef[7] + 3.0) < 0.3
+        assert abs(coef[15] - 1.5) < 0.3
+        others = np.delete(coef, [2, 7, 15])
+        assert np.abs(others).max() < 0.15
+        assert las.score(ds.array(x), ds.array(y)) > 0.95
+
+    def test_vs_sklearn(self, rng):
+        from sklearn.linear_model import Lasso as SkLasso
+        n, d = 160, 8
+        x = rng.randn(n, d).astype(np.float32)
+        y = (x[:, :2] @ np.array([3.0, -2.0], np.float32)).reshape(-1, 1)
+        alpha = 0.1
+        las = Lasso(lmbd=alpha * n, rho=1.0, max_iter=500, atol=1e-6, rtol=1e-5)
+        las.fit(ds.array(x), ds.array(y))
+        sk = SkLasso(alpha=alpha).fit(x, y.ravel())
+        np.testing.assert_allclose(las.coef_, sk.coef_, atol=0.05)
+
+
+class TestADMM:
+    def test_identity_prox_is_least_squares(self, rng):
+        from dislib_tpu.optimization import ADMM
+        x = rng.randn(64, 5).astype(np.float32)
+        w = rng.randn(5).astype(np.float32)
+        y = (x @ w).reshape(-1, 1)
+        admm = ADMM(rho=1.0, max_iter=200, abstol=1e-6, reltol=1e-5)
+        admm.fit(ds.array(x), ds.array(y))
+        np.testing.assert_allclose(admm.z_, w, atol=1e-2)
+        assert admm.converged_
+
+
+class TestNeighbors:
+    def test_vs_sklearn(self, rng):
+        from sklearn.neighbors import NearestNeighbors as SkNN
+        x = rng.rand(90, 5).astype(np.float32)
+        q = rng.rand(17, 5).astype(np.float32)
+        nn = NearestNeighbors(n_neighbors=4).fit(ds.array(x))
+        d, i = nn.kneighbors(ds.array(q))
+        sk = SkNN(n_neighbors=4, algorithm="brute").fit(x)
+        sd, si = sk.kneighbors(q)
+        np.testing.assert_allclose(d.collect(), sd, rtol=1e-3, atol=1e-4)
+        np.testing.assert_array_equal(i.collect().astype(int), si)
+
+    def test_self_query(self, rng):
+        x = rng.rand(40, 3).astype(np.float32)
+        nn = NearestNeighbors(n_neighbors=1).fit(ds.array(x))
+        d, i = nn.kneighbors(ds.array(x))
+        np.testing.assert_array_equal(i.collect().ravel().astype(int), np.arange(40))
+        np.testing.assert_allclose(d.collect().ravel(), 0, atol=1e-3)
+
+    def test_k_too_large(self, rng):
+        nn = NearestNeighbors(n_neighbors=99).fit(ds.array(rng.rand(5, 2)))
+        with pytest.raises(ValueError):
+            nn.kneighbors(ds.array(rng.rand(3, 2)))
+
+
+class TestKNNClassifier:
+    def test_vs_sklearn(self, rng):
+        from sklearn.neighbors import KNeighborsClassifier as SkKNN
+        x, labels = _blobs(rng, n=150, d=4, k=3)
+        q, _ = _blobs(rng, n=30, d=4, k=3)
+        y = labels.astype(np.float32).reshape(-1, 1)
+        knn = KNeighborsClassifier(n_neighbors=5).fit(ds.array(x), ds.array(y))
+        got = knn.predict(ds.array(q)).collect().ravel()
+        sk = SkKNN(n_neighbors=5).fit(x, labels)
+        want = sk.predict(q)
+        assert (got == want).mean() > 0.95
+        assert knn.score(ds.array(x), ds.array(y)) > 0.95
+
+    def test_distance_weights(self, rng):
+        x, labels = _blobs(rng, n=90, d=3, k=3)
+        y = labels.astype(np.float32).reshape(-1, 1)
+        knn = KNeighborsClassifier(n_neighbors=3, weights="distance")
+        knn.fit(ds.array(x), ds.array(y))
+        assert knn.score(ds.array(x), ds.array(y)) == 1.0
+
+
+class TestReviewRegressions:
+    """Locks in fixes from code review."""
+
+    def test_scaler_large_mean_variance(self, rng):
+        # mean ~1e4, std ~1: one-pass E[x²]−μ² would cancel in float32
+        x = (1e4 + rng.randn(200, 3)).astype(np.float32)
+        sc = StandardScaler().fit(ds.array(x))
+        np.testing.assert_allclose(sc.var_.collect().ravel(), x.var(axis=0),
+                                   rtol=0.05)
+        t = sc.transform(ds.array(x)).collect()
+        assert abs(t.std() - 1.0) < 0.05
+
+    def test_knn_k_exceeds_samples(self, rng):
+        x = rng.rand(5, 3).astype(np.float32)
+        y = np.zeros((5, 1), np.float32)
+        knn = KNeighborsClassifier(n_neighbors=10).fit(ds.array(x), ds.array(y))
+        with pytest.raises(ValueError):
+            knn.predict(ds.array(x))
+
+    def test_admm_rejects_multitarget(self, rng):
+        from dislib_tpu.optimization import ADMM
+        with pytest.raises(ValueError):
+            ADMM().fit(ds.array(rng.rand(8, 2)), ds.array(rng.rand(8, 2)))
+
+    def test_neighbors_indices_are_int(self, rng):
+        nn = NearestNeighbors(n_neighbors=2).fit(ds.array(rng.rand(10, 2)))
+        _, i = nn.kneighbors(ds.array(rng.rand(4, 2)))
+        assert np.issubdtype(i.collect().dtype, np.integer)

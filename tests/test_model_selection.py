@@ -1,0 +1,101 @@
+"""Model-selection tests (reference: test_model_selection — SURVEY.md §3.4)."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import KMeans
+from dislib_tpu.classification import KNeighborsClassifier
+from dislib_tpu.model_selection import KFold, GridSearchCV, RandomizedSearchCV
+
+
+def _blobs(rng, n=120, d=3, k=3):
+    centers = rng.rand(k, d) * 10
+    x = np.vstack([centers[i] + 0.2 * rng.randn(n // k, d) for i in range(k)])
+    y = np.repeat(np.arange(k), n // k).astype(np.float32)
+    return x.astype(np.float32), y.reshape(-1, 1)
+
+
+class TestKFold:
+    def test_partition(self, rng):
+        x, y = _blobs(rng, n=90)
+        folds = list(KFold(n_splits=3).split(ds.array(x), ds.array(y)))
+        assert len(folds) == 3
+        test_rows = np.vstack([f[2].collect() for f in folds])
+        assert test_rows.shape == x.shape
+        # every original row appears exactly once across test folds
+        assert len(np.unique(test_rows @ rng.rand(3).astype(np.float32))) >= 85
+
+    def test_sizes(self, rng):
+        x, _ = _blobs(rng, n=90)
+        for xt, _, xv, _ in KFold(n_splits=4).split(ds.array(x)):
+            assert xt.shape[0] + xv.shape[0] == 90
+            assert xv.shape[0] in (22, 23)
+
+    def test_shuffle_deterministic(self, rng):
+        x, _ = _blobs(rng, n=60)
+        f1 = [f[2].collect() for f in KFold(3, shuffle=True, random_state=0).split(ds.array(x))]
+        f2 = [f[2].collect() for f in KFold(3, shuffle=True, random_state=0).split(ds.array(x))]
+        for a, b in zip(f1, f2):
+            np.testing.assert_array_equal(a, b)
+
+    def test_bad_n_splits(self, rng):
+        x, _ = _blobs(rng, n=30)
+        with pytest.raises(ValueError):
+            list(KFold(n_splits=1).split(ds.array(x)))
+
+
+class TestGridSearchCV:
+    def test_finds_best_k(self, rng):
+        x, y = _blobs(rng, n=120, k=3)
+        perm = rng.permutation(len(x))
+        x, y = x[perm], y[perm]
+        gs = GridSearchCV(KNeighborsClassifier(),
+                          {"n_neighbors": [1, 3, 5]},
+                          cv=KFold(n_splits=3, shuffle=True, random_state=0))
+        gs.fit(ds.array(x), ds.array(y))
+        assert set(gs.cv_results_.keys()) >= {"params", "mean_test_score",
+                                              "std_test_score", "rank_test_score"}
+        assert len(gs.cv_results_["params"]) == 3
+        assert gs.best_score_ > 0.9
+        assert gs.best_estimator_.score(ds.array(x), ds.array(y)) > 0.9
+        assert gs.predict(ds.array(x)).shape == (120, 1)
+
+    def test_unsupervised_estimator(self, rng):
+        x, _ = _blobs(rng, n=90, k=3)
+        gs = GridSearchCV(KMeans(random_state=0, max_iter=20),
+                          {"n_clusters": [2, 3]}, cv=3)
+        gs.fit(ds.array(x))
+        assert len(gs.cv_results_["params"]) == 2
+        assert hasattr(gs, "best_params_")
+
+    def test_multi_grid(self, rng):
+        x, y = _blobs(rng, n=60)
+        gs = GridSearchCV(KNeighborsClassifier(),
+                          [{"n_neighbors": [1, 3]},
+                           {"n_neighbors": [5], "weights": ["distance"]}],
+                          cv=2)
+        gs.fit(ds.array(x), ds.array(y))
+        assert len(gs.cv_results_["params"]) == 3
+
+
+class TestRandomizedSearchCV:
+    def test_samples_n_iter(self, rng):
+        x, y = _blobs(rng, n=60)
+        rs = RandomizedSearchCV(KNeighborsClassifier(),
+                                {"n_neighbors": [1, 2, 3, 4, 5]},
+                                n_iter=4, random_state=0,
+                                cv=KFold(n_splits=2, shuffle=True, random_state=0))
+        rs.fit(ds.array(x), ds.array(y))
+        assert len(rs.cv_results_["params"]) == 4
+        assert rs.best_score_ > 0.8
+
+    def test_scipy_distribution(self, rng):
+        from scipy.stats import randint
+        x, y = _blobs(rng, n=60)
+        rs = RandomizedSearchCV(KNeighborsClassifier(),
+                                {"n_neighbors": randint(1, 6)},
+                                n_iter=3, cv=2, random_state=1)
+        rs.fit(ds.array(x), ds.array(y))
+        ks = [p["n_neighbors"] for p in rs.cv_results_["params"]]
+        assert all(1 <= k < 6 for k in ks)
