@@ -133,7 +133,7 @@ class GaussianMixture(BaseEstimator):
         self._check_fitted()
         labels = _gm_predict(x._data, x.shape, jnp.asarray(self.weights_),
                              jnp.asarray(self.means_), jnp.asarray(self.covariances_),
-                             self.covariance_type, float(self.reg_covar))
+                             self.covariance_type)
         return Array._from_logical_padded(labels, (x.shape[0], 1))
 
     def _check_fitted(self):
@@ -263,7 +263,7 @@ def _gm_fit(xp, shape, resp0, cov_type, reg_covar, tol, max_iter, overrides=(Non
 
 
 @partial(jax.jit, static_argnames=("shape", "cov_type"))
-def _gm_predict(xp, shape, weights, means, covs, cov_type, reg_covar):
+def _gm_predict(xp, shape, weights, means, covs, cov_type):
     m, n = shape
     xv = xp[:, :n]
     prec = _chol_precisions(covs, cov_type, n)

@@ -54,12 +54,15 @@ class GridSearchCV(BaseEstimator):
     def fit(self, x, y=None):
         candidates = self._candidates()
         cv = self.cv if isinstance(self.cv, KFold) else KFold(n_splits=self.cv)
-        folds = list(cv.split(x, y))
+        n_folds = cv.get_n_splits()
         scorer = self.scoring if self.scoring is not None else _score
 
-        all_scores = np.zeros((len(candidates), len(folds)))
-        for ci, params in enumerate(candidates):
-            for fi, (xt, yt, xv, yv) in enumerate(folds):
+        # fold-major loop: only ONE fold's train/validation copies are device-
+        # resident at a time (fold f is released before f+1 materializes),
+        # bounding memory to one fold regardless of cv or candidate count
+        all_scores = np.zeros((len(candidates), n_folds))
+        for fi, (xt, yt, xv, yv) in enumerate(cv.split(x, y)):
+            for ci, params in enumerate(candidates):
                 est = clone(self.estimator).set_params(**params)
                 est.fit(xt, yt) if yt is not None else est.fit(xt)
                 all_scores[ci, fi] = scorer(est, xv, yv)
@@ -72,7 +75,7 @@ class GridSearchCV(BaseEstimator):
             "mean_test_score": mean,
             "std_test_score": std,
             "rank_test_score": rank.astype(int),
-            **{f"split{j}_test_score": all_scores[:, j] for j in range(len(folds))},
+            **{f"split{j}_test_score": all_scores[:, j] for j in range(n_folds)},
         }
         self.best_index_ = int(np.argmax(mean))
         self.best_params_ = candidates[self.best_index_]
@@ -109,10 +112,14 @@ class RandomizedSearchCV(GridSearchCV):
 
     def _candidates(self):
         rng = np.random.RandomState(self.random_state)
+        dists = self.param_distributions
+        if isinstance(dists, dict):
+            dists = [dists]
         out = []
         for _ in range(self.n_iter):
+            d = dists[rng.randint(len(dists))]
             params = {}
-            for k, v in self.param_distributions.items():
+            for k, v in d.items():
                 if hasattr(v, "rvs"):
                     params[k] = v.rvs(random_state=rng)
                 else:

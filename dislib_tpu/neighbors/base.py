@@ -19,7 +19,7 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array
+from dislib_tpu.data.array import Array, _repad
 
 
 class NearestNeighbors(BaseEstimator):
@@ -40,23 +40,18 @@ class NearestNeighbors(BaseEstimator):
         ds-arrays too)."""
         if not hasattr(self, "_fit_data"):
             raise RuntimeError("NearestNeighbors is not fitted")
-        k = n_neighbors or self.n_neighbors
+        k = self.n_neighbors if n_neighbors is None else n_neighbors
         f = self._fit_data
-        if k > f.shape[0]:
-            raise ValueError(f"n_neighbors {k} > fitted samples {f.shape[0]}")
+        if not 1 <= k <= f.shape[0]:
+            raise ValueError(f"n_neighbors {k} not in [1, {f.shape[0]}]")
         d, idx = _kneighbors(x._data, f._data, x.shape, f.shape, k)
-        d_arr = Array._from_logical_padded(_repad2(d, (x.shape[0], k)), (x.shape[0], k))
+        d_arr = Array._from_logical_padded(_repad(d, (x.shape[0], k)), (x.shape[0], k))
         # indices stay int32 (exact for any realistic row count; float32 would
         # corrupt indices past 2^24)
-        i_arr = Array._from_logical_padded(_repad2(idx, (x.shape[0], k)), (x.shape[0], k))
+        i_arr = Array._from_logical_padded(_repad(idx, (x.shape[0], k)), (x.shape[0], k))
         if return_distance:
             return d_arr, i_arr
         return i_arr
-
-
-def _repad2(data, shape):
-    from dislib_tpu.data.array import _repad
-    return _repad(data, shape)
 
 
 @partial(jax.jit, static_argnames=("q_shape", "f_shape", "k"))

@@ -74,6 +74,8 @@ class ADMM(BaseEstimator):
         """Solve consensus least-squares + prox over row-partitions of (x, y)."""
         if y.shape[1] != 1:
             raise ValueError(f"ADMM supports a single target column; y is {y.shape}")
+        if x.shape[0] != y.shape[0]:
+            raise ValueError(f"x and y row counts differ: {x.shape[0]} != {y.shape[0]}")
         prox = self.z_prox if self.z_prox is not None else identity_prox
         z, n_iter, converged = _admm_fit(
             x._data, y._data, x.shape, (y.shape[0], y.shape[1]),

@@ -1,0 +1,5 @@
+"""Recommendation (reference: `dislib/recommendation` — ALS; SURVEY.md §3.3)."""
+
+from dislib_tpu.recommendation.als import ALS
+
+__all__ = ["ALS"]
