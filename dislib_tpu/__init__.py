@@ -25,7 +25,8 @@ from dislib_tpu.utils.saving import save_model, load_model
 
 # subpackages (sklearn-style namespaces, reference parity)
 from dislib_tpu import cluster, classification, regression, neighbors, \
-    preprocessing, optimization, model_selection, recommendation  # noqa: E402,F401
+    preprocessing, optimization, model_selection, recommendation, \
+    trees  # noqa: E402,F401
 
 __version__ = "0.1.0"
 

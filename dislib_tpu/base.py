@@ -16,6 +16,10 @@ from copy import deepcopy
 class BaseEstimator:
     """Minimal sklearn-compatible base: constructor args are hyperparameters."""
 
+    #: extra (leading-underscore) fitted state a subclass needs persisted by
+    #: ``save_model`` beyond the trailing-underscore convention
+    _private_fitted_attrs: tuple = ()
+
     @classmethod
     def _param_names(cls):
         sig = inspect.signature(cls.__init__)
@@ -35,7 +39,12 @@ class BaseEstimator:
         return self
 
     def _fitted_attrs(self) -> dict:
-        return {k: v for k, v in vars(self).items() if k.endswith("_") and not k.startswith("_")}
+        out = {k: v for k, v in vars(self).items()
+               if k.endswith("_") and not k.startswith("_")}
+        for k in self._private_fitted_attrs:
+            if hasattr(self, k):
+                out[k] = getattr(self, k)
+        return out
 
     def __repr__(self):
         params = ", ".join(f"{k}={v!r}" for k, v in self.get_params().items())

@@ -1,3 +1,4 @@
 from dislib_tpu.classification.knn import KNeighborsClassifier
+from dislib_tpu.classification.csvm import CascadeSVM
 
-__all__ = ["KNeighborsClassifier"]
+__all__ = ["KNeighborsClassifier", "CascadeSVM"]

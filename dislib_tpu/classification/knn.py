@@ -18,6 +18,7 @@ from jax import lax
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.neighbors.base import _kneighbors
+from dislib_tpu.ops.base import precise
 
 
 class KNeighborsClassifier(BaseEstimator):
@@ -27,6 +28,8 @@ class KNeighborsClassifier(BaseEstimator):
     ----------
     classes_ : ndarray of unique labels.
     """
+
+    _private_fitted_attrs = ("_fit_x", "_codes")
 
     def __init__(self, n_neighbors=5, weights="uniform"):
         self.n_neighbors = n_neighbors
@@ -65,6 +68,7 @@ class KNeighborsClassifier(BaseEstimator):
 
 
 @partial(jax.jit, static_argnames=("q_shape", "f_shape", "k", "use_dist"))
+@precise
 def _knn_predict(qp, fp, q_shape, f_shape, codes, classes, k, use_dist):
     dist_k, idx = _kneighbors(qp, fp, q_shape, f_shape, k)
     neigh_codes = codes[idx]                                  # (mq_pad, k)

@@ -1,4 +1,6 @@
 from dislib_tpu.cluster.kmeans import KMeans
 from dislib_tpu.cluster.gm import GaussianMixture
+from dislib_tpu.cluster.dbscan import DBSCAN
+from dislib_tpu.cluster.daura import Daura
 
-__all__ = ["KMeans", "GaussianMixture"]
+__all__ = ["KMeans", "GaussianMixture", "DBSCAN", "Daura"]

@@ -1,0 +1,108 @@
+"""Daura / GROMOS conformational clustering (reference:
+`dislib/cluster/daura` — pairwise-RMSD-count tasks + an iterative "extract
+the max-neighbor medoid" greedy outer loop; SURVEY.md §3.3).
+
+TPU-native redesign: the reference distributes the *neighbor counting* (one
+task per block pair) and keeps the greedy loop on the master, syncing counts
+every round.  Here the full pairwise RMSD adjacency is one distance GEMM and
+the entire greedy loop — count active neighbors, argmax, peel the medoid's
+neighborhood, repeat — runs on device inside a single `lax.while_loop` with
+no host round-trips: each round is a masked reduce + argmax + row-gather on
+the resident adjacency matrix.
+
+Frames are rows of the ds-array, ``3·n_atoms`` coordinates per row (the
+layout `load_mdcrd_file` produces).  RMSD(i, j) = √(‖xᵢ − xⱼ‖² / n_atoms),
+without superposition — matching the reference, which clusters pre-aligned
+trajectories.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.ops import distances_sq
+from dislib_tpu.ops.base import precise
+
+
+class Daura(BaseEstimator):
+    """GROMOS clustering of MD trajectory frames.
+
+    Parameters
+    ----------
+    cutoff : float — RMSD threshold for two frames to be neighbors.
+
+    Attributes
+    ----------
+    clusters_ : list of ndarray — one per cluster, frame indices with the
+        medoid first; ordered by extraction (largest neighborhoods first).
+    labels_ : ndarray (n_frames,) int — cluster id per frame.
+    """
+
+    def __init__(self, cutoff=1.0):
+        self.cutoff = cutoff
+
+    def fit(self, x: Array, y=None):
+        if x.shape[1] % 3 != 0:
+            raise ValueError("Daura expects rows of 3*n_atoms coordinates")
+        n_atoms = x.shape[1] // 3
+        labels, medoids = _daura_fit(x._data, x.shape, float(self.cutoff),
+                                     n_atoms)
+        labels = np.asarray(jax.device_get(labels))[: x.shape[0]]
+        medoids = np.asarray(jax.device_get(medoids))
+        self.labels_ = labels.astype(np.int64)
+        clusters = []
+        for cid in range(int(labels.max()) + 1 if labels.size else 0):
+            members = np.nonzero(labels == cid)[0]
+            med = int(medoids[cid])
+            clusters.append(np.concatenate(([med], members[members != med])))
+        self.clusters_ = clusters
+        return self
+
+    def fit_predict(self, x: Array, y=None) -> Array:
+        self.fit(x)
+        lab = jnp.asarray(self.labels_.astype(np.int32)[:, None])
+        return Array._from_logical_padded(_repad(lab, (x.shape[0], 1)),
+                                          (x.shape[0], 1))
+
+
+@partial(jax.jit, static_argnames=("shape", "n_atoms"))
+@precise
+def _daura_fit(xp, shape, cutoff, n_atoms):
+    m, n = shape
+    xv = xp[:, :n]
+    mp = xv.shape[0]
+
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
+    rmsd2 = distances_sq(xv, xv) / n_atoms
+    adj = (rmsd2 <= cutoff * cutoff) & valid[:, None] & valid[None, :]
+    # structural self-loops: every frame is its own neighbor, so each round
+    # removes ≥1 frame and the loop terminates regardless of fp rounding
+    ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
+    adj = adj | (jnp.eye(mp, dtype=jnp.bool_) & valid[:, None])
+
+    def body(carry):
+        active, labels, medoids, cid = carry
+        counts = jnp.sum(adj & active[None, :], axis=1)   # active-neighbor counts
+        counts = jnp.where(active, counts, -1)
+        medoid = jnp.argmax(counts).astype(jnp.int32)
+        members = (adj[medoid] | (ids == medoid)) & active
+        labels = jnp.where(members, cid, labels)
+        medoids = medoids.at[cid].set(medoid)
+        return active & ~members, labels, medoids, cid + 1
+
+    def cond(carry):
+        return jnp.any(carry[0])
+
+    labels0 = jnp.full((mp,), -1, jnp.int32)
+    medoids0 = jnp.full((mp,), -1, jnp.int32)
+    active0 = valid
+    _, labels, medoids, _ = lax.while_loop(
+        cond, body, (active0, labels0, medoids0, jnp.int32(0)))
+    return labels, medoids

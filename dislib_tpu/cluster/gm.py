@@ -27,6 +27,7 @@ from jax import lax
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops.base import precise
 
 _LOG2PI = float(np.log(2.0 * np.pi))
 
@@ -90,22 +91,65 @@ class GaussianMixture(BaseEstimator):
             raise ValueError(f"unsupported init_params {self.init_params!r}")
         return resp
 
-    def fit(self, x: Array, y=None):
+    def fit(self, x: Array, y=None, checkpoint=None):
+        """Fit by EM.  With ``checkpoint=FitCheckpoint(path, every=k)`` the
+        device loop runs in k-iteration chunks, snapshotting (weights, means,
+        covariances, lower_bound, n_iter) after each; a re-run resumes from
+        the snapshot (SURVEY §6 checkpoint/resume)."""
         if self.covariance_type not in ("full", "tied", "diag", "spherical"):
             raise ValueError(f"bad covariance_type {self.covariance_type!r}")
+        if self.max_iter < 1:
+            raise ValueError("max_iter must be >= 1")
         m, n = x.shape
-        k = self.n_components
-        resp0 = self._init_resp(x)
-        overrides = self._explicit_inits(n)
-        weights, means, covs, lb, n_iter, converged = _gm_fit(
-            x._data, x.shape, resp0, self.covariance_type,
-            float(self.reg_covar), float(self.tol), self.max_iter, overrides)
+        it, lb, converged = 0, None, False
+        state = checkpoint.load() if checkpoint is not None else None
+        if state is not None:
+            # resume: all three parameters come from the snapshot, so skip
+            # the (KMeans-based) responsibility init entirely
+            resp0 = jnp.zeros((x._data.shape[0], self.n_components),
+                              jnp.float32)
+            overrides = tuple(jnp.asarray(state[k]) for k in
+                              ("weights", "means", "covariances"))
+            want = (self.n_components, n)
+            if overrides[1].shape != want:
+                raise ValueError(
+                    f"checkpoint means shape {overrides[1].shape} does not "
+                    f"match this estimator/data {want} — stale or foreign "
+                    "snapshot")
+            lb = float(state["lower_bound"])
+            it = int(state["n_iter"])
+            converged = bool(state.get("converged", False))
+        else:
+            resp0 = self._init_resp(x)
+            overrides = self._explicit_inits(n)
+        while not converged:
+            chunk = self.max_iter - it if checkpoint is None else \
+                min(checkpoint.every, self.max_iter - it)
+            if chunk <= 0:
+                break
+            weights, means, covs, lb_dev, n_done, conv = _gm_fit(
+                x._data, x.shape, resp0, self.covariance_type,
+                float(self.reg_covar), float(self.tol), chunk, overrides,
+                prev_lb0=lb)
+            it += int(n_done)
+            lb = float(lb_dev)
+            converged = bool(conv)
+            overrides = (weights, means, covs)
+            if checkpoint is not None:
+                checkpoint.save({
+                    "weights": np.asarray(jax.device_get(weights)),
+                    "means": np.asarray(jax.device_get(means)),
+                    "covariances": np.asarray(jax.device_get(covs)),
+                    "lower_bound": lb, "n_iter": it, "converged": converged})
+            if checkpoint is None:
+                break
+        weights, means, covs = overrides
         self.weights_ = np.asarray(jax.device_get(weights))
         self.means_ = np.asarray(jax.device_get(means))
         self.covariances_ = np.asarray(jax.device_get(covs))
-        self.lower_bound_ = float(lb)
-        self.n_iter_ = int(n_iter)
-        self.converged_ = bool(converged)
+        self.lower_bound_ = lb if lb is not None else -np.inf
+        self.n_iter_ = it
+        self.converged_ = converged
         return self
 
     def _explicit_inits(self, d):
@@ -217,7 +261,9 @@ def _estimate_covs(xv, resp, nk, means, cov_type, reg_covar, w):
 
 
 @partial(jax.jit, static_argnames=("shape", "cov_type", "max_iter"))
-def _gm_fit(xp, shape, resp0, cov_type, reg_covar, tol, max_iter, overrides=(None, None, None)):
+@precise
+def _gm_fit(xp, shape, resp0, cov_type, reg_covar, tol, max_iter,
+            overrides=(None, None, None), prev_lb0=None):
     m, n = shape
     xv = xp[:, :n]
     xv = lax.with_sharding_constraint(xv, _mesh.row_sharding())
@@ -256,13 +302,15 @@ def _gm_fit(xp, shape, resp0, cov_type, reg_covar, tol, max_iter, overrides=(Non
         _, _, _, lb, conv, it = carry
         return (~conv) & (it < max_iter)
 
-    init = (weights0, means0, covs0, jnp.asarray(-jnp.inf, xv.dtype),
-            jnp.asarray(False), jnp.int32(0))
+    lb0 = jnp.asarray(-jnp.inf, xv.dtype) if prev_lb0 is None else \
+        jnp.asarray(prev_lb0, xv.dtype)
+    init = (weights0, means0, covs0, lb0, jnp.asarray(False), jnp.int32(0))
     weights, means, covs, lb, conv, n_iter = lax.while_loop(cond, step, init)
     return weights, means, covs, lb, n_iter, conv
 
 
 @partial(jax.jit, static_argnames=("shape", "cov_type"))
+@precise
 def _gm_predict(xp, shape, weights, means, covs, cov_type):
     m, n = shape
     xv = xp[:, :n]

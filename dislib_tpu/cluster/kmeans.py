@@ -29,7 +29,9 @@ from jax import lax
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _pad_mask
+from dislib_tpu.ops import distances_sq as _distances_sq
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops.base import precise
 
 
 class KMeans(BaseEstimator):
@@ -81,13 +83,45 @@ class KMeans(BaseEstimator):
             rows = jnp.concatenate([rows, extra], axis=0)
         return rows
 
-    def fit(self, x: Array, y=None):
-        centers0 = self._init_centers(x)
-        centers, n_iter, inertia = _kmeans_fit(
-            x._data, x.shape, centers0, self.max_iter, float(self.tol))
+    def fit(self, x: Array, y=None, checkpoint=None):
+        """Fit on `x`.  With ``checkpoint=FitCheckpoint(path, every=k)`` the
+        device loop runs in k-iteration chunks, snapshotting (centers,
+        n_iter) after each; a re-run resumes from the snapshot (SURVEY §6
+        checkpoint/resume — TPU preemption recovery)."""
+        it = 0
+        done = False
+        state = checkpoint.load() if checkpoint is not None else None
+        if state is not None:
+            centers = jnp.asarray(state["centers"])
+            want = (self.n_clusters, x.shape[1])
+            if centers.shape != want:
+                raise ValueError(
+                    f"checkpoint centers shape {centers.shape} does not match "
+                    f"this estimator/data {want} — stale or foreign snapshot")
+            it = int(state["n_iter"])
+            done = bool(state.get("converged", False))
+        else:
+            centers = self._init_centers(x)
+        inertia = None
+        while not done:
+            chunk = self.max_iter - it if checkpoint is None else \
+                min(checkpoint.every, self.max_iter - it)
+            if chunk <= 0:
+                break
+            centers, n_done, inertia, shift = _kmeans_fit(
+                x._data, x.shape, centers, chunk, float(self.tol))
+            it += int(n_done)
+            done = float(shift) < self.tol
+            if checkpoint is not None:
+                checkpoint.save({"centers": np.asarray(jax.device_get(centers)),
+                                 "n_iter": it, "converged": done})
+            if checkpoint is None:
+                break
         self.centers_ = np.asarray(jax.device_get(centers))
-        self.n_iter_ = int(n_iter)
-        self.inertia_ = float(inertia)
+        self.n_iter_ = it
+        # inertia is None only when resuming an already-finished fit
+        self.inertia_ = float(inertia) if inertia is not None else \
+            -float(_kmeans_score(x._data, x.shape, centers))
         return self
 
     def fit_predict(self, x: Array, y=None) -> Array:
@@ -112,15 +146,8 @@ class KMeans(BaseEstimator):
 # device kernels
 # ---------------------------------------------------------------------------
 
-def _distances_sq(xv, centers):
-    """Squared euclidean distances (m_pad, k): one GEMM + norms (MXU)."""
-    x_sq = jnp.sum(xv * xv, axis=1, keepdims=True)
-    c_sq = jnp.sum(centers * centers, axis=1)
-    cross = xv @ centers.T
-    return x_sq - 2.0 * cross + c_sq[None, :]
-
-
 @partial(jax.jit, static_argnames=("shape", "max_iter"))
+@precise
 def _kmeans_fit(xp, shape, centers0, max_iter, tol):
     m, n = shape
     xv = xp[:, :n]  # crop padded cols; padded rows stay (weighted 0)
@@ -148,11 +175,12 @@ def _kmeans_fit(xp, shape, centers0, max_iter, tol):
 
     init = (centers0, jnp.asarray(jnp.inf, xv.dtype), jnp.int32(0),
             jnp.asarray(0.0, xv.dtype))
-    centers, _, n_iter, inertia = lax.while_loop(cond, step, init)
-    return centers, n_iter, inertia
+    centers, shift, n_iter, inertia = lax.while_loop(cond, step, init)
+    return centers, n_iter, inertia, shift
 
 
 @partial(jax.jit, static_argnames=("shape",))
+@precise
 def _kmeans_predict(xp, shape, centers):
     m, n = shape
     xv = xp[:, :n]
@@ -165,6 +193,7 @@ def _kmeans_predict(xp, shape, centers):
 
 
 @partial(jax.jit, static_argnames=("shape",))
+@precise
 def _kmeans_score(xp, shape, centers):
     m, n = shape
     xv = xp[:, :n]

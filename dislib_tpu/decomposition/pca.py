@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
+from dislib_tpu.ops.base import precise
 
 
 class PCA(BaseEstimator):
@@ -71,6 +72,7 @@ from functools import partial
 
 
 @partial(jax.jit, static_argnames=("shape", "use_svd"))
+@precise
 def _pca_fit(xp, shape, use_svd):
     m, n = shape
     xv = xp[:, :n]  # crop cols; padded rows are zero

@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops.base import precise
 
 
 def tsqr(a: Array, mode: str = "reduced", indexes=None):
@@ -59,6 +60,7 @@ def tsqr(a: Array, mode: str = "reduced", indexes=None):
 
 
 @partial(jax.jit, static_argnames=("mesh", "p"))
+@precise
 def _tsqr_shardmap(av, mesh, p):
     n = av.shape[1]
 

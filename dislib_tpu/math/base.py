@@ -27,6 +27,7 @@ from jax import lax
 
 from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops.base import precise
 
 
 # ---------------------------------------------------------------------------
@@ -34,6 +35,7 @@ from dislib_tpu.parallel import mesh as _mesh
 # ---------------------------------------------------------------------------
 
 @partial(jax.jit, static_argnames=("ta", "tb", "a_shape", "b_shape"))
+@precise
 def _matmul_kernel(a, b, ta, tb, a_shape, b_shape):
     if ta:
         a = a.T
@@ -137,6 +139,7 @@ def svd(a: Array, compute_uv: bool = True, sort: bool = True,
 
 
 @partial(jax.jit, static_argnames=("max_sweeps",))
+@precise
 def _jacobi_svd(a, eps, max_sweeps):
     m, n = a.shape
     # round-robin pairings: n-1 rounds, each pairing all columns once

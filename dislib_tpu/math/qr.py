@@ -26,9 +26,11 @@ import jax
 import jax.numpy as jnp
 
 from dislib_tpu.data.array import Array
+from dislib_tpu.ops.base import precise
 
 
 @partial(jax.jit, static_argnames=("mode", "shape"))
+@precise
 def _qr_kernel(a, mode, shape):
     return jnp.linalg.qr(a, mode=mode)
 

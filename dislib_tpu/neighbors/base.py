@@ -20,10 +20,13 @@ from jax import lax
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.ops.base import distances_sq, precise
 
 
 class NearestNeighbors(BaseEstimator):
     """Exact brute-force kNN index over a ds-array."""
+
+    _private_fitted_attrs = ("_fit_data",)
 
     def __init__(self, n_neighbors=5):
         self.n_neighbors = n_neighbors
@@ -55,14 +58,13 @@ class NearestNeighbors(BaseEstimator):
 
 
 @partial(jax.jit, static_argnames=("q_shape", "f_shape", "k"))
+@precise
 def _kneighbors(qp, fp, q_shape, f_shape, k):
     mq, d = q_shape
     mf = f_shape[0]
     qv = qp[:, :d]
     fv = fp[:, :d]
-    q_sq = jnp.sum(qv * qv, axis=1, keepdims=True)
-    f_sq = jnp.sum(fv * fv, axis=1)
-    dist = q_sq - 2.0 * (qv @ fv.T) + f_sq[None, :]           # (mq_pad, mf_pad)
+    dist = distances_sq(qv, fv)                               # (mq_pad, mf_pad)
     invalid = lax.broadcasted_iota(jnp.int32, (1, fv.shape[0]), 1) >= mf
     dist = jnp.where(invalid, jnp.inf, dist)
     neg, idx = lax.top_k(-dist, k)

@@ -1,0 +1,3 @@
+from dislib_tpu.ops.base import distances_sq, precise
+
+__all__ = ["distances_sq", "precise"]

@@ -1,0 +1,49 @@
+"""Shared device kernels used across estimators.
+
+These are the hot inner ops the reference computes per block inside NumPy
+`@task`s (e.g. `scipy cdist` in `dislib/cluster/kmeans._partial_sum`);
+here each is a single MXU-friendly formulation shared by every caller so
+numerical fixes land in one place.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def precise(fn):
+    """Trace-time matmul-precision scope for library kernels.
+
+    TPU matmuls default to bfloat16 inputs; the reference's per-block kernels
+    are NumPy float64, so dislib_tpu's own GEMMs run float32-faithful
+    ('highest') — bf16 cross-term error (~‖x‖²/256) breaks distance
+    thresholds, QR orthogonality and normal-equation solves outright.
+    Scoped here (under each kernel's ``jax.jit``, active during tracing)
+    rather than via the global ``jax_default_matmul_precision`` flag so user
+    code's own precision configuration is never touched."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args, **kwargs)
+    return wrapped
+
+
+def distances_sq(a, b, precision=None):
+    """Pairwise squared euclidean distances (m, k) between rows of `a` (m, d)
+    and rows of `b` (k, d): one GEMM + norms (‖a‖² − 2a·bᵀ + ‖b‖²), clamped
+    at zero against cancellation.
+
+    ``precision=None`` inherits the enclosing scope's matmul precision —
+    inside the library's kernels that is the float32-faithful scope set by
+    :func:`precise`.  At TPU-native bf16 the cross-term error (~‖x‖²/256)
+    dwarfs ε-thresholds — a point's distance to ITSELF comes out ≫ 0,
+    breaking radius comparisons (DBSCAN/Daura) — so callers outside a
+    ``precise`` kernel should pass an explicit precision."""
+    a_sq = jnp.sum(a * a, axis=1, keepdims=True)
+    b_sq = jnp.sum(b * b, axis=1)
+    cross = jnp.matmul(a, b.T, precision=precision)
+    return jnp.maximum(a_sq - 2.0 * cross + b_sq[None, :], 0.0)

@@ -29,6 +29,7 @@ from jax.sharding import PartitionSpec as P
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops.base import precise
 
 
 def soft_threshold(v, k):
@@ -89,6 +90,7 @@ class ADMM(BaseEstimator):
 
 
 @partial(jax.jit, static_argnames=("x_shape", "y_shape", "max_iter", "prox", "mesh"))
+@precise
 def _admm_fit(xp, yp, x_shape, y_shape, rho, kappa, abstol, reltol, max_iter, prox, mesh):
     m, n = x_shape
     xv = xp[:, :n]

@@ -44,6 +44,11 @@ def init(mesh_shape: tuple[int, int] | None = None, devices=None) -> Mesh:
         parallelism, the reference's dominant pattern (SURVEY.md §3.6).
     devices : sequence of jax devices, optional
         Defaults to ``jax.devices()``.
+
+    Matmul precision note: the library's own kernels always trace their
+    GEMMs at float32-faithful precision (see ``dislib_tpu.ops.base.precise``)
+    — no global JAX configuration is touched, so user code keeps whatever
+    ``jax_default_matmul_precision`` it set.
     """
     global _default_mesh
     if devices is None:

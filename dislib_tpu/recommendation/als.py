@@ -37,6 +37,7 @@ from jax import lax
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops.base import precise
 
 
 class ALS(BaseEstimator):
@@ -75,13 +76,18 @@ class ALS(BaseEstimator):
         self.verbose = verbose
         self.arity = arity
 
-    def fit(self, x: Array, test=None):
+    def fit(self, x: Array, test=None, checkpoint=None):
         """Factorise the ratings matrix ``x`` (users × items, 0 = unobserved).
 
         ``test`` — optional held-out ratings (ndarray or ds-array with the
         same shape, 0 = unobserved) used for the convergence RMSE instead of
         the training ratings, as in the reference.
+        ``checkpoint`` — optional ``FitCheckpoint``: run in `every`-iteration
+        chunks, snapshot (users, items, rmse, n_iter) after each, resume from
+        the snapshot on re-run (SURVEY §6 checkpoint/resume).
         """
+        if self.max_iter < 1:
+            raise ValueError("max_iter must be >= 1")
         if test is None:
             test_p = x._data
         else:
@@ -91,15 +97,47 @@ class ALS(BaseEstimator):
                     f"test ratings shape {t.shape} != ratings shape {x.shape}")
             test_p = _pad_like(t, x)
         seed = self.random_state if self.random_state is not None else 0
-        u, v, rmse, n_iter, conv = _als_fit(
-            x._data, test_p, x.shape, int(self.n_f), float(self.lambda_),
-            float(self.tol), int(self.max_iter), int(seed))
+        it, rmse, conv, state = 0, np.inf, False, None
+        if checkpoint is not None:
+            snap = checkpoint.load()
+            if snap is not None:
+                want = (x._data.shape[0], int(self.n_f))
+                if snap["users"].shape != want:
+                    raise ValueError(
+                        f"checkpoint users shape {snap['users'].shape} does "
+                        f"not match this estimator/data {want} — stale or "
+                        "foreign snapshot")
+                state = (jnp.asarray(snap["users"]), jnp.asarray(snap["items"]),
+                         float(snap["rmse"]))
+                rmse = float(snap["rmse"])
+                it = int(snap["n_iter"])
+                conv = bool(snap.get("converged", False))
+        while not conv:
+            chunk = self.max_iter - it if checkpoint is None else \
+                min(checkpoint.every, self.max_iter - it)
+            if chunk <= 0:
+                break
+            u, v, rmse_dev, n_done, conv_dev = _als_fit(
+                x._data, test_p, x.shape, int(self.n_f), float(self.lambda_),
+                float(self.tol), chunk, int(seed), init_state=state)
+            it += int(n_done)
+            rmse = float(rmse_dev)
+            conv = bool(conv_dev)
+            state = (u, v, rmse)
+            if checkpoint is not None:
+                checkpoint.save({"users": np.asarray(jax.device_get(u)),
+                                 "items": np.asarray(jax.device_get(v)),
+                                 "rmse": rmse, "n_iter": it,
+                                 "converged": conv})
+            if checkpoint is None:
+                break
+        u, v, _ = state
         m, n = x.shape
         self.users_ = np.asarray(jax.device_get(u))[:m]
         self.items_ = np.asarray(jax.device_get(v))[:n]
         self.rmse_ = float(rmse)
-        self.n_iter_ = int(n_iter)
-        self.converged_ = bool(conv)
+        self.n_iter_ = it
+        self.converged_ = conv
         return self
 
     def predict_user(self, user_id: int) -> np.ndarray:
@@ -143,7 +181,9 @@ def _solve_factors(r, mask, v, lambda_, n_f):
 
 
 @partial(jax.jit, static_argnames=("shape", "n_f", "max_iter"))
-def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed):
+@precise
+def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed,
+             init_state=None):
     rp = lax.with_sharding_constraint(rp, _mesh.data_sharding())
     mask = (rp != 0).astype(rp.dtype)
     tmask = (test_p != 0).astype(rp.dtype)
@@ -153,6 +193,10 @@ def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed):
     # init scaled to the mean magnitude behaves equivalently
     u0 = jax.random.uniform(ku, (rp.shape[0], n_f), rp.dtype)
     v0 = jax.random.uniform(kv, (rp.shape[1], n_f), rp.dtype)
+    prev0 = jnp.asarray(jnp.inf, rp.dtype)
+    if init_state is not None:                 # mid-fit checkpoint resume
+        u0, v0, prev0 = init_state
+        prev0 = jnp.asarray(prev0, rp.dtype)
 
     def rmse(u, v):
         se = ((u @ v.T - test_p) * tmask) ** 2
@@ -170,7 +214,6 @@ def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed):
         *_, it, conv = carry
         return (it < max_iter) & (~conv)
 
-    init = (u0, v0, jnp.asarray(jnp.inf, rp.dtype), jnp.int32(0),
-            jnp.asarray(False))
+    init = (u0, v0, prev0, jnp.int32(0), jnp.asarray(False))
     u, v, cur, n_iter, conv = lax.while_loop(cond, step, init)
     return u, v, cur, n_iter, conv

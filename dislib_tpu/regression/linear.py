@@ -19,6 +19,7 @@ from jax import lax
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops.base import precise
 
 
 class LinearRegression(BaseEstimator):
@@ -64,6 +65,7 @@ class LinearRegression(BaseEstimator):
 
 
 @partial(jax.jit, static_argnames=("x_shape", "y_shape", "fit_intercept"))
+@precise
 def _linreg_fit(xp, yp, x_shape, y_shape, fit_intercept):
     m, n = x_shape
     t = y_shape[1]
@@ -86,6 +88,7 @@ def _linreg_fit(xp, yp, x_shape, y_shape, fit_intercept):
 
 
 @partial(jax.jit, static_argnames=("shape",))
+@precise
 def _linreg_predict(xp, shape, coef, intercept):
     m, n = shape
     xv = xp[:, :n]

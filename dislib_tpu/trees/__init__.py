@@ -1,0 +1,9 @@
+from dislib_tpu.trees.forest import (
+    RandomForestClassifier, RandomForestRegressor,
+    DecisionTreeClassifier, DecisionTreeRegressor,
+)
+
+__all__ = [
+    "RandomForestClassifier", "RandomForestRegressor",
+    "DecisionTreeClassifier", "DecisionTreeRegressor",
+]

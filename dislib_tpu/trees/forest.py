@@ -1,0 +1,169 @@
+"""Random forests + single decision trees (reference: `dislib/trees/forest.py`
+— `RandomForestClassifier(n_estimators, try_features, max_depth, distr_depth,
+sklearn_max, hard_vote, random_state)`, `RandomForestRegressor`; SURVEY.md
+§3.3).  Growth machinery in `decision_tree.py`; here the sklearn-style API,
+label handling and voting."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.trees.decision_tree import _BaseTreeEnsemble
+
+
+class _ClassifierMixin:
+    _criterion = "gini"
+
+    def _encode_labels(self, x: Array, y: Array):
+        y_host = np.asarray(y.collect()).ravel()
+        self.classes_ = np.unique(y_host)
+        enc = np.searchsorted(self.classes_, y_host)
+        k = len(self.classes_)
+        mp = x._data.shape[0]
+        onehot = np.zeros((mp, k), np.float32)
+        onehot[np.arange(len(enc)), enc] = 1.0
+        return onehot
+
+    def predict_proba(self, x: Array) -> Array:
+        self._check_fitted()
+        leaf = self._apply(x)                               # (T, mq_pad)
+        counts = jnp.take_along_axis(
+            self._leaves, leaf[:, :, None], axis=1)         # (T, mq_pad, K)
+        probs = counts / jnp.maximum(
+            jnp.sum(counts, axis=2, keepdims=True), 1e-12)
+        mean = jnp.mean(probs, axis=0)                      # (mq_pad, K)
+        k = len(self.classes_)
+        out = _repad(mean[: x.shape[0]], (x.shape[0], k))
+        return Array._from_logical_padded(out, (x.shape[0], k))
+
+    def predict(self, x: Array) -> Array:
+        self._check_fitted()
+        leaf = self._apply(x)
+        counts = jnp.take_along_axis(self._leaves, leaf[:, :, None], axis=1)
+        if getattr(self, "hard_vote", False):
+            votes = jnp.argmax(counts, axis=2)              # (T, mq_pad)
+            k = len(self.classes_)
+            tally = jax.nn.one_hot(votes, k).sum(axis=0)
+            enc = jnp.argmax(tally, axis=1)
+        else:
+            probs = counts / jnp.maximum(
+                jnp.sum(counts, axis=2, keepdims=True), 1e-12)
+            enc = jnp.argmax(jnp.mean(probs, axis=0), axis=1)
+        labels = self.classes_[np.asarray(jax.device_get(enc))[: x.shape[0]]]
+        out = jnp.asarray(labels.astype(np.float32)[:, None])
+        return Array._from_logical_padded(_repad(out, (x.shape[0], 1)),
+                                          (x.shape[0], 1))
+
+    def score(self, x: Array, y: Array) -> float:
+        pred = self.predict(x).collect().ravel()
+        truth = np.asarray(y.collect()).ravel()
+        return float(np.mean(pred == truth))
+
+
+class _RegressorMixin:
+    _criterion = "mse"
+
+    def _encode_targets(self, x: Array, y: Array):
+        y_host = np.asarray(y.collect()).ravel().astype(np.float32)
+        mp = x._data.shape[0]
+        stats = np.zeros((mp, 3), np.float32)               # [w, wy, wy²] basis
+        stats[: len(y_host), 0] = 1.0
+        stats[: len(y_host), 1] = y_host
+        stats[: len(y_host), 2] = y_host * y_host
+        return stats
+
+    def predict(self, x: Array) -> Array:
+        self._check_fitted()
+        leaf = self._apply(x)                               # (T, mq_pad)
+        stats = jnp.take_along_axis(self._leaves, leaf[:, :, None], axis=1)
+        mean = stats[:, :, 1] / jnp.maximum(stats[:, :, 0], 1e-12)
+        pred = jnp.mean(mean, axis=0)[:, None]              # (mq_pad, 1)
+        return Array._from_logical_padded(
+            _repad(pred[: x.shape[0]], (x.shape[0], 1)), (x.shape[0], 1))
+
+    def score(self, x: Array, y: Array) -> float:
+        """R² (sklearn convention)."""
+        pred = self.predict(x).collect().ravel()
+        truth = np.asarray(y.collect()).ravel()
+        ss_res = float(np.sum((truth - pred) ** 2))
+        ss_tot = float(np.sum((truth - truth.mean()) ** 2))
+        return 1.0 - ss_res / max(ss_tot, 1e-12)
+
+
+class RandomForestClassifier(_ClassifierMixin, _BaseTreeEnsemble):
+    """Bootstrap ensemble of histogram decision trees (classification).
+
+    Parameters (reference parity; `distr_depth`, `sklearn_max` accepted and
+    ignored — see decision_tree module docstring)
+    ----------
+    n_estimators : int, default 10
+    try_features : 'sqrt' (default), 'third', int, or None (all features)
+    max_depth : int or np.inf — clamped to 12 (padded-array level cap).
+    hard_vote : bool, default False — majority of per-tree votes instead of
+        averaged probabilities.
+    random_state : int or None
+    """
+
+    def __init__(self, n_estimators=10, try_features="sqrt", max_depth=np.inf,
+                 distr_depth="auto", sklearn_max=1e8, hard_vote=False,
+                 random_state=None):
+        self.n_estimators = n_estimators
+        self.try_features = try_features
+        self.max_depth = max_depth
+        self.distr_depth = distr_depth
+        self.sklearn_max = sklearn_max
+        self.hard_vote = hard_vote
+        self.random_state = random_state
+
+    def fit(self, x: Array, y: Array):
+        stats = self._encode_labels(x, y)
+        return self._fit_forest(x, stats, self.n_estimators, bootstrap=True)
+
+
+class RandomForestRegressor(_RegressorMixin, _BaseTreeEnsemble):
+    """Bootstrap ensemble of histogram decision trees (regression).
+
+    Same knobs as :class:`RandomForestClassifier` minus `hard_vote`.
+    """
+
+    def __init__(self, n_estimators=10, try_features="sqrt", max_depth=np.inf,
+                 distr_depth="auto", sklearn_max=1e8, random_state=None):
+        self.n_estimators = n_estimators
+        self.try_features = try_features
+        self.max_depth = max_depth
+        self.distr_depth = distr_depth
+        self.sklearn_max = sklearn_max
+        self.random_state = random_state
+
+    def fit(self, x: Array, y: Array):
+        stats = self._encode_targets(x, y)
+        return self._fit_forest(x, stats, self.n_estimators, bootstrap=True)
+
+
+class DecisionTreeClassifier(_ClassifierMixin, _BaseTreeEnsemble):
+    """Single histogram decision tree (no bootstrap, all features)."""
+
+    def __init__(self, max_depth=np.inf, try_features=None, random_state=None):
+        self.max_depth = max_depth
+        self.try_features = try_features
+        self.random_state = random_state
+
+    def fit(self, x: Array, y: Array):
+        stats = self._encode_labels(x, y)
+        return self._fit_forest(x, stats, 1, bootstrap=False)
+
+
+class DecisionTreeRegressor(_RegressorMixin, _BaseTreeEnsemble):
+    """Single histogram regression tree (no bootstrap, all features)."""
+
+    def __init__(self, max_depth=np.inf, try_features=None, random_state=None):
+        self.max_depth = max_depth
+        self.try_features = try_features
+        self.random_state = random_state
+
+    def fit(self, x: Array, y: Array):
+        stats = self._encode_targets(x, y)
+        return self._fit_forest(x, stats, 1, bootstrap=False)

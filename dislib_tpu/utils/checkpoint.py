@@ -1,0 +1,56 @@
+"""Mid-fit checkpointing (SURVEY.md §6 "Failure detection / elastic
+recovery" + "Checkpoint / resume").
+
+The reference's fault tolerance is runtime-level (COMPSs resubmits failed
+tasks; `dislib/utils/saving.py` snapshots only *fitted* models).  On TPU a
+chip failure kills the whole SPMD job, so mid-fit checkpointing of the
+iteration state is first-class: iterative estimators (`KMeans`,
+`GaussianMixture`, `ALS`) accept ``checkpoint=FitCheckpoint(path, every=k)``
+and then run their device loop in k-iteration chunks, snapshotting the
+host-readable iteration state (centers / responsibilities stats / factors +
+iteration counter) after each chunk.  A re-run with the same checkpoint
+resumes from the snapshot and produces the same result as an uninterrupted
+fit (deterministic iterations) — asserted by the kill+resume fault-injection
+test (`tests/test_checkpoint.py`).
+
+Format: ``.npz`` written atomically (tmp file + rename), no pickle.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+class FitCheckpoint:
+    """Snapshot/restore of in-flight fit state.
+
+    Parameters
+    ----------
+    path : str — target ``.npz`` file.
+    every : int, default 10 — checkpoint every `every` iterations.
+    """
+
+    def __init__(self, path: str, every: int = 10):
+        self.path = str(path)
+        self.every = int(every)
+        if self.every < 1:
+            raise ValueError("every must be >= 1")
+
+    def save(self, state: dict) -> None:
+        """Atomically persist a dict of ndarrays/scalars."""
+        tmp = self.path + ".tmp.npz"      # np.savez wants an .npz suffix
+        np.savez(tmp, **state)
+        os.replace(tmp, self.path)
+
+    def load(self) -> dict | None:
+        """Return the saved state, or None if no checkpoint exists."""
+        if not os.path.exists(self.path):
+            return None
+        with np.load(self.path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    def delete(self) -> None:
+        if os.path.exists(self.path):
+            os.remove(self.path)

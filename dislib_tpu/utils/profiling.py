@@ -1,0 +1,55 @@
+"""Profiling / tracing (SURVEY.md §6 "Tracing / profiling").
+
+Reference mechanism: COMPSs `runcompss --tracing` LD_PRELOADs Extrae into
+master+workers and merges Paraver timelines; `--graph` dumps the task DAG.
+dislib code is unmodified — tracing hooks the runtime.
+
+TPU-native equivalent, same layering (estimator code stays unmodified, the
+profiler hooks the runtime):
+
+- `start_trace(logdir)` / `stop_trace()` / `trace(logdir)` — wrap
+  `jax.profiler`; produces XPlane/Perfetto timelines (per-op HLO, ICI
+  collectives) — the Paraver analog.
+- `annotate(name)` — `jax.named_scope` + `jax.profiler.TraceAnnotation`;
+  user-event markers on both the XLA op names and the host timeline — the
+  Extrae user-events analog.  Estimators wrap their phases with it.
+- `op_graph(fn, *args)` — compiled-HLO text of a jitted function — the
+  `--graph` task-DAG analog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def start_trace(logdir: str) -> None:
+    """Begin a profiler capture; view with TensorBoard/Perfetto."""
+    jax.profiler.start_trace(logdir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Context-managed capture: ``with dslib.utils.trace('/tmp/tb'): fit()``."""
+    start_trace(logdir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Mark a phase on both the device op names and the host trace timeline."""
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def op_graph(fn, *args, **kwargs) -> str:
+    """Compiled-HLO text of `fn(*args)` — the task-DAG dump analog."""
+    return jax.jit(fn).lower(*args, **kwargs).compile().as_text()

@@ -1,0 +1,112 @@
+"""Mid-fit checkpoint / kill+resume fault-injection tests (SURVEY.md §6
+"Failure detection / elastic recovery": kill a fit mid-way, resume from the
+snapshot, assert equivalence with an uninterrupted fit).
+
+The "kill" is simulated by running a fit whose max_iter stops it mid-way
+(the snapshot is what a preempted job would have on disk), then resuming
+with a fresh estimator pointed at the same checkpoint."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import KMeans, GaussianMixture
+from dislib_tpu.recommendation import ALS
+from dislib_tpu.utils import FitCheckpoint
+
+
+def _blobs(rng, n=200, d=4, k=3):
+    centers = rng.rand(k, d) * 10
+    x = np.vstack([centers[i] + 0.3 * rng.randn(n // k, d) for i in range(k)])
+    return x.astype(np.float32)
+
+
+class TestFitCheckpoint:
+    def test_save_load_roundtrip(self, tmp_path):
+        ck = FitCheckpoint(str(tmp_path / "s.npz"), every=2)
+        assert ck.load() is None
+        ck.save({"a": np.arange(5), "n": 3})
+        st = ck.load()
+        assert np.array_equal(st["a"], np.arange(5)) and int(st["n"]) == 3
+        ck.delete()
+        assert ck.load() is None
+
+    def test_bad_every(self, tmp_path):
+        with pytest.raises(ValueError):
+            FitCheckpoint(str(tmp_path / "s.npz"), every=0)
+
+
+class TestKillResume:
+    def test_kmeans_resume_equals_full(self, rng, tmp_path):
+        x_np = _blobs(rng)
+        x = ds.array(x_np)
+        init = np.ascontiguousarray(x_np[[0, 70, 140]])
+        full = KMeans(n_clusters=3, init=init, max_iter=12, tol=0.0).fit(x)
+
+        path = str(tmp_path / "km.npz")
+        # "killed" run: stops after 6 iterations, snapshot on disk
+        KMeans(n_clusters=3, init=init, max_iter=6, tol=0.0).fit(
+            x, checkpoint=FitCheckpoint(path, every=3))
+        # resume to completion with a fresh estimator
+        res = KMeans(n_clusters=3, init=init, max_iter=12, tol=0.0).fit(
+            x, checkpoint=FitCheckpoint(path, every=3))
+        assert res.n_iter_ == full.n_iter_
+        np.testing.assert_allclose(res.centers_, full.centers_, rtol=1e-5)
+
+    def test_kmeans_checkpointed_equals_plain(self, rng, tmp_path):
+        x_np = _blobs(rng, n=120)
+        x = ds.array(x_np)
+        init = np.ascontiguousarray(x_np[[0, 40, 80]])
+        plain = KMeans(n_clusters=3, init=init, max_iter=10, tol=1e-4).fit(x)
+        ck = KMeans(n_clusters=3, init=init, max_iter=10, tol=1e-4).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "k2.npz"), every=2))
+        np.testing.assert_allclose(ck.centers_, plain.centers_, rtol=1e-5)
+
+    def test_gmm_resume_converges_same(self, rng, tmp_path):
+        x = ds.array(_blobs(rng, n=150, d=3, k=2))
+        full = GaussianMixture(n_components=2, max_iter=40, tol=1e-6,
+                               random_state=0).fit(x)
+        path = str(tmp_path / "gm.npz")
+        GaussianMixture(n_components=2, max_iter=10, tol=1e-6,
+                        random_state=0).fit(
+            x, checkpoint=FitCheckpoint(path, every=5))
+        res = GaussianMixture(n_components=2, max_iter=40, tol=1e-6,
+                              random_state=0).fit(
+            x, checkpoint=FitCheckpoint(path, every=5))
+        assert res.converged_
+        assert res.lower_bound_ == pytest.approx(full.lower_bound_, rel=1e-4)
+        np.testing.assert_allclose(np.sort(res.means_, axis=0),
+                                   np.sort(full.means_, axis=0), atol=1e-2)
+
+    def test_als_resume_converges_same(self, rng, tmp_path):
+        u = rng.rand(30, 4).astype(np.float32)
+        v = rng.rand(20, 4).astype(np.float32)
+        r = (u @ v.T) * (rng.rand(30, 20) < 0.6)
+        x = ds.array(r.astype(np.float32))
+        full = ALS(n_f=4, max_iter=20, tol=1e-7, random_state=0).fit(x)
+        path = str(tmp_path / "als.npz")
+        ALS(n_f=4, max_iter=6, tol=1e-7, random_state=0).fit(
+            x, checkpoint=FitCheckpoint(path, every=3))
+        res = ALS(n_f=4, max_iter=20, tol=1e-7, random_state=0).fit(
+            x, checkpoint=FitCheckpoint(path, every=3))
+        assert res.rmse_ == pytest.approx(full.rmse_, abs=1e-4)
+
+
+class TestProfiling:
+    def test_annotate_and_op_graph(self, rng):
+        import jax.numpy as jnp
+        from dislib_tpu.utils import annotate, op_graph
+        with annotate("phase"):
+            pass
+        txt = op_graph(lambda a: a @ a, jnp.ones((8, 8)))
+        assert "dot" in txt or "fusion" in txt
+
+    def test_trace_writes_files(self, rng, tmp_path):
+        import jax.numpy as jnp
+        from dislib_tpu.utils import trace
+        d = str(tmp_path / "tb")
+        with trace(d):
+            (jnp.ones((16, 16)) @ jnp.ones((16, 16))).block_until_ready()
+        import os
+        found = [f for _, _, fs in os.walk(d) for f in fs]
+        assert found, "profiler wrote no trace files"

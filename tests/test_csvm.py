@@ -1,0 +1,78 @@
+"""CascadeSVM tests (reference: test_csvm.py — SURVEY.md §5 oracle pattern:
+accuracy vs sklearn SVC on the same data, convergence behavior, both
+kernels)."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.classification import CascadeSVM
+
+
+def _two_blobs(rng, n=200, d=4, sep=4.0):
+    a = rng.randn(n // 2, d).astype(np.float32)
+    b = (rng.randn(n // 2, d) + sep).astype(np.float32)
+    x = np.vstack([a, b])
+    y = np.r_[np.zeros(n // 2), np.ones(n // 2)].astype(np.float32)
+    p = rng.permutation(n)
+    return x[p], y[p]
+
+
+class TestCascadeSVM:
+    @pytest.mark.parametrize("kernel", ["rbf", "linear"])
+    def test_separable_blobs(self, rng, kernel):
+        x, y = _two_blobs(rng)
+        est = CascadeSVM(kernel=kernel, c=1.0, max_iter=5, random_state=0)
+        est.fit(ds.array(x, block_size=(50, 4)), ds.array(y[:, None]))
+        assert est.score(ds.array(x), ds.array(y[:, None])) >= 0.98
+        assert est.support_vectors_count_ >= 2
+
+    @pytest.mark.parametrize("kernel", ["rbf", "linear"])
+    def test_accuracy_vs_sklearn(self, rng, kernel):
+        from sklearn.svm import SVC
+        x, y = _two_blobs(rng, n=160, d=3, sep=2.0)   # overlapping-ish
+        est = CascadeSVM(kernel=kernel, c=1.0, max_iter=6, tol=1e-4,
+                         random_state=0)
+        est.fit(ds.array(x, block_size=(40, 3)), ds.array(y[:, None]))
+        mine = est.score(ds.array(x), ds.array(y[:, None]))
+        gamma = 1.0 / x.shape[1] if kernel == "rbf" else "scale"
+        sk = SVC(kernel=kernel, C=1.0, gamma=gamma).fit(x, y).score(x, y)
+        # K+1 bias augmentation ≠ libsvm's exact intercept: allow small slack
+        assert mine >= sk - 0.05
+
+    def test_decision_function_sign(self, rng):
+        x, y = _two_blobs(rng, n=100, d=2)
+        est = CascadeSVM(max_iter=3, random_state=0)
+        est.fit(ds.array(x), ds.array(y[:, None]))
+        dec = est.decision_function(ds.array(x)).collect().ravel()
+        pred = est.predict(ds.array(x)).collect().ravel()
+        assert np.array_equal(pred == est.classes_[1], dec > 0)
+
+    def test_converges_and_reports(self, rng):
+        x, y = _two_blobs(rng, n=120, d=3)
+        est = CascadeSVM(max_iter=10, tol=1e-2, check_convergence=True,
+                         random_state=0)
+        est.fit(ds.array(x, block_size=(30, 3)), ds.array(y[:, None]))
+        assert est.converged_
+        assert est.n_iter_ <= 10
+
+    def test_original_labels_preserved(self, rng):
+        x, y = _two_blobs(rng, n=80, d=2)
+        y_named = np.where(y > 0, 7.0, -3.0).astype(np.float32)
+        est = CascadeSVM(max_iter=3, random_state=0)
+        est.fit(ds.array(x), ds.array(y_named[:, None]))
+        pred = est.predict(ds.array(x)).collect().ravel()
+        assert set(np.unique(pred)) <= {-3.0, 7.0}
+        assert np.array_equal(est.classes_, [-3.0, 7.0])
+
+    def test_not_fitted_raises(self, rng):
+        with pytest.raises(RuntimeError):
+            CascadeSVM().decision_function(ds.array(rng.rand(4, 2)))
+
+    def test_bad_kernel_and_multiclass(self, rng):
+        x = ds.array(rng.rand(12, 2))
+        y3 = ds.array(np.arange(12.0)[:, None] % 3)
+        with pytest.raises(ValueError):
+            CascadeSVM(kernel="poly").fit(x, y3)
+        with pytest.raises(ValueError):
+            CascadeSVM().fit(x, y3)

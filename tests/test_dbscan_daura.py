@@ -1,0 +1,120 @@
+"""DBSCAN + Daura tests (reference: test_dbscan.py, test_daura.py —
+SURVEY.md §5 oracle pattern: compare vs sklearn / NumPy closed form,
+labels permutation-equivalent)."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import DBSCAN, Daura
+
+
+def _moons_like(rng, n=200):
+    """Two well-separated dense rings + far-away noise points."""
+    t = rng.rand(n // 2) * 2 * np.pi
+    c1 = np.c_[np.cos(t), np.sin(t)] + 0.05 * rng.randn(n // 2, 2)
+    c2 = np.c_[np.cos(t) + 6.0, np.sin(t)] + 0.05 * rng.randn(n // 2, 2)
+    noise = rng.rand(6, 2) * 2 + np.array([2.5, 4.0])
+    return np.vstack([c1, c2, noise]).astype(np.float32)
+
+
+def _canon(labels):
+    """Canonical form: relabel clusters by first occurrence (noise stays -1)."""
+    out = np.full_like(labels, -1)
+    nxt = 0
+    seen = {}
+    for i, v in enumerate(labels):
+        if v == -1:
+            continue
+        if v not in seen:
+            seen[v] = nxt
+            nxt += 1
+        out[i] = seen[v]
+    return out
+
+
+class TestDBSCAN:
+    def test_vs_sklearn(self, rng):
+        from sklearn.cluster import DBSCAN as SkDBSCAN
+        x = _moons_like(rng)
+        mine = DBSCAN(eps=0.4, min_samples=5).fit(ds.array(x))
+        sk = SkDBSCAN(eps=0.4, min_samples=5).fit(x)
+        assert mine.n_clusters_ == len(set(sk.labels_) - {-1})
+        # noise sets identical; core-point partitions permutation-equivalent
+        assert np.array_equal(mine.labels_ == -1, sk.labels_ == -1)
+        core = np.zeros(len(x), bool)
+        core[sk.core_sample_indices_] = True
+        assert np.array_equal(_canon(np.where(core, mine.labels_, -1)),
+                              _canon(np.where(core, sk.labels_, -1)))
+        assert np.array_equal(np.sort(mine.core_sample_indices_),
+                              np.sort(sk.core_sample_indices_))
+
+    def test_fit_predict_matches_labels(self, rng):
+        x = _moons_like(rng, n=80)
+        est = DBSCAN(eps=0.4, min_samples=4)
+        lab = est.fit_predict(ds.array(x)).collect().ravel().astype(int)
+        assert np.array_equal(lab, est.labels_)
+
+    def test_all_noise(self, rng):
+        x = (rng.rand(20, 3) * 100).astype(np.float32)
+        est = DBSCAN(eps=1e-3, min_samples=3).fit(ds.array(x))
+        assert est.n_clusters_ == 0
+        assert np.all(est.labels_ == -1)
+
+    def test_single_cluster(self, rng):
+        x = (rng.randn(30, 2) * 0.01).astype(np.float32)
+        est = DBSCAN(eps=1.0, min_samples=3).fit(ds.array(x))
+        assert est.n_clusters_ == 1
+        assert np.all(est.labels_ == 0)
+
+    def test_chain_cluster(self, rng):
+        # a long 1-D chain: worst case for label propagation depth
+        x = np.c_[np.arange(64) * 0.5, np.zeros(64)].astype(np.float32)
+        est = DBSCAN(eps=0.6, min_samples=2).fit(ds.array(x))
+        assert est.n_clusters_ == 1
+        assert np.all(est.labels_ == 0)
+
+
+def _np_daura(x, cutoff, n_atoms):
+    """NumPy oracle: greedy GROMOS clustering."""
+    d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1) / n_atoms
+    adj = d2 <= cutoff ** 2
+    active = np.ones(len(x), bool)
+    labels = np.full(len(x), -1)
+    medoids = []
+    cid = 0
+    while active.any():
+        counts = np.where(active, (adj & active[None, :]).sum(1), -1)
+        med = int(np.argmax(counts))
+        members = adj[med] & active
+        labels[members] = cid
+        medoids.append(med)
+        active &= ~members
+        cid += 1
+    return labels, medoids
+
+
+class TestDaura:
+    def test_vs_numpy_oracle(self, rng):
+        n_atoms = 4
+        x = (rng.randn(40, 3 * n_atoms) * 2).astype(np.float32)
+        cutoff = 3.0
+        est = Daura(cutoff=cutoff).fit(ds.array(x))
+        ref_labels, ref_medoids = _np_daura(x, cutoff, n_atoms)
+        assert np.array_equal(est.labels_, ref_labels)
+        assert [c[0] for c in est.clusters_] == ref_medoids
+
+    def test_cluster_membership(self, rng):
+        n_atoms = 2
+        # two tight bundles of frames
+        a = rng.randn(1, 6) + np.zeros((10, 6))
+        b = rng.randn(1, 6) + 50 + np.zeros((8, 6))
+        x = (np.vstack([a, b]) + 0.01 * rng.randn(18, 6)).astype(np.float32)
+        est = Daura(cutoff=1.0).fit(ds.array(x))
+        assert len(est.clusters_) == 2
+        assert {tuple(sorted(c)) for c in est.clusters_} == \
+            {tuple(range(10)), tuple(range(10, 18))}
+
+    def test_bad_shape(self, rng):
+        with pytest.raises(ValueError):
+            Daura().fit(ds.array(rng.rand(5, 7)))

@@ -1,0 +1,110 @@
+"""Trees tests (reference: test_rf_classifier.py, test_rf_regressor.py,
+test_decision_tree.py — SURVEY.md §5 oracle pattern: accuracy/R² vs sklearn
+on the same data)."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.trees import (
+    RandomForestClassifier, RandomForestRegressor,
+    DecisionTreeClassifier, DecisionTreeRegressor,
+)
+
+
+def _class_data(rng, n=300, d=6, k=3):
+    centers = rng.randn(k, d) * 3
+    x = np.vstack([centers[i] + rng.randn(n // k, d) * 0.7 for i in range(k)])
+    y = np.repeat(np.arange(k), n // k).astype(np.float32)
+    p = rng.permutation(len(y))
+    return x[p].astype(np.float32), y[p]
+
+
+def _reg_data(rng, n=300, d=5):
+    x = rng.rand(n, d).astype(np.float32) * 4
+    y = (np.sin(x[:, 0]) * 3 + x[:, 1] ** 2 - 2 * x[:, 2]).astype(np.float32)
+    return x, y
+
+
+class TestRandomForestClassifier:
+    def test_separable_accuracy(self, rng):
+        x, y = _class_data(rng)
+        rf = RandomForestClassifier(n_estimators=8, random_state=0)
+        rf.fit(ds.array(x), ds.array(y[:, None]))
+        assert rf.score(ds.array(x), ds.array(y[:, None])) >= 0.95
+
+    def test_vs_sklearn_holdout(self, rng):
+        from sklearn.ensemble import RandomForestClassifier as SkRF
+        x, y = _class_data(rng, n=400, d=5, k=2)
+        xt, yt = x[:300], y[:300]
+        xv, yv = x[300:], y[300:]
+        rf = RandomForestClassifier(n_estimators=10, random_state=0)
+        rf.fit(ds.array(xt), ds.array(yt[:, None]))
+        mine = rf.score(ds.array(xv), ds.array(yv[:, None]))
+        sk = SkRF(n_estimators=10, random_state=0).fit(xt, yt).score(xv, yv)
+        assert mine >= sk - 0.07
+
+    def test_hard_vote(self, rng):
+        x, y = _class_data(rng, n=150, d=4, k=2)
+        rf = RandomForestClassifier(n_estimators=5, hard_vote=True,
+                                    random_state=0)
+        rf.fit(ds.array(x), ds.array(y[:, None]))
+        assert rf.score(ds.array(x), ds.array(y[:, None])) >= 0.9
+
+    def test_predict_proba(self, rng):
+        x, y = _class_data(rng, n=120, d=4, k=3)
+        rf = RandomForestClassifier(n_estimators=4, random_state=0)
+        rf.fit(ds.array(x), ds.array(y[:, None]))
+        proba = rf.predict_proba(ds.array(x)).collect()
+        assert proba.shape == (120, 3)
+        assert np.allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+    def test_original_labels(self, rng):
+        x, y = _class_data(rng, n=90, d=3, k=2)
+        y2 = np.where(y > 0, 5.0, -2.0).astype(np.float32)
+        rf = RandomForestClassifier(n_estimators=3, random_state=0)
+        rf.fit(ds.array(x), ds.array(y2[:, None]))
+        pred = rf.predict(ds.array(x)).collect().ravel()
+        assert set(np.unique(pred)) <= {-2.0, 5.0}
+
+    def test_not_fitted(self, rng):
+        with pytest.raises(RuntimeError):
+            RandomForestClassifier().predict(ds.array(rng.rand(4, 2)))
+
+
+class TestRandomForestRegressor:
+    def test_r2_train(self, rng):
+        x, y = _reg_data(rng)
+        rf = RandomForestRegressor(n_estimators=8, random_state=0)
+        rf.fit(ds.array(x), ds.array(y[:, None]))
+        assert rf.score(ds.array(x), ds.array(y[:, None])) >= 0.8
+
+    def test_vs_sklearn_holdout(self, rng):
+        from sklearn.ensemble import RandomForestRegressor as SkRF
+        x, y = _reg_data(rng, n=400)
+        xt, yt, xv, yv = x[:300], y[:300], x[300:], y[300:]
+        rf = RandomForestRegressor(n_estimators=10, random_state=0)
+        rf.fit(ds.array(xt), ds.array(yt[:, None]))
+        mine = rf.score(ds.array(xv), ds.array(yv[:, None]))
+        sk = SkRF(n_estimators=10, random_state=0).fit(xt, yt).score(xv, yv)
+        assert mine >= sk - 0.15
+
+
+class TestDecisionTree:
+    def test_classifier_overfits_train(self, rng):
+        x, y = _class_data(rng, n=200, d=5, k=3)
+        dt = DecisionTreeClassifier(random_state=0)
+        dt.fit(ds.array(x), ds.array(y[:, None]))
+        assert dt.score(ds.array(x), ds.array(y[:, None])) >= 0.97
+
+    def test_regressor_fits_train(self, rng):
+        x, y = _reg_data(rng, n=200)
+        dt = DecisionTreeRegressor(random_state=0)
+        dt.fit(ds.array(x), ds.array(y[:, None]))
+        assert dt.score(ds.array(x), ds.array(y[:, None])) >= 0.9
+
+    def test_max_depth_limits(self, rng):
+        x, y = _class_data(rng, n=100, d=3, k=2)
+        dt = DecisionTreeClassifier(max_depth=2, random_state=0)
+        dt.fit(ds.array(x), ds.array(y[:, None]))
+        assert dt._depth == 2

@@ -59,6 +59,35 @@ class TestSaving:
         np.testing.assert_array_equal(km2.predict(a).collect(),
                                       km.predict(a).collect())
 
+    def test_roundtrip_private_state_estimators(self, rng, tmp_path):
+        """Estimators whose predictive state lives in leading-underscore
+        attrs (declared via _private_fitted_attrs) must predict identically
+        after a save/load round trip."""
+        from dislib_tpu.classification import CascadeSVM, KNeighborsClassifier
+        from dislib_tpu.trees import RandomForestClassifier
+        from dislib_tpu.neighbors import NearestNeighbors
+        x = rng.randn(80, 3).astype(np.float32)
+        x[40:] += 4.0
+        y = np.r_[np.zeros(40), np.ones(40)].astype(np.float32)
+        a, ya = ds.array(x), ds.array(y[:, None])
+        for est in (CascadeSVM(max_iter=2, random_state=0),
+                    RandomForestClassifier(n_estimators=3, random_state=0),
+                    KNeighborsClassifier(n_neighbors=3)):
+            est.fit(a, ya)
+            path = os.path.join(tmp_path, f"{type(est).__name__}.json")
+            ds.save_model(est, path)
+            est2 = ds.load_model(path)
+            np.testing.assert_array_equal(est2.predict(a).collect(),
+                                          est.predict(a).collect())
+        nn = NearestNeighbors(n_neighbors=2).fit(a)
+        path = os.path.join(tmp_path, "nn.json")
+        ds.save_model(nn, path)
+        nn2 = ds.load_model(path)
+        d1, i1 = nn.kneighbors(a)
+        d2, i2 = nn2.kneighbors(a)
+        np.testing.assert_allclose(d2.collect(), d1.collect(), atol=1e-5)
+        np.testing.assert_array_equal(i2.collect(), i1.collect())
+
     def test_no_overwrite(self, rng, tmp_path):
         km = KMeans(n_clusters=2).fit(ds.array(rng.rand(10, 2)))
         path = os.path.join(tmp_path, "m.json")
