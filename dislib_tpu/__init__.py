@@ -18,6 +18,7 @@ from dislib_tpu.data.array import (
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
 )
+from dislib_tpu.data.sparse import SparseArray
 from dislib_tpu.math import matmul, kron, svd, qr
 from dislib_tpu.decomposition import tsqr, random_svd, lanczos_svd, PCA
 from dislib_tpu.utils.base import shuffle, train_test_split
@@ -33,7 +34,7 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "get_mesh", "set_mesh",
     "Array", "array", "random_array", "zeros", "full", "ones", "identity",
-    "eye", "apply_along_axis", "concat_rows", "concat_cols",
+    "eye", "apply_along_axis", "concat_rows", "concat_cols", "SparseArray",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
     "save_txt",
     "matmul", "kron", "svd", "qr",

@@ -149,7 +149,9 @@ class CascadeSVM(BaseEstimator):
 
         self.iterations_n = self.n_iter_ = it
         self._sv_idx = sv_idx
-        self._sv_x = np.asarray(jax.device_get(x._data))[sv_idx, : n]
+        # gather SV rows on device, then fetch only those (not the dataset)
+        self._sv_x = np.asarray(jax.device_get(
+            x._data[jnp.asarray(sv_idx), : n]))
         self._sv_y = y_pm[sv_idx]
         self._gamma_fit = gamma
         self.support_vectors_count_ = len(sv_idx)

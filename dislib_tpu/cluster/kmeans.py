@@ -28,7 +28,8 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array, _pad_mask
+from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.data.sparse import SparseArray, _spmm, _spmm_t
 from dislib_tpu.ops import distances_sq as _distances_sq
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
@@ -65,7 +66,7 @@ class KMeans(BaseEstimator):
 
     # -- fitting -------------------------------------------------------------
 
-    def _init_centers(self, x: Array):
+    def _init_centers(self, x):
         k, n = self.n_clusters, x.shape[1]
         if isinstance(self.init, (np.ndarray, list)):
             c = np.asarray(self.init, dtype=np.float32)
@@ -77,7 +78,13 @@ class KMeans(BaseEstimator):
         rng = np.random.RandomState(self.random_state)
         # sample k distinct rows — the reference inits from data rows too
         idx = rng.choice(x.shape[0], size=min(k, x.shape[0]), replace=False)
-        rows = x[np.sort(idx), :]._data[: len(idx), : n]
+        if isinstance(x, SparseArray):
+            # gather rows as a selection product: (xᵀ @ selᵀ)ᵀ, one spmm
+            sel = np.zeros((len(idx), x.shape[0]), np.float32)
+            sel[np.arange(len(idx)), np.sort(idx)] = 1.0
+            rows = _spmm_t(x._bcoo, jnp.asarray(sel.T)).T
+        else:
+            rows = x[np.sort(idx), :]._data[: len(idx), : n]
         if len(idx) < k:  # fewer samples than clusters: top up with jitter
             extra = rows[rng.randint(0, len(idx), k - len(idx))] + 1e-3
             rows = jnp.concatenate([rows, extra], axis=0)
@@ -108,8 +115,16 @@ class KMeans(BaseEstimator):
                 min(checkpoint.every, self.max_iter - it)
             if chunk <= 0:
                 break
-            centers, n_done, inertia, shift = _kmeans_fit(
-                x._data, x.shape, centers, chunk, float(self.tol))
+            if isinstance(x, SparseArray):
+                centers, n_done, inertia, shift = _kmeans_fit_sparse(
+                    x._bcoo, x.row_norms_sq(), centers, chunk, float(self.tol))
+            elif _use_fused_estep(x):
+                centers, n_done, inertia, shift = _kmeans_fit_fused(
+                    x._data, x.shape, centers, chunk, float(self.tol),
+                    _mesh.get_mesh())
+            else:
+                centers, n_done, inertia, shift = _kmeans_fit(
+                    x._data, x.shape, centers, chunk, float(self.tol))
             it += int(n_done)
             done = float(shift) < self.tol
             if checkpoint is not None:
@@ -121,20 +136,30 @@ class KMeans(BaseEstimator):
         self.n_iter_ = it
         # inertia is None only when resuming an already-finished fit
         self.inertia_ = float(inertia) if inertia is not None else \
-            -float(_kmeans_score(x._data, x.shape, centers))
+            -self.score(x)
         return self
 
     def fit_predict(self, x: Array, y=None) -> Array:
         return self.fit(x).predict(x)
 
-    def predict(self, x: Array) -> Array:
+    def predict(self, x) -> Array:
         self._check_fitted()
+        if isinstance(x, SparseArray):
+            d = _sparse_distances(x._bcoo, x.row_norms_sq(),
+                                  jnp.asarray(self.centers_))
+            labels = jnp.argmin(d, axis=1).astype(jnp.float32)[:, None]
+            return Array._from_logical_padded(_repad(labels, (x.shape[0], 1)),
+                                              (x.shape[0], 1))
         labels = _kmeans_predict(x._data, x.shape, jnp.asarray(self.centers_))
         return Array._from_logical_padded(labels, (x.shape[0], 1))
 
-    def score(self, x: Array, y=None) -> float:
+    def score(self, x, y=None) -> float:
         """Negative inertia on x (sklearn convention)."""
         self._check_fitted()
+        if isinstance(x, SparseArray):
+            d = _sparse_distances(x._bcoo, x.row_norms_sq(),
+                                  jnp.asarray(self.centers_))
+            return -float(jnp.sum(jnp.min(d, axis=1)))
         return float(_kmeans_score(x._data, x.shape, jnp.asarray(self.centers_)))
 
     def _check_fitted(self):
@@ -145,6 +170,18 @@ class KMeans(BaseEstimator):
 # ---------------------------------------------------------------------------
 # device kernels
 # ---------------------------------------------------------------------------
+
+def _use_fused_estep(x) -> bool:
+    """Use the Pallas fused E-step on TPU (opt out: DSLIB_NO_PALLAS=1) when
+    each shard holds at least one full sublane of rows."""
+    import os
+    if os.environ.get("DSLIB_NO_PALLAS") == "1":
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    p = _mesh.get_mesh().shape[_mesh.ROWS]
+    return x._data.shape[0] % p == 0 and x._data.shape[0] // p >= 8
+
 
 @partial(jax.jit, static_argnames=("shape", "max_iter"))
 @precise
@@ -190,6 +227,102 @@ def _kmeans_predict(xp, shape, centers):
     valid = lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m
     labels = jnp.where(valid, labels, 0.0)
     return labels[:, None]
+
+
+@partial(jax.jit, static_argnames=("shape", "max_iter", "mesh", "interpret"))
+def _kmeans_fit_fused(xp, shape, centers0, max_iter, tol, mesh,
+                      interpret=False):
+    """Lloyd's with the Pallas fused E-step (`ops/kmeans_pallas.py`): one
+    pass over each shard's rows per iteration instead of the XLA path's two
+    GEMM passes — same `psum` communication structure, run explicitly in a
+    `shard_map` here because the kernel is opaque to the SPMD partitioner."""
+    from dislib_tpu.ops.kmeans_pallas import fused_estep
+
+    m, n = shape
+    k = centers0.shape[0]
+    n_pad = xp.shape[1]
+    k_pad = max(8, -(-k // 8) * 8)
+    c0 = jnp.zeros((k_pad, n_pad), xp.dtype)
+    c0 = lax.dynamic_update_slice(c0, centers0, (0, 0))
+    xp = lax.with_sharding_constraint(xp, _mesh.row_sharding(mesh))
+    p = mesh.shape[_mesh.ROWS]
+    mp_local = xp.shape[0] // p
+
+    def shard_fn(x_local):
+        offset = lax.axis_index(_mesh.ROWS) * mp_local
+        mvalid = jnp.clip(m - offset, 0, mp_local).astype(jnp.int32)
+        mvalid = mvalid.reshape(1, 1)
+
+        def step(carry):
+            centers, _, it, _ = carry
+            sums, counts, inertia = fused_estep(x_local, centers, mvalid, k,
+                                                interpret)
+            sums = lax.psum(sums, _mesh.ROWS)
+            counts = lax.psum(counts, _mesh.ROWS)[0]
+            inertia = lax.psum(inertia, _mesh.ROWS)
+            new_centers = jnp.where(counts[:, None] > 0,
+                                    sums / jnp.maximum(counts, 1.0)[:, None],
+                                    centers)
+            shift = jnp.sum((new_centers - centers) ** 2)
+            return new_centers, shift, it + 1, inertia
+
+        def cond(carry):
+            _, shift, it, _ = carry
+            return (it < max_iter) & (shift >= tol)
+
+        init = (c0, jnp.asarray(jnp.inf, xp.dtype), jnp.int32(0),
+                jnp.asarray(0.0, xp.dtype))
+        return lax.while_loop(cond, step, init)
+
+    from jax.sharding import PartitionSpec as P
+    # check_vma=False: every shard's psum-ed loop state is replicated in
+    # fact; the static varying-axes analysis can't see through pallas_call
+    centers, shift, n_iter, inertia = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=P(_mesh.ROWS, None),
+        out_specs=(P(), P(), P(), P()),
+        check_vma=False,
+    )(xp)
+    return centers[:k, :n], n_iter, inertia, shift
+
+
+def _sparse_distances(bcoo, rowsq, centers):
+    """Squared distances (m, k) with the cross-term as one spmm."""
+    c_sq = jnp.sum(centers * centers, axis=1)
+    cross = _spmm(bcoo, centers.T)
+    return jnp.maximum(rowsq[:, None] - 2.0 * cross + c_sq[None, :], 0.0)
+
+
+@partial(jax.jit, static_argnames=("max_iter",))
+@precise
+def _kmeans_fit_sparse(bcoo, rowsq, centers0, max_iter, tol):
+    """Sparse-path Lloyd's: same on-device while_loop as `_kmeans_fit`, with
+    the two GEMMs replaced by BCOO contractions (no padding — sparse arrays
+    are not mesh-padded; see `dislib_tpu/data/sparse.py`)."""
+    k = centers0.shape[0]
+
+    def step(carry):
+        centers, _, it, _ = carry
+        d = _sparse_distances(bcoo, rowsq, centers)
+        labels = jnp.argmin(d, axis=1)
+        onehot = jax.nn.one_hot(labels, k, dtype=centers.dtype)
+        sums = _spmm_t(bcoo, onehot).T               # (k, n)
+        counts = jnp.sum(onehot, axis=0)
+        new_centers = jnp.where(counts[:, None] > 0,
+                                sums / jnp.maximum(counts, 1.0)[:, None],
+                                centers)
+        shift = jnp.sum((new_centers - centers) ** 2)
+        inertia = jnp.sum(jnp.min(d, axis=1))
+        return new_centers, shift, it + 1, inertia
+
+    def cond(carry):
+        _, shift, it, _ = carry
+        return (it < max_iter) & (shift >= tol)
+
+    init = (centers0, jnp.asarray(jnp.inf, centers0.dtype), jnp.int32(0),
+            jnp.asarray(0.0, centers0.dtype))
+    centers, shift, n_iter, inertia = lax.while_loop(cond, step, init)
+    return centers, n_iter, inertia, shift
 
 
 @partial(jax.jit, static_argnames=("shape",))

@@ -5,10 +5,11 @@ from dislib_tpu.data.array import (
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
 )
+from dislib_tpu.data.sparse import SparseArray
 
 __all__ = [
     "Array", "array", "random_array", "zeros", "full", "ones", "identity",
     "eye", "apply_along_axis", "concat_rows", "concat_cols",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
-    "save_txt",
+    "save_txt", "SparseArray",
 ]

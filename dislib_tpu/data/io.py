@@ -64,7 +64,8 @@ def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True
             dense[i, k - 1] = v  # svmlight is 1-indexed
     if store_sparse:
         import scipy.sparse as sp
-        x = _ds_array(sp.csr_matrix(dense), block_size=block_size)
+        from dislib_tpu.data.sparse import SparseArray
+        x = SparseArray.from_scipy(sp.csr_matrix(dense), block_size=block_size)
     else:
         x = _ds_array(dense, block_size=block_size)
     y = _ds_array(np.asarray(labels, dtype=np.float32).reshape(-1, 1),

@@ -1,0 +1,169 @@
+"""Sparse ds-array — BCOO-backed storage (SURVEY.md §8 "Sparse support":
+"TPU has no general CSR.  BCOO matvec covers ALS/svmlight ingestion;
+dense-with-mask is the fallback; this decision gates ALS and sparse
+KMeans/CSVM parity").
+
+Reference capability: ds-array blocks may be SciPy CSR matrices
+(`dislib/data/array.py`, `_sparse=True`); KMeans/CSVM/svmlight ingestion
+accept them and per-block NumPy kernels dispatch to scipy.sparse ops.
+
+TPU-native design and its honest limits:
+
+- Storage is one `jax.experimental.sparse.BCOO` on device — O(nnz) memory,
+  the role CSR plays for the reference.  No padding is needed: sparse
+  compute is not mesh-sharded in this build (BCOO's ragged buffers do not
+  shard cleanly over a Mesh); products against dense operands materialise
+  MXU-shaped dense results which ARE placed with the library sharding.
+  Row-sharded BCOO (per-shard nnz balancing) is future work.
+- Per-estimator choice (recorded as SURVEY §8 directs):
+  * KMeans — native sparse path (`fit`/`predict` accept SparseArray; the
+    distance cross-term and the per-cluster sums are `bcoo_dot_general`
+    contractions).
+  * ALS — dense-with-mask (see `recommendation/als.py`: a zero rating IS
+    the mask; the normal-equation GEMMs need the dense mask anyway).
+  * CascadeSVM / trees / others — densify (`to_dense()`); same stance as
+    the reference's per-block `.toarray()` escape hatches.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from dislib_tpu.data.array import Array
+from dislib_tpu.ops.base import precise
+
+__all__ = ["SparseArray"]
+
+
+class SparseArray:
+    """A 2-D sparse matrix on device, BCOO-backed (the CSR-block role)."""
+
+    def __init__(self, bcoo: jsparse.BCOO, reg_shape=None):
+        self._bcoo = bcoo
+        self._shape = (int(bcoo.shape[0]), int(bcoo.shape[1]))
+        self._reg_shape = reg_shape or self._shape
+        self._sparse = True
+        self._dense_cache = None
+
+    @property
+    def _data(self):
+        """Lazy padded dense backing — the reference's per-block
+        ``.toarray()`` escape hatch, so every non-sparse-aware estimator
+        transparently accepts a SparseArray (at densification memory cost).
+        Sparse-aware paths (KMeans) dispatch on the type before touching
+        this."""
+        if self._dense_cache is None:
+            self._dense_cache = self.to_dense()._data
+        return self._dense_cache
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def from_scipy(cls, mat, block_size=None) -> "SparseArray":
+        coo = mat.tocoo()
+        data = jnp.asarray(coo.data.astype(np.float32))
+        idx = jnp.asarray(np.stack([coo.row, coo.col], axis=1).astype(np.int32))
+        bcoo = jsparse.BCOO((data, idx), shape=mat.shape)
+        return cls(bcoo, reg_shape=block_size)
+
+    @classmethod
+    def from_dense(cls, x, block_size=None) -> "SparseArray":
+        x = np.asarray(x, dtype=np.float32)
+        return cls(jsparse.BCOO.fromdense(jnp.asarray(x)), reg_shape=block_size)
+
+    # -- metadata ------------------------------------------------------------
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    @property
+    def block_size(self):
+        return self._reg_shape
+
+    def __repr__(self):
+        return (f"dslib.SparseArray(shape={self._shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    # -- sync / conversion ---------------------------------------------------
+
+    def collect(self):
+        """Materialise as scipy CSR on host (reference sparse collect)."""
+        import scipy.sparse as sp
+        data = np.asarray(jax.device_get(self._bcoo.data))
+        idx = np.asarray(jax.device_get(self._bcoo.indices))
+        return sp.csr_matrix((data, (idx[:, 0], idx[:, 1])), shape=self._shape)
+
+    def to_dense(self) -> Array:
+        """Densify onto the mesh (the reference's `.toarray()` escape hatch)."""
+        return Array._from_logical(self._bcoo.todense())
+
+    # -- ops -----------------------------------------------------------------
+
+    def transpose(self) -> "SparseArray":
+        return SparseArray(self._bcoo.T, reg_shape=(self._reg_shape[1],
+                                                    self._reg_shape[0]))
+
+    @property
+    def T(self) -> "SparseArray":
+        return self.transpose()
+
+    def __matmul__(self, other):
+        """sparse @ dense → dense Array (one bcoo_dot_general, MXU-lowered)."""
+        if isinstance(other, Array):
+            rhs = other._data[: other.shape[0], : other.shape[1]]
+        else:
+            rhs = jnp.asarray(np.asarray(other, dtype=np.float32))
+        if self._shape[1] != rhs.shape[0]:
+            raise ValueError(f"matmul shape mismatch {self._shape} @ {rhs.shape}")
+        out = _spmm(self._bcoo, rhs)
+        return Array._from_logical(out)
+
+    def sum(self, axis=0) -> Array:
+        if axis not in (0, 1, None):
+            raise ValueError("axis must be 0, 1 or None")
+        data, idx = self._bcoo.data, self._bcoo.indices
+        if axis is None:
+            return Array._from_logical(jnp.sum(data).reshape(1, 1))
+        keep = 1 - axis                     # reduce over `axis`, group by the other
+        segs = jax.ops.segment_sum(data, idx[:, keep],
+                                   num_segments=self._shape[keep])
+        out = segs.reshape(1, -1) if axis == 0 else segs.reshape(-1, 1)
+        return Array._from_logical(out)
+
+    def mean(self, axis=0) -> Array:
+        denom = self._shape[0] if axis == 0 else \
+            self._shape[1] if axis == 1 else self._shape[0] * self._shape[1]
+        return self.sum(axis) * (1.0 / denom)
+
+    def row_norms_sq(self):
+        """Device vector of per-row ‖x_i‖² (KMeans distance term)."""
+        data, idx = self._bcoo.data, self._bcoo.indices
+        return jax.ops.segment_sum(data * data, idx[:, 0],
+                                   num_segments=self._shape[0])
+
+
+@jax.jit
+@precise
+def _spmm(bcoo, rhs):
+    return jsparse.bcoo_dot_general(
+        bcoo, rhs, dimension_numbers=(([1], [0]), ([], [])))
+
+
+@jax.jit
+@precise
+def _spmm_t(bcoo, rhs):
+    """xᵀ @ rhs for a BCOO x: contract over the row dimension → (n, k)."""
+    return jsparse.bcoo_dot_general(
+        bcoo, rhs, dimension_numbers=(([0], [0]), ([], [])))

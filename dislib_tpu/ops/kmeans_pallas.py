@@ -1,0 +1,115 @@
+"""Fused KMeans E-step as a Pallas TPU kernel (SURVEY.md §8: "Pallas only
+where XLA fusion measurably falls short (candidate: KMeans E-step fused
+distance/argmin/scatter-add)").
+
+Why a kernel: the XLA path runs TWO passes over the row-sharded data per
+Lloyd iteration — the distance GEMM (reads x) and the per-cluster-sum GEMM
+``onehotᵀ @ x`` (reads x again) — so at 1M×100/k=10 the iteration is HBM-
+bound at ~2 dataset reads/iter.  This kernel streams each row tile through
+VMEM ONCE: distances, masked argmin (as a first-occurrence one-hot),
+per-cluster partial sums, counts and inertia all come out of the single
+pass, halving HBM traffic.  Accumulation exploits the sequential TPU grid:
+every grid step revisits the same output block (constant index_map) and
+adds its tile's partials.
+
+The kernel is single-shard compute; `cluster.kmeans._kmeans_fit_fused` runs
+it per shard inside `shard_map` and combines partials with `lax.psum` —
+identical communication structure to the XLA path.  `centers` must fit VMEM
+(k_pad·n_pad floats).  Off-TPU the caller uses the XLA path;
+``interpret=True`` runs the same kernel in the interpreter for CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# row-tile height: 64 × the f32 sublane quantum; 512×128 f32 = 256 KB VMEM
+TILE_M = 512
+
+
+def _estep_kernel(mvalid_ref, x_ref, c_ref, sums_ref, counts_ref, stats_ref,
+                  *, k, tile_m):
+    """One row tile: distances → one-hot argmin → partial (Σx, count, inertia).
+
+    mvalid_ref (SMEM, (1,1)): number of valid rows in THIS shard — rows at or
+    beyond it are padding and carry weight 0."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        stats_ref[:] = jnp.zeros_like(stats_ref)
+
+    row = i * tile_m + lax.broadcasted_iota(jnp.int32, (tile_m, 1), 0)
+    valid = (row < mvalid_ref[0, 0]).astype(jnp.float32)   # (TILE_M, 1)
+    x = x_ref[:] * valid                            # zero padded rows: no NaNs
+    c = c_ref[:]                                    # (k_pad, n_pad)
+    x_sq = jnp.sum(x * x, axis=1, keepdims=True)
+    c_sq = jnp.sum(c * c, axis=1)
+    cross = lax.dot_general(x, c, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32,
+                            precision=lax.Precision.HIGHEST)
+    d = jnp.maximum(x_sq - 2.0 * cross + c_sq[None, :], 0.0)
+
+    # padded center slots can never win the argmin
+    col = lax.broadcasted_iota(jnp.int32, d.shape, 1)
+    d = jnp.where(col < k, d, jnp.inf)
+
+    d_min = jnp.min(d, axis=1, keepdims=True)
+    # one-hot of the LOWEST index achieving the min (argmin tie-break),
+    # without cumsum (not lowerable on TPU Pallas): take the min column
+    # index among the argmin ties
+    am = jnp.min(jnp.where(d == d_min, col, d.shape[1]), axis=1,
+                 keepdims=True)
+    onehot = (col == am).astype(jnp.float32) * valid
+
+    sums_ref[:] += lax.dot_general(onehot, x, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32,
+                                   precision=lax.Precision.HIGHEST)
+    counts_ref[:] += jnp.sum(onehot, axis=0)[None, :]
+    stats_ref[0, 0] += jnp.sum(d_min * valid)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def fused_estep(x_local, centers_pad, mvalid, k, interpret=False):
+    """One fused E-step pass over this shard's rows (m_local, n_pad).
+
+    centers_pad: (k_pad, n_pad); mvalid: int32 (1, 1) — valid-row count.
+    Returns (sums (k_pad, n_pad), counts (1, k_pad), inertia scalar)."""
+    m_local, n_pad = x_local.shape
+    k_pad = centers_pad.shape[0]
+    tile = min(TILE_M, m_local)
+    grid = pl.cdiv(m_local, tile)
+    kernel = functools.partial(_estep_kernel, k=k, tile_m=tile)
+    sums, counts, stats = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, n_pad), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((k_pad, n_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((k_pad, n_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, k_pad), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((k_pad, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, k_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(mvalid, x_local, centers_pad)
+    return sums, counts, stats[0, 0]

@@ -4,8 +4,12 @@ from dislib_tpu.parallel.mesh import (
     ROWS, COLS, init, get_mesh, set_mesh, mesh_shape, pad_quantum,
     data_sharding, row_sharding, replicated,
 )
+from dislib_tpu.parallel.distributed import (
+    initialize, is_initialized, process_info, shutdown,
+)
 
 __all__ = [
     "ROWS", "COLS", "init", "get_mesh", "set_mesh", "mesh_shape",
     "pad_quantum", "data_sharding", "row_sharding", "replicated",
+    "initialize", "is_initialized", "process_info", "shutdown",
 ]

@@ -1,0 +1,74 @@
+"""Multi-host initialisation — the `runcompss` / COMPSs-resources analog
+(SURVEY.md §3.7 "Distributed communication backend" and §6 "Config / flag
+system").
+
+The reference describes cluster topology in COMPSs XML resource files and
+starts the job through `runcompss`/`enqueue_compss`; the Java runtime then
+wires master↔worker sockets.  TPU-native, the whole of that stack is
+`jax.distributed.initialize`: one controller process per host joins a GRPC
+coordinator, after which `jax.devices()` spans every host and XLA
+collectives ride ICI within a slice and DCN across hosts/slices.
+
+Usage (per host)::
+
+    import dislib_tpu as ds
+    ds.parallel.initialize(coordinator_address="host0:8476",
+                           num_processes=4, process_id=rank)
+    ds.init()          # mesh over ALL hosts' devices; 'rows' axis spans DCN
+
+On a single process (or under a TPU runtime that auto-detects, e.g. GKE
+with megascale env vars) every argument may be omitted.  Keep reductions
+hierarchical by putting the host-spanning dimension on the mesh 'rows'
+axis — `init()`'s device order already groups each host's local devices
+contiguously, so a (n_hosts·local, 1) mesh reduces ICI-first, DCN-second.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None,
+               local_device_ids=None) -> None:
+    """Join (or form) the multi-host job.  Arguments default to the
+    ``DSLIB_COORDINATOR`` / ``DSLIB_NUM_PROCS`` / ``DSLIB_PROC_ID`` env vars
+    (the launch-script interface, replacing the reference's XML files), then
+    to JAX's own auto-detection.  No-op if already initialised or if neither
+    arguments nor env vars request a multi-process job."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("DSLIB_COORDINATOR")
+    if num_processes is None and "DSLIB_NUM_PROCS" in os.environ:
+        num_processes = int(os.environ["DSLIB_NUM_PROCS"])
+    if process_id is None and "DSLIB_PROC_ID" in os.environ:
+        process_id = int(os.environ["DSLIB_PROC_ID"])
+    if coordinator_address is None and num_processes is None:
+        return  # single-process job: nothing to join
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id,
+                               local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) of this controller."""
+    return jax.process_index(), jax.process_count()
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False

@@ -239,12 +239,18 @@ class _BaseTreeEnsemble(BaseEstimator):
         leaves = _leaf_stats(node, w, stats, 2 ** depth)
         self._edges = edges
         # pad the ragged per-level (T, 2^lvl) arrays to (T, depth, 2^(depth-1))
-        # once here, so predict calls are a single gather-walk jit
+        # once here, so predict calls are a single gather-walk jit.  Done in
+        # NumPy on host: the arrays are tiny and this avoids ~2·depth one-off
+        # eagerly-dispatched pad/stack programs per fit.
         wide = 2 ** (depth - 1)
-        self._feats = jnp.stack([jnp.pad(f, ((0, 0), (0, wide - f.shape[1])))
-                                 for f in feats], axis=1)
-        self._tbins = jnp.stack([jnp.pad(t, ((0, 0), (0, wide - t.shape[1])))
-                                 for t in tbins], axis=1)
+
+        def _pack(levels):
+            host = [np.asarray(jax.device_get(a)) for a in levels]
+            return np.stack([np.pad(a, ((0, 0), (0, wide - a.shape[1])))
+                             for a in host], axis=1)
+
+        self._feats = _pack(feats)
+        self._tbins = _pack(tbins)
         self._depth = depth
         self._leaves = leaves                          # (T, 2^depth, S)
         self.n_features_ = n

@@ -1,0 +1,42 @@
+"""Pallas fused-E-step equivalence tests (SURVEY.md §8: Pallas only where
+XLA fusion falls short — the fused kernel must be a drop-in for the XLA
+path).  Runs the SAME kernel in interpreter mode on the 8-device CPU mesh;
+the real-TPU path is exercised by bench.py and the TPU test run."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster.kmeans import _kmeans_fit, _kmeans_fit_fused
+from dislib_tpu.parallel import mesh as _mesh
+
+
+@pytest.mark.parametrize("m,n,k", [(64, 8, 3), (100, 5, 4)])
+def test_fused_fit_matches_xla_path(rng, m, n, k):
+    x = ds.array((rng.rand(m, n) * 5).astype(np.float32))
+    import jax.numpy as jnp
+    centers0 = jnp.asarray(np.ascontiguousarray(
+        x.collect()[rng.choice(m, k, replace=False)]))
+    ref_c, ref_it, ref_inertia, ref_shift = _kmeans_fit(
+        x._data, x.shape, centers0, 10, 1e-6)
+    fus_c, fus_it, fus_inertia, fus_shift = _kmeans_fit_fused(
+        x._data, x.shape, centers0, 10, 1e-6, _mesh.get_mesh(),
+        interpret=True)
+    assert int(fus_it) == int(ref_it)
+    np.testing.assert_allclose(np.asarray(fus_c), np.asarray(ref_c),
+                               rtol=1e-4, atol=1e-5)
+    assert float(fus_inertia) == pytest.approx(float(ref_inertia), rel=1e-4)
+
+
+def test_fused_estep_partial_tile(rng):
+    """Row count not divisible by the tile/mesh quantum: padded rows must
+    carry weight zero."""
+    m, n, k = 72, 6, 2          # 72 rows over 8 shards = 9 per shard
+    x = ds.array((rng.rand(m, n) + 1).astype(np.float32))
+    import jax.numpy as jnp
+    centers0 = jnp.asarray(np.ascontiguousarray(x.collect()[[0, 40]]))
+    ref = _kmeans_fit(x._data, x.shape, centers0, 5, 0.0)
+    fus = _kmeans_fit_fused(x._data, x.shape, centers0, 5, 0.0,
+                            _mesh.get_mesh(), interpret=True)
+    np.testing.assert_allclose(np.asarray(fus[0]), np.asarray(ref[0]),
+                               rtol=1e-4, atol=1e-5)

@@ -1,0 +1,52 @@
+"""Mesh + multi-host init tests (SURVEY.md §3.7/§6: topology is a named
+Mesh; `initialize` is the runcompss analog — single-process path must be a
+clean no-op)."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu import parallel
+
+
+class TestDistributedInit:
+    def test_single_process_noop(self, monkeypatch):
+        monkeypatch.delenv("DSLIB_COORDINATOR", raising=False)
+        monkeypatch.delenv("DSLIB_NUM_PROCS", raising=False)
+        parallel.initialize()            # no args, no env: must not raise
+        assert not parallel.is_initialized()
+
+    def test_process_info_single(self):
+        idx, cnt = parallel.process_info()
+        assert (idx, cnt) == (0, 1)
+
+
+class TestMesh:
+    def test_default_mesh_spans_devices(self):
+        import jax
+        ds.init()
+        r, c = parallel.mesh_shape()
+        assert r * c == len(jax.devices())
+
+    def test_explicit_shape_and_quantum(self):
+        ds.init((2, 4))
+        assert parallel.mesh_shape() == (2, 4)
+        assert parallel.pad_quantum() == 4
+        ds.init((4, 2))
+        assert parallel.pad_quantum() == 4
+
+    def test_env_mesh(self, monkeypatch):
+        monkeypatch.setenv("DSLIB_MESH", "2,2")
+        ds.init()
+        assert parallel.mesh_shape() == (2, 2)
+
+    def test_too_many_devices_raises(self):
+        with pytest.raises(ValueError):
+            ds.init((100, 100))
+
+    def test_library_does_not_touch_global_precision(self):
+        import jax
+        before = jax.config.jax_default_matmul_precision
+        x = ds.random_array((32, 8), random_state=0)
+        ds.cluster.KMeans(n_clusters=2, random_state=0).fit(x)
+        assert jax.config.jax_default_matmul_precision == before

@@ -1,0 +1,109 @@
+"""Sparse ds-array tests (reference: sparse CSR block variants across
+test_array/test_kmeans — SURVEY.md §5 "sparse/dense variants ... catch the
+most bugs"; §8 sparse-support decision record in data/sparse.py)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import KMeans
+from dislib_tpu.data.sparse import SparseArray
+
+
+def _rand_csr(rng, m=40, n=12, density=0.2):
+    return sp.random(m, n, density=density, format="csr",
+                     random_state=rng, dtype=np.float32)
+
+
+class TestSparseArray:
+    def test_roundtrip_collect(self, rng):
+        mat = _rand_csr(rng)
+        a = SparseArray.from_scipy(mat)
+        got = a.collect()
+        assert sp.issparse(got)
+        np.testing.assert_allclose(got.toarray(), mat.toarray(), rtol=1e-6)
+        assert a.nnz == mat.nnz
+        assert a.shape == mat.shape
+
+    def test_to_dense_matches(self, rng):
+        mat = _rand_csr(rng)
+        dense = SparseArray.from_scipy(mat).to_dense()
+        np.testing.assert_allclose(dense.collect(), mat.toarray(), rtol=1e-6)
+
+    def test_matmul_dense_oracle(self, rng):
+        mat = _rand_csr(rng, m=30, n=10)
+        rhs = rng.rand(10, 7).astype(np.float32)
+        out = SparseArray.from_scipy(mat) @ ds.array(rhs)
+        np.testing.assert_allclose(out.collect(), mat.toarray() @ rhs,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_matmul_shape_mismatch(self, rng):
+        with pytest.raises(ValueError):
+            SparseArray.from_scipy(_rand_csr(rng, m=5, n=3)) @ np.ones((4, 2))
+
+    def test_transpose(self, rng):
+        mat = _rand_csr(rng, m=9, n=5)
+        t = SparseArray.from_scipy(mat).T
+        assert t.shape == (5, 9)
+        np.testing.assert_allclose(t.collect().toarray(), mat.toarray().T,
+                                   rtol=1e-6)
+
+    def test_sums_and_means(self, rng):
+        mat = _rand_csr(rng, m=15, n=6)
+        a = SparseArray.from_scipy(mat)
+        dense = mat.toarray()
+        np.testing.assert_allclose(a.sum(axis=0).collect().ravel(),
+                                   dense.sum(axis=0), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(a.sum(axis=1).collect().ravel(),
+                                   dense.sum(axis=1), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(a.mean(axis=None).collect().ravel(),
+                                   [dense.mean()], rtol=1e-5)
+
+    def test_row_norms(self, rng):
+        mat = _rand_csr(rng, m=12, n=8)
+        got = np.asarray(SparseArray.from_scipy(mat).row_norms_sq())
+        np.testing.assert_allclose(got, (mat.toarray() ** 2).sum(axis=1),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestSparseKMeans:
+    def test_sparse_fit_matches_dense(self, rng):
+        # block-structured sparse blobs
+        dense = np.zeros((90, 10), np.float32)
+        dense[:45, :5] = rng.rand(45, 5) + 2
+        dense[45:, 5:] = rng.rand(45, 5) + 2
+        init = np.ascontiguousarray(dense[[0, 60]])
+        km_d = KMeans(n_clusters=2, init=init, max_iter=20).fit(ds.array(dense))
+        km_s = KMeans(n_clusters=2, init=init, max_iter=20).fit(
+            SparseArray.from_scipy(sp.csr_matrix(dense)))
+        np.testing.assert_allclose(km_s.centers_, km_d.centers_,
+                                   rtol=1e-4, atol=1e-5)
+        assert km_s.n_iter_ == km_d.n_iter_
+        assert km_s.inertia_ == pytest.approx(km_d.inertia_, rel=1e-4)
+
+    def test_sparse_predict_and_random_init(self, rng):
+        dense = np.zeros((60, 8), np.float32)
+        dense[:30, :4] = rng.rand(30, 4) + 3
+        dense[30:, 4:] = rng.rand(30, 4) + 3
+        sx = SparseArray.from_scipy(sp.csr_matrix(dense))
+        km = KMeans(n_clusters=2, random_state=0, max_iter=20).fit(sx)
+        labels = km.predict(sx).collect().ravel().astype(int)
+        assert len(np.unique(labels[:30])) == 1
+        assert len(np.unique(labels[30:])) == 1
+        assert labels[0] != labels[-1]
+        assert km.score(sx) <= 0.0
+
+
+class TestSvmlightSparse:
+    def test_loader_returns_sparse(self, tmp_path):
+        path = str(tmp_path / "data.svm")
+        with open(path, "w") as f:
+            f.write("1 1:0.5 3:2.0\n0 2:1.5\n1 1:1.0 2:0.5 3:0.25\n")
+        x, y = ds.load_svmlight_file(path, n_features=3, store_sparse=True)
+        assert isinstance(x, SparseArray)
+        got = x.collect().toarray()
+        want = np.array([[0.5, 0, 2.0], [0, 1.5, 0], [1.0, 0.5, 0.25]],
+                        np.float32)
+        np.testing.assert_allclose(got, want)
+        np.testing.assert_allclose(y.collect().ravel(), [1, 0, 1])
