@@ -16,6 +16,12 @@ TPU-native redesign (the survey's §4.2 TPU mapping, verbatim):
   emits as a `psum` over ICI.  The `arity` knob is gone: reduction topology
   belongs to the compiler (SURVEY §6).
 - Padded (zero) rows carry weight 0 so they never perturb sums or counts.
+- A Pallas fused E-step kernel was built and benchmarked in round 2 (single
+  pass over x per iteration vs the XLA path's two GEMM reads): 105-111
+  iter/s across tile sizes 512-4096 vs 124 iter/s for this XLA path on the
+  1M×100 k=10 north star (TPU v5e).  XLA's own fusion already wins, so the
+  kernel was deleted (SURVEY §8: "Pallas only where XLA fusion MEASURABLY
+  falls short").
 """
 
 from __future__ import annotations
@@ -118,10 +124,6 @@ class KMeans(BaseEstimator):
             if isinstance(x, SparseArray):
                 centers, n_done, inertia, shift = _kmeans_fit_sparse(
                     x._bcoo, x.row_norms_sq(), centers, chunk, float(self.tol))
-            elif _use_fused_estep(x):
-                centers, n_done, inertia, shift = _kmeans_fit_fused(
-                    x._data, x.shape, centers, chunk, float(self.tol),
-                    _mesh.get_mesh())
             else:
                 centers, n_done, inertia, shift = _kmeans_fit(
                     x._data, x.shape, centers, chunk, float(self.tol))
@@ -171,18 +173,6 @@ class KMeans(BaseEstimator):
 # device kernels
 # ---------------------------------------------------------------------------
 
-def _use_fused_estep(x) -> bool:
-    """Use the Pallas fused E-step on TPU (opt out: DSLIB_NO_PALLAS=1) when
-    each shard holds at least one full sublane of rows."""
-    import os
-    if os.environ.get("DSLIB_NO_PALLAS") == "1":
-        return False
-    if jax.default_backend() != "tpu":
-        return False
-    p = _mesh.get_mesh().shape[_mesh.ROWS]
-    return x._data.shape[0] % p == 0 and x._data.shape[0] // p >= 8
-
-
 @partial(jax.jit, static_argnames=("shape", "max_iter"))
 @precise
 def _kmeans_fit(xp, shape, centers0, max_iter, tol):
@@ -227,63 +217,6 @@ def _kmeans_predict(xp, shape, centers):
     valid = lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m
     labels = jnp.where(valid, labels, 0.0)
     return labels[:, None]
-
-
-@partial(jax.jit, static_argnames=("shape", "max_iter", "mesh", "interpret"))
-def _kmeans_fit_fused(xp, shape, centers0, max_iter, tol, mesh,
-                      interpret=False):
-    """Lloyd's with the Pallas fused E-step (`ops/kmeans_pallas.py`): one
-    pass over each shard's rows per iteration instead of the XLA path's two
-    GEMM passes — same `psum` communication structure, run explicitly in a
-    `shard_map` here because the kernel is opaque to the SPMD partitioner."""
-    from dislib_tpu.ops.kmeans_pallas import fused_estep
-
-    m, n = shape
-    k = centers0.shape[0]
-    n_pad = xp.shape[1]
-    k_pad = max(8, -(-k // 8) * 8)
-    c0 = jnp.zeros((k_pad, n_pad), xp.dtype)
-    c0 = lax.dynamic_update_slice(c0, centers0, (0, 0))
-    xp = lax.with_sharding_constraint(xp, _mesh.row_sharding(mesh))
-    p = mesh.shape[_mesh.ROWS]
-    mp_local = xp.shape[0] // p
-
-    def shard_fn(x_local):
-        offset = lax.axis_index(_mesh.ROWS) * mp_local
-        mvalid = jnp.clip(m - offset, 0, mp_local).astype(jnp.int32)
-        mvalid = mvalid.reshape(1, 1)
-
-        def step(carry):
-            centers, _, it, _ = carry
-            sums, counts, inertia = fused_estep(x_local, centers, mvalid, k,
-                                                interpret)
-            sums = lax.psum(sums, _mesh.ROWS)
-            counts = lax.psum(counts, _mesh.ROWS)[0]
-            inertia = lax.psum(inertia, _mesh.ROWS)
-            new_centers = jnp.where(counts[:, None] > 0,
-                                    sums / jnp.maximum(counts, 1.0)[:, None],
-                                    centers)
-            shift = jnp.sum((new_centers - centers) ** 2)
-            return new_centers, shift, it + 1, inertia
-
-        def cond(carry):
-            _, shift, it, _ = carry
-            return (it < max_iter) & (shift >= tol)
-
-        init = (c0, jnp.asarray(jnp.inf, xp.dtype), jnp.int32(0),
-                jnp.asarray(0.0, xp.dtype))
-        return lax.while_loop(cond, step, init)
-
-    from jax.sharding import PartitionSpec as P
-    # check_vma=False: every shard's psum-ed loop state is replicated in
-    # fact; the static varying-axes analysis can't see through pallas_call
-    centers, shift, n_iter, inertia = jax.shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=P(_mesh.ROWS, None),
-        out_specs=(P(), P(), P(), P()),
-        check_vma=False,
-    )(xp)
-    return centers[:k, :n], n_iter, inertia, shift
 
 
 def _sparse_distances(bcoo, rowsq, centers):
