@@ -46,6 +46,31 @@ class BaseEstimator:
                 out[k] = getattr(self, k)
         return out
 
+    # -- async trial protocol (SURVEY §4.5: GridSearchCV submits all fits
+    # before waiting on any; estimators opt in by overriding these) --------
+
+    def _fit_async(self, x, y=None):
+        """Dispatch this estimator's fit without reading device values back
+        to the host, returning an opaque state handle for
+        `_fit_finalize`/`_score_async`.  The default falls back to the
+        synchronous `fit` and returns None (JAX async dispatch still
+        overlaps the device work; the fallback only loses the cross-trial
+        pipelining of convergence-scalar reads)."""
+        self.fit(x, y) if y is not None else self.fit(x)
+        return None
+
+    def _fit_finalize(self, state):
+        """Materialise fitted attributes from an async state handle (no-op
+        for the synchronous fallback)."""
+
+    def _score_async(self, state, x, y=None):
+        """Score a trial from its async state; may return a device scalar —
+        the caller converts to float only after every trial is dispatched."""
+        if not hasattr(self, "score"):
+            raise TypeError(f"{type(self).__name__} has no score(); "
+                            "pass scoring=")
+        return self.score(x, y) if y is not None else self.score(x)
+
     def __repr__(self):
         params = ", ".join(f"{k}={v!r}" for k, v in self.get_params().items())
         return f"{type(self).__name__}({params})"

@@ -20,8 +20,10 @@ TPU-native redesign — no sklearn, no ragged SV sets:
   at 0 and they can never become SVs.  Each cascade level is ONE `vmap`-ed
   solve over all nodes of the level (the reference's task-level parallelism,
   recovered as batching).
-- The full kernel matrix of the fit set is computed once per fit (one
-  distance/Gram GEMM); per-node sub-Grams are gathers from it.
+- Kernel values are computed **per node** from gathered rows — a node's
+  (cap, cap) sub-Gram, never the m×m Gram of the whole fit set.  Peak
+  memory is O(nodes·cap²) per level, which is what lets the cascade scale
+  past single-chip HBM the way the reference's partitioning does.
 """
 
 from __future__ import annotations
@@ -102,9 +104,6 @@ class CascadeSVM(BaseEstimator):
         xv = x._data
         yv = jnp.asarray(np.pad(y_pm, (0, xv.shape[0] - m)))
 
-        # gram of the whole fit set, once
-        kmat = _gram(xv, xv, x.shape[1], self.kernel, gamma)
-
         # level-0 partitions = row-block index chunks (reference: one SVC
         # task per row block)
         part = max(1, x._reg_shape[0])
@@ -126,18 +125,29 @@ class CascadeSVM(BaseEstimator):
                 nodes = nodes0
             # cascade reduction to one node
             while True:
-                alphas = _solve_level(kmat, yv, jnp.asarray(nodes),
-                                      float(self.c))
+                alphas, objs = _solve_level(xv, yv, jnp.asarray(nodes),
+                                            float(self.c), n, self.kernel,
+                                            gamma)
                 if nodes.shape[0] == 1:
                     break
                 nodes = self._merge_level(nodes, np.asarray(alphas))
             # top node: global SVs + dual objective
             top_idx, top_alpha = nodes[0], np.asarray(alphas[0])
             keep = (top_alpha > 1e-8) & (top_idx >= 0)
+            if not keep.any():
+                # degenerate solve (tiny C / degenerate data): an empty SV
+                # set would make decision_function identically 0 — keep the
+                # max-α sample so the model stays usable, and say so
+                import warnings
+                warnings.warn("CascadeSVM: no support vector exceeded "
+                              "alpha=1e-8; retaining the max-alpha sample",
+                              RuntimeWarning, stacklevel=2)
+                keep = np.zeros_like(keep)
+                keep[int(np.argmax(np.where(top_idx >= 0, top_alpha,
+                                            -np.inf)))] = True
             sv_idx = top_idx[keep]
             self._sv_alpha = top_alpha[keep].astype(np.float32)
-            w = float(_dual_objective(kmat, yv, jnp.asarray(top_idx),
-                                      jnp.asarray(top_alpha)))
+            w = float(objs[0])       # top node's dual objective (same solve)
             if self.verbose:
                 print(f"CascadeSVM iter {it}: W={w:.6f}, SVs={len(sv_idx)}")
             if self.check_convergence and last_w is not None:
@@ -217,24 +227,24 @@ def _pack_nodes(rows):
 # device kernels
 # ---------------------------------------------------------------------------
 
+def _gram(a, b, kernel, gamma):
+    if kernel == "rbf":
+        return jnp.exp(-gamma * distances_sq(a, b))
+    return a @ b.T
+
+
 @partial(jax.jit, static_argnames=("n_feat", "kernel"))
 @precise
-def _gram(a, b, n_feat, kernel, gamma):
-    av, bv = a[:, :n_feat], b[:, :n_feat]
-    if kernel == "rbf":
-        return jnp.exp(-gamma * distances_sq(av, bv))
-    return av @ bv.T
-
-
-@partial(jax.jit, static_argnames=())
-@precise
-def _solve_level(kmat, yv, nodes, c):
-    """Solve the boxed dual on every node of a cascade level (vmap)."""
+def _solve_level(xv, yv, nodes, c, n_feat, kernel, gamma):
+    """Solve the boxed dual on every node of a cascade level (vmap).  Each
+    node's (cap, cap) sub-Gram is built from its gathered rows — the m×m
+    Gram is never materialised."""
 
     def solve_one(idx):
         valid = idx >= 0
         safe = jnp.maximum(idx, 0)
-        k_sub = kmat[safe][:, safe] + 1.0          # K+1 bias augmentation
+        x_sub = xv[safe, :n_feat]
+        k_sub = _gram(x_sub, x_sub, kernel, gamma) + 1.0  # K+1 bias augment
         y_sub = yv[safe]
         q = k_sub * (y_sub[:, None] * y_sub[None, :])
         c_vec = jnp.where(valid, c, 0.0)            # padded slots pinned at 0
@@ -254,21 +264,12 @@ def _solve_level(kmat, yv, nodes, c):
         alpha0 = jnp.zeros_like(y_sub)
         alpha, _, _ = lax.while_loop(cond, body, (alpha0, jnp.int32(0),
                                                   jnp.float32(jnp.inf)))
-        return alpha
+        # dual objective on the Q this solve already holds — callers read
+        # the top node's value for the convergence check
+        obj = jnp.sum(alpha) - 0.5 * alpha @ (q @ alpha)
+        return alpha, obj
 
     return jax.vmap(solve_one)(nodes)
-
-
-@jax.jit
-@precise
-def _dual_objective(kmat, yv, idx, alpha):
-    valid = idx >= 0
-    safe = jnp.maximum(idx, 0)
-    k_sub = kmat[safe][:, safe] + 1.0
-    y_sub = yv[safe]
-    q = k_sub * (y_sub[:, None] * y_sub[None, :])
-    a = jnp.where(valid, alpha, 0.0)
-    return jnp.sum(a) - 0.5 * a @ (q @ a)
 
 
 @partial(jax.jit, static_argnames=("q_shape", "kernel"))

@@ -17,6 +17,7 @@ from jax import lax
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
+from dislib_tpu.neighbors import base as _nb
 from dislib_tpu.neighbors.base import _kneighbors
 from dislib_tpu.ops.base import precise
 
@@ -55,7 +56,8 @@ class KNeighborsClassifier(BaseEstimator):
         labels = _knn_predict(x._data, self._fit_x._data, x.shape,
                               self._fit_x.shape, self._codes,
                               jnp.asarray(self.classes_, jnp.float32),
-                              self.n_neighbors, self.weights == "distance")
+                              self.n_neighbors, self.weights == "distance",
+                              _nb._CHUNK)
         return Array._from_logical_padded(labels, (x.shape[0], 1))
 
     def score(self, x: Array, y: Array) -> float:
@@ -67,10 +69,12 @@ class KNeighborsClassifier(BaseEstimator):
             raise RuntimeError("KNeighborsClassifier is not fitted")
 
 
-@partial(jax.jit, static_argnames=("q_shape", "f_shape", "k", "use_dist"))
+@partial(jax.jit, static_argnames=("q_shape", "f_shape", "k", "use_dist",
+                                   "chunk"))
 @precise
-def _knn_predict(qp, fp, q_shape, f_shape, codes, classes, k, use_dist):
-    dist_k, idx = _kneighbors(qp, fp, q_shape, f_shape, k)
+def _knn_predict(qp, fp, q_shape, f_shape, codes, classes, k, use_dist,
+                 chunk):
+    dist_k, idx = _kneighbors(qp, fp, q_shape, f_shape, k, chunk=chunk)
     neigh_codes = codes[idx]                                  # (mq_pad, k)
     n_classes = classes.shape[0]
     onehot = jax.nn.one_hot(neigh_codes, n_classes, dtype=jnp.float32)

@@ -29,6 +29,11 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
+from dislib_tpu.ops import tiled as _tiled
+
+# padded frame counts above this stream the RMSD adjacency in tiles
+# (module-level so tests can force the path)
+_DENSE_MAX = 16384
 
 
 class Daura(BaseEstimator):
@@ -52,8 +57,13 @@ class Daura(BaseEstimator):
         if x.shape[1] % 3 != 0:
             raise ValueError("Daura expects rows of 3*n_atoms coordinates")
         n_atoms = x.shape[1] // 3
-        labels, medoids = _daura_fit(x._data, x.shape, float(self.cutoff),
-                                     n_atoms)
+        if x._data.shape[0] <= _DENSE_MAX:
+            labels, medoids = _daura_fit(x._data, x.shape, float(self.cutoff),
+                                         n_atoms)
+        else:
+            labels, medoids = _daura_fit_tiled(x._data, x.shape,
+                                               float(self.cutoff), n_atoms,
+                                               _tiled.TILE)
         labels = np.asarray(jax.device_get(labels))[: x.shape[0]]
         medoids = np.asarray(jax.device_get(medoids))
         self.labels_ = labels.astype(np.int64)
@@ -105,4 +115,40 @@ def _daura_fit(xp, shape, cutoff, n_atoms):
     active0 = valid
     _, labels, medoids, _ = lax.while_loop(
         cond, body, (active0, labels0, medoids0, jnp.int32(0)))
+    return labels, medoids
+
+
+@partial(jax.jit, static_argnames=("shape", "n_atoms", "tile"))
+@precise
+def _daura_fit_tiled(xp, shape, cutoff, n_atoms, tile):
+    """Greedy GROMOS loop without the resident m×m adjacency: each round's
+    active-neighbor counts are a streamed tile pass (`ops/tiled.py`), and
+    the extracted medoid's neighborhood is one (1, m) distance row.  Trades
+    one O(m²/tile²)-GEMM pass per extracted cluster for O(tile²) memory —
+    the same memory-for-recompute trade the reference's block-pair count
+    tasks made."""
+    m, n = shape
+    cut2 = cutoff * cutoff * n_atoms          # rmsd² ≤ cutoff² ⇔ d² ≤ cut2
+    xv, _ = _tiled.pad_to_tiles(xp[:, :n], tile)
+    mp = xv.shape[0]
+
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
+    ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
+
+    def body(carry):
+        active, labels, medoids, cid = carry
+        counts, _ = _tiled.neigh_count_min(xv, cut2, ids, active,
+                                           jnp.int32(mp), tile)
+        counts = jnp.where(active, counts, -1)
+        medoid = jnp.argmax(counts).astype(jnp.int32)
+        mrow = distances_sq(xv[medoid][None, :], xv)[0]
+        members = ((mrow <= cut2) | (ids == medoid)) & active
+        labels = jnp.where(members, cid, labels)
+        medoids = medoids.at[cid].set(medoid)
+        return active & ~members, labels, medoids, cid + 1
+
+    labels0 = jnp.full((mp,), -1, jnp.int32)
+    medoids0 = jnp.full((mp,), -1, jnp.int32)
+    _, labels, medoids, _ = lax.while_loop(
+        lambda c: jnp.any(c[0]), body, (valid, labels0, medoids0, jnp.int32(0)))
     return labels, medoids

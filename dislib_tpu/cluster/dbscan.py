@@ -22,6 +22,14 @@ and gathers that converges in O(log n) rounds and runs entirely on device:
 The grid-partition knobs of the reference (`n_regions`, `dimensions`,
 `max_samples`) are accepted for API parity and ignored: spatial partitioning
 was a memory/scheduling device of the task runtime, not algorithm semantics.
+
+Scale: fit sets whose padded row count exceeds `_DENSE_MAX` switch from the
+resident m×m adjacency to the streamed tile passes of `ops/tiled.py` —
+every reduce (core counts, per-round min-label propagation, border labels)
+is the same math over (tile × tile) distance pieces, so peak memory is
+O(tile²), at the cost of recomputing distance GEMMs each propagation round
+(the reference's region grid made the same memory-for-recompute trade at
+the task level).
 """
 
 from __future__ import annotations
@@ -37,6 +45,11 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
+from dislib_tpu.ops import tiled as _tiled
+
+# padded row counts above this stream the adjacency in tiles instead of
+# materialising the m×m matrix (module-level so tests can force the path)
+_DENSE_MAX = 16384
 
 
 class DBSCAN(BaseEstimator):
@@ -65,8 +78,12 @@ class DBSCAN(BaseEstimator):
         self.max_samples = max_samples
 
     def fit(self, x: Array, y=None):
-        raw, core = _dbscan_fit(x._data, x.shape, float(self.eps),
-                                int(self.min_samples))
+        if x._data.shape[0] <= _DENSE_MAX:
+            raw, core = _dbscan_fit(x._data, x.shape, float(self.eps),
+                                    int(self.min_samples))
+        else:
+            raw, core = _dbscan_fit_tiled(x._data, x.shape, float(self.eps),
+                                          int(self.min_samples), _tiled.TILE)
         raw = np.asarray(jax.device_get(raw))[: x.shape[0]]
         core = np.asarray(jax.device_get(core))[: x.shape[0]]
         # renumber root labels compactly in order of first appearance
@@ -130,6 +147,45 @@ def _dbscan_fit(xp, shape, eps, min_samples):
     # border points: min label among adjacent core points
     border_neigh = jnp.where(adj & core[None, :], label[None, :], sentinel)
     border_label = jnp.min(border_neigh, axis=1)
+    final = jnp.where(core, label, jnp.where(valid, border_label, sentinel))
+    final = jnp.where(final < sentinel, final, -1)
+    return final, core
+
+
+@partial(jax.jit, static_argnames=("shape", "min_samples", "tile"))
+@precise
+def _dbscan_fit_tiled(xp, shape, eps, min_samples, tile):
+    """Same algorithm as `_dbscan_fit`, adjacency streamed in tiles — the
+    distance GEMM is recomputed per propagation round (O(log n) rounds via
+    pointer jumping) instead of held resident."""
+    m, n = shape
+    xv, _ = _tiled.pad_to_tiles(xp[:, :n], tile)
+    mp = xv.shape[0]
+    sentinel = jnp.int32(mp)
+    eps2 = eps * eps
+
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
+    ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
+
+    counts, _ = _tiled.neigh_count_min(xv, eps2, ids, valid, sentinel, tile)
+    core = (counts >= min_samples) & valid
+
+    label0 = jnp.where(core, ids, sentinel)
+
+    def body(carry):
+        label, _ = carry
+        _, neigh_min = _tiled.neigh_count_min(xv, eps2, label, core,
+                                              sentinel, tile)
+        new = jnp.where(core, jnp.minimum(label, neigh_min), sentinel)
+        jumped = jnp.where(new < sentinel, new[jnp.minimum(new, mp - 1)],
+                           sentinel)
+        new = jnp.minimum(new, jumped)
+        return new, jnp.any(new != label)
+
+    label, _ = lax.while_loop(lambda c: c[1], body, (label0, jnp.bool_(True)))
+
+    _, border_label = _tiled.neigh_count_min(xv, eps2, label, core,
+                                             sentinel, tile)
     final = jnp.where(core, label, jnp.where(valid, border_label, sentinel))
     final = jnp.where(final < sentinel, final, -1)
     return final, core

@@ -141,6 +141,29 @@ class KMeans(BaseEstimator):
             -self.score(x)
         return self
 
+    # async trial protocol (SURVEY §4.5): fit/score entirely on device, no
+    # host read until GridSearchCV has dispatched every trial
+    def _fit_async(self, x, y=None):
+        if isinstance(x, SparseArray):
+            return super()._fit_async(x, y)
+        centers0 = self._init_centers(x)
+        return _kmeans_fit(x._data, x.shape, centers0, self.max_iter,
+                           float(self.tol))
+
+    def _fit_finalize(self, state):
+        if state is None:
+            return
+        centers, n_iter, inertia, _ = state
+        self.centers_ = np.asarray(jax.device_get(centers))
+        self.n_iter_ = int(n_iter)
+        self.inertia_ = float(inertia)
+
+    def _score_async(self, state, x, y=None):
+        if state is None or isinstance(x, SparseArray):
+            self._fit_finalize(state)
+            return super()._score_async(state, x, y)
+        return _kmeans_score(x._data, x.shape, state[0])
+
     def fit_predict(self, x: Array, y=None) -> Array:
         return self.fit(x).predict(x)
 

@@ -2,17 +2,24 @@
 `_little_qr` per diagonal block and `_multiply_single_block` trailing updates;
 SURVEY.md §3.2 / §4.4).
 
-TPU-native redesign: the reference's task-per-block elimination order exists
-because each block lives on a different worker.  On TPU the whole matrix is
-one sharded array, so:
+TPU-native redesign — a distributed blocked factorisation, not a gather:
 
-- tall-skinny inputs (the shape QR is actually hot for in dislib workloads —
-  tsQR is BASELINE config 3) route to :func:`dislib_tpu.decomposition.tsqr`'s
-  shard_map tree;
-- the general case lowers to XLA's native Householder QR over the global
-  array (`jnp.linalg.qr`), which XLA blocks and tiles for the MXU itself —
-  re-expressing the reference's hand-written block elimination would
-  hand-schedule what the compiler already does (SURVEY §8 design stance).
+- tall-skinny inputs (n ≤ panel width) route to
+  :func:`dislib_tpu.decomposition.tsqr`'s shard_map tree (BASELINE config 3);
+- wider economic/r factorisations run a **panel loop**: each panel is
+  tsQR-factored in a `shard_map` (local QR + one `all_gather(R)` over ICI),
+  and the trailing matrix is updated with sharded GEMMs — the reference's
+  `_little_qr` / `_multiply_single_block` elimination order, re-expressed as
+  right-looking block Gram–Schmidt with a re-orthogonalisation pass
+  ("twice is enough") for stability.  The full operand is NEVER gathered:
+  every step touches row-sharded (m, b) panels and small replicated (b, n)
+  coefficient blocks.  All panel steps share ONE compiled program — the
+  panel offset is a traced `dynamic_slice` index inside a `lax.fori_loop`,
+  and the accumulated-Q buffer is full width with not-yet-computed columns
+  held at zero so shapes never change.
+- mode='full' (square Q) and the short-wide case delegate to XLA's native
+  Householder QR over the global array — a replicated fallback, appropriate
+  at the sizes where an m×m Q is representable at all.
 
 Modes follow the reference: 'full' (Q m×m, R m×n), 'economic' (Q m×n, R n×n),
 'r' (R only).
@@ -24,9 +31,15 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from dislib_tpu.data.array import Array
+from dislib_tpu.decomposition.tsqr import _tsqr_shardmap
 from dislib_tpu.ops.base import precise
+from dislib_tpu.parallel import mesh as _mesh
+
+# panel width for the blocked path (module-level so tests can shrink it)
+_PANEL = 256
 
 
 @partial(jax.jit, static_argnames=("mode", "shape"))
@@ -45,6 +58,16 @@ def qr(a: Array, mode: str = "full", overwrite_a: bool = False):
     if mode not in ("full", "economic", "r"):
         raise ValueError(f"unsupported mode {mode!r}")
     m, n = a.shape
+    mesh = _mesh.get_mesh()
+    p = mesh.shape[_mesh.ROWS]
+    mp = a._data.shape[0]
+    if mode in ("economic", "r") and m >= n and n > _PANEL \
+            and mp // p >= _PANEL and mp % p == 0:
+        q_pad, r = _qr_blocked(a._data, (m, n), mesh, p, _PANEL)
+        if mode == "r":
+            return Array._from_logical(r[:n, :n])
+        return (Array._from_logical_padded(q_pad, (m, n), a._reg_shape),
+                Array._from_logical(r[:n, :n]))
     av = a._data[:m, :n].astype(jnp.float32)
     if mode == "full":
         q, r = _qr_kernel(av, "complete", (m, n))
@@ -53,3 +76,62 @@ def qr(a: Array, mode: str = "full", overwrite_a: bool = False):
     if mode == "r":
         return Array._from_logical(r)
     return Array._from_logical(q), Array._from_logical(r)
+
+
+@partial(jax.jit, static_argnames=("shape", "mesh", "p", "panel"))
+@precise
+def _qr_blocked(ap, shape, mesh, p, panel):
+    """Right-looking blocked QR over the row-sharded padded operand.
+
+    Invariants inside the loop (panel j, offset off = j·panel):
+    - Q columns ≥ off are zero, so the re-orthogonalisation projection
+      ``C = Qᵀ P`` is exact with fixed shapes;
+    - T columns < off are spent (never read again); columns ≥ off hold the
+      trailing matrix with all previous panels' updates applied.
+    """
+    m, n = shape
+    b = panel
+    n_panels = -(-n // b)
+    n_pad = n_panels * b
+    mp = ap.shape[0]
+    if ap.shape[1] < n_pad:
+        av = jnp.pad(ap, ((0, 0), (0, n_pad - ap.shape[1])))
+    else:
+        av = ap[:, :n_pad]
+    # logical col padding beyond n must be zero for the zero-panel algebra
+    col = lax.broadcasted_iota(jnp.int32, (1, n_pad), 1)
+    av = jnp.where(col < n, av, 0.0)
+    av = lax.with_sharding_constraint(av, _mesh.row_sharding(mesh))
+
+    def step(j, carry):
+        t, q, r = carry
+        off = j * b
+        p_blk = lax.dynamic_slice(t, (0, off), (mp, b))
+        # re-orthogonalisation pass against accumulated Q (cols ≥ off zero)
+        c = q.T @ p_blk                          # (n_pad, b), row-axis psum
+        p_blk = p_blk - q @ c
+        r = lax.dynamic_update_slice(
+            r, lax.dynamic_slice(r, (0, off), (n_pad, b)) + c, (0, off))
+        # panel factorisation: shard-local QR + all_gather(R) over ICI
+        qs, rs = _tsqr_shardmap(p_blk, mesh, p)  # (mp, b), (b, b)
+        # trailing update as sharded GEMMs: G = Qsᵀ T, T -= Qs G (cols > off+b)
+        g = qs.T @ t                             # (b, n_pad)
+        trailing = col >= off + b
+        g_trail = jnp.where(trailing, g, 0.0)
+        t = t - qs @ g_trail
+        # R row block [off:off+b) = [Rs at panel cols | G on trailing cols]
+        row_blk = lax.dynamic_update_slice(g_trail, rs, (0, off))
+        r = lax.dynamic_update_slice(r, row_blk, (off, 0))
+        q = lax.dynamic_update_slice(q, qs, (0, off))
+        return t, q, r
+
+    q0 = jnp.zeros((mp, n_pad), jnp.float32)
+    q0 = lax.with_sharding_constraint(q0, _mesh.row_sharding(mesh))
+    r0 = jnp.zeros((n_pad, n_pad), jnp.float32)
+    _, q, r = lax.fori_loop(0, n_panels, step, (av, q0, r0))
+    # fully-padded shards can leave garbage in Q's padded rows (local QR of a
+    # zero block is implementation-defined); enforce the zero-row invariant
+    row = lax.broadcasted_iota(jnp.int32, (mp, 1), 0)
+    q = jnp.where(row < m, q, 0.0)
+    q = jnp.where(col < n, q, 0.0)
+    return q, r

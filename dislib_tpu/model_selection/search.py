@@ -3,11 +3,15 @@ sklearn-mirroring GridSearchCV / RandomizedSearchCV that submit ALL candidate
 fits before waiting on any, so search-level parallelism multiplies
 estimator-internal parallelism; SURVEY.md §3.4, §4.5).
 
-TPU-native: estimator-internal parallelism already saturates the mesh for
-one trial; trials are dispatched in a host loop whose device work overlaps
-via JAX async dispatch (a fit only blocks when it reads its own convergence
-scalars).  The contract preserved from the reference is no *artificial*
-serialization: nothing in the loop synchronises on earlier trials' results.
+TPU-native concurrency contract: within each fold, every candidate's fit is
+dispatched through the estimator's `_fit_async` protocol (device handles,
+no host reads) BEFORE any score is read — JAX async dispatch then pipelines
+the trials' device programs back-to-back.  Estimators without an async path
+fall back to synchronous fit inside the dispatch loop (their device work
+still overlaps; only their own convergence-scalar reads serialise).
+Scoring accepts the estimator's `score`, a callable, or a scorer string
+('accuracy', 'r2', 'neg_mean_squared_error') mirroring the reference's
+sklearn scorer checks.
 """
 
 from __future__ import annotations
@@ -24,6 +28,48 @@ def _score(est, xv, yv):
     if hasattr(est, "score"):
         return est.score(xv, yv) if yv is not None else est.score(xv)
     raise TypeError(f"{type(est).__name__} has no score(); pass scoring=")
+
+
+def _pred_np(est, xv):
+    return np.asarray(est.predict(xv).collect()).ravel()
+
+
+def _truth_np(yv):
+    return np.asarray(yv.collect()).ravel()
+
+
+def _accuracy(est, xv, yv):
+    return float(np.mean(_pred_np(est, xv) == _truth_np(yv)))
+
+
+def _r2(est, xv, yv):
+    y = _truth_np(yv)
+    resid = ((y - _pred_np(est, xv)) ** 2).sum()
+    total = ((y - y.mean()) ** 2).sum()
+    return float(1.0 - resid / max(total, 1e-12))
+
+
+def _neg_mse(est, xv, yv):
+    y = _truth_np(yv)
+    return float(-np.mean((y - _pred_np(est, xv)) ** 2))
+
+
+_SCORERS = {"accuracy": _accuracy, "r2": _r2,
+            "neg_mean_squared_error": _neg_mse}
+
+
+def _resolve_scorer(scoring):
+    if scoring is None:
+        return None
+    if callable(scoring):
+        return scoring
+    if isinstance(scoring, str):
+        if scoring not in _SCORERS:
+            raise ValueError(f"unknown scorer {scoring!r}; known: "
+                             f"{sorted(_SCORERS)} (or pass a callable)")
+        return _SCORERS[scoring]
+    raise TypeError(f"scoring must be None, str or callable, got "
+                    f"{type(scoring).__name__}")
 
 
 class GridSearchCV(BaseEstimator):
@@ -55,17 +101,30 @@ class GridSearchCV(BaseEstimator):
         candidates = self._candidates()
         cv = self.cv if isinstance(self.cv, KFold) else KFold(n_splits=self.cv)
         n_folds = cv.get_n_splits()
-        scorer = self.scoring if self.scoring is not None else _score
+        scorer = _resolve_scorer(self.scoring)
 
         # fold-major loop: only ONE fold's train/validation copies are device-
         # resident at a time (fold f is released before f+1 materializes),
-        # bounding memory to one fold regardless of cv or candidate count
+        # bounding memory to one fold regardless of cv or candidate count.
+        # Within a fold: dispatch ALL fits, then ALL scores, and only then
+        # read any value back (SURVEY §4.5 "no artificial serialization").
         all_scores = np.zeros((len(candidates), n_folds))
         for fi, (xt, yt, xv, yv) in enumerate(cv.split(x, y)):
+            pend = []
             for ci, params in enumerate(candidates):
                 est = clone(self.estimator).set_params(**params)
-                est.fit(xt, yt) if yt is not None else est.fit(xt)
-                all_scores[ci, fi] = scorer(est, xv, yv)
+                state = est._fit_async(xt, yt) if yt is not None \
+                    else est._fit_async(xt)
+                pend.append((ci, est, state))
+            vals = []
+            for ci, est, state in pend:
+                if scorer is None:
+                    vals.append((ci, est._score_async(state, xv, yv)))
+                else:
+                    est._fit_finalize(state)
+                    vals.append((ci, scorer(est, xv, yv)))
+            for ci, v in vals:            # single host sync point per fold
+                all_scores[ci, fi] = float(v)
 
         mean = all_scores.mean(axis=1)
         std = all_scores.std(axis=1)

@@ -2,11 +2,14 @@
 fitted-block) local kNN tasks, pairwise merge keeping the global k-best;
 SURVEY.md §3.3 "all-pairs block product then min-merge").
 
-TPU-native: the all-pairs block product is one distance GEMM on the sharded
-operands (‖q‖² − 2qᵀx + ‖x‖²) and the k-best merge is a single `lax.top_k`
-— the reference's merge tree exists because no worker sees all distances;
-on a mesh the row-axis reduction is XLA's problem.  Padded fit rows are
-masked to +inf so they can never be neighbors.
+TPU-native: the all-pairs block product is a distance GEMM on the sharded
+operands (‖q‖² − 2qᵀx + ‖x‖²) and the k-best merge is `lax.top_k`.  Small
+fit sets take the direct path (one (mq, mf) distance matrix).  Large fit
+sets stream in fitted-row chunks with a running top-k merge — top_k over
+[current best ∥ chunk distances] per step — so peak memory is
+O(mq·(k + chunk)), never O(mq·mf); this is the reference's own pairwise
+merge tree, collapsed to a `lax.scan`.  Padded fit rows are masked to +inf
+so they can never be neighbors.
 """
 
 from __future__ import annotations
@@ -47,7 +50,8 @@ class NearestNeighbors(BaseEstimator):
         f = self._fit_data
         if not 1 <= k <= f.shape[0]:
             raise ValueError(f"n_neighbors {k} not in [1, {f.shape[0]}]")
-        d, idx = _kneighbors(x._data, f._data, x.shape, f.shape, k)
+        d, idx = _kneighbors(x._data, f._data, x.shape, f.shape, k,
+                             chunk=_CHUNK)
         d_arr = Array._from_logical_padded(_repad(d, (x.shape[0], k)), (x.shape[0], k))
         # indices stay int32 (exact for any realistic row count; float32 would
         # corrupt indices past 2^24)
@@ -57,19 +61,60 @@ class NearestNeighbors(BaseEstimator):
         return i_arr
 
 
-@partial(jax.jit, static_argnames=("q_shape", "f_shape", "k"))
+# fitted-row chunk for the streaming path; fit sets up to 2×_CHUNK rows use
+# the direct single-GEMM path (module-level so tests can shrink it)
+_CHUNK = 4096
+
+
+@partial(jax.jit, static_argnames=("q_shape", "f_shape", "k", "chunk"))
 @precise
-def _kneighbors(qp, fp, q_shape, f_shape, k):
+def _kneighbors(qp, fp, q_shape, f_shape, k, chunk=None):
     mq, d = q_shape
     mf = f_shape[0]
     qv = qp[:, :d]
     fv = fp[:, :d]
-    dist = distances_sq(qv, fv)                               # (mq_pad, mf_pad)
-    invalid = lax.broadcasted_iota(jnp.int32, (1, fv.shape[0]), 1) >= mf
-    dist = jnp.where(invalid, jnp.inf, dist)
-    neg, idx = lax.top_k(-dist, k)
+    # chunk is a static cache key; None (internal callers) reads the module
+    # default at trace time
+    chunk = _CHUNK if chunk is None else chunk
+    if fv.shape[0] <= 2 * chunk:
+        dist = distances_sq(qv, fv)                           # (mq_pad, mf_pad)
+        invalid = lax.broadcasted_iota(jnp.int32, (1, fv.shape[0]), 1) >= mf
+        dist = jnp.where(invalid, jnp.inf, dist)
+        neg, idx = lax.top_k(-dist, k)
+        idx = idx.astype(jnp.int32)
+    else:
+        neg, idx = _kneighbors_chunked(qv, fv, mf, k, chunk)
     dist_k = jnp.sqrt(jnp.maximum(-neg, 0.0))
     valid_q = lax.broadcasted_iota(jnp.int32, (qv.shape[0], 1), 0) < mq
     dist_k = jnp.where(valid_q, dist_k, 0.0)
     idx = jnp.where(valid_q, idx, 0)
-    return dist_k, idx.astype(jnp.int32)
+    return dist_k, idx
+
+
+def _kneighbors_chunked(qv, fv, mf, k, chunk):
+    """Running top-k over fitted-row chunks: each scan step merges the
+    carried k-best with one chunk's distances.  Ties keep the earlier
+    (lower) index — carried candidates precede the chunk in the merge, and
+    chunks arrive in index order, so tie-breaking matches the direct path."""
+    mq_pad = qv.shape[0]
+    n_chunks = -(-fv.shape[0] // chunk)
+    fpad = jnp.pad(fv, ((0, n_chunks * chunk - fv.shape[0]), (0, 0)))
+    f_chunks = fpad.reshape(n_chunks, chunk, fv.shape[1])
+    offsets = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
+
+    def body(carry, xs):
+        best_neg, best_idx = carry
+        f_chunk, off = xs
+        dist = distances_sq(qv, f_chunk)                      # (mq_pad, chunk)
+        col = off + lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+        dist = jnp.where(col >= mf, jnp.inf, dist)
+        cand_neg = jnp.concatenate([best_neg, -dist], axis=1)
+        cand_idx = jnp.concatenate(
+            [best_idx, jnp.broadcast_to(col, (mq_pad, chunk))], axis=1)
+        neg, sel = lax.top_k(cand_neg, k)
+        return (neg, jnp.take_along_axis(cand_idx, sel, axis=1)), None
+
+    init = (jnp.full((mq_pad, k), -jnp.inf, qv.dtype),
+            jnp.zeros((mq_pad, k), jnp.int32))
+    (best_neg, best_idx), _ = lax.scan(body, init, (f_chunks, offsets))
+    return best_neg, best_idx

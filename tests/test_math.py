@@ -73,6 +73,59 @@ class TestQR:
             ds.qr(ds.array(rng.rand(4, 4)), mode="zzz")
 
 
+class TestBlockedQR:
+    """The distributed panel-loop path (VERDICT r1 #5): tsQR panels +
+    sharded trailing GEMMs, full operand never gathered."""
+
+    @pytest.mark.parametrize("shape", [(256, 130), (300, 97), (192, 64)])
+    def test_invariants_irregular(self, rng, shape, monkeypatch):
+        import importlib
+        qr_mod = importlib.import_module("dislib_tpu.math.qr")
+        monkeypatch.setattr(qr_mod, "_PANEL", 32)
+        x = rng.rand(*shape).astype(np.float32)
+        q, r = ds.qr(ds.array(x, block_size=(64, 32)), mode="economic")
+        qc, rc = q.collect(), r.collect()
+        assert qc.shape == shape and rc.shape == (shape[1], shape[1])
+        np.testing.assert_allclose(qc @ rc, x, atol=1e-3)
+        np.testing.assert_allclose(qc.T @ qc, np.eye(shape[1]), atol=1e-3)
+        np.testing.assert_allclose(np.tril(rc, -1), 0, atol=1e-4)
+
+    def test_r_mode_matches_numpy(self, rng, monkeypatch):
+        import importlib
+        qr_mod = importlib.import_module("dislib_tpu.math.qr")
+        monkeypatch.setattr(qr_mod, "_PANEL", 32)
+        x = rng.rand(256, 80).astype(np.float32)
+        r = ds.qr(ds.array(x), mode="r").collect()
+        rn = np.linalg.qr(x, mode="r")
+        np.testing.assert_allclose(np.abs(r), np.abs(rn), atol=1e-3)
+
+    def test_never_gathers_full_operand(self, rng):
+        """Compiled-HLO assertion: on a multi-device rows mesh, no
+        all-gather materialises the full (mp, n_pad) operand."""
+        import jax
+        import jax.numpy as jnp
+        from dislib_tpu.math.qr import _qr_blocked
+        from dislib_tpu.parallel import mesh as _mesh
+        mesh = _mesh.get_mesh()
+        p = mesh.shape[_mesh.ROWS]
+        if p == 1:
+            pytest.skip("needs a multi-device rows axis")
+        mp, n = 2048 * p, 1024
+        ap = jax.device_put(jnp.zeros((mp, n), jnp.float32),
+                            _mesh.row_sharding())
+        compiled = _qr_blocked.lower(ap, (mp, n), mesh, p, 256).compile()
+        hlo = compiled.as_text()
+        full_elems = (mp * n)
+        import re
+        for m_ in re.finditer(r"all-gather[^\n]*f32\[([\d,]+)\]", hlo):
+            dims = [int(d) for d in m_.group(1).split(",")]
+            elems = 1
+            for d in dims:
+                elems *= d
+            assert elems < full_elems, \
+                f"all-gather of {dims} covers the full operand"
+
+
 class TestTSQR:
     @pytest.mark.parametrize("shape", [(64, 8), (100, 13), (8, 8), (1000, 3)])
     def test_reduced(self, rng, shape):

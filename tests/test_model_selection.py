@@ -99,3 +99,72 @@ class TestRandomizedSearchCV:
         rs.fit(ds.array(x), ds.array(y))
         ks = [p["n_neighbors"] for p in rs.cv_results_["params"]]
         assert all(1 <= k < 6 for k in ks)
+
+
+class TestAsyncDispatch:
+    """SURVEY §4.5 concurrency contract: all candidate fits dispatch before
+    any score is read, and the async path is score-identical to serial."""
+
+    def test_async_matches_serial(self, rng, monkeypatch):
+        from dislib_tpu.base import BaseEstimator
+        x = ds.array(rng.rand(120, 4).astype(np.float32), block_size=(30, 4))
+        grid = {"n_clusters": [2, 3, 4], "random_state": [0]}
+        fast = GridSearchCV(KMeans(random_state=0), grid, cv=3, refit=False)
+        fast.fit(x)
+        # force every estimator onto the synchronous fallback
+        monkeypatch.setattr(KMeans, "_fit_async", BaseEstimator._fit_async)
+        monkeypatch.setattr(KMeans, "_score_async", BaseEstimator._score_async)
+        slow = GridSearchCV(KMeans(random_state=0), grid, cv=3, refit=False)
+        slow.fit(x)
+        np.testing.assert_allclose(fast.cv_results_["mean_test_score"],
+                                   slow.cv_results_["mean_test_score"],
+                                   rtol=1e-5)
+        assert fast.best_params_ == slow.best_params_
+
+    def test_all_fits_dispatch_before_any_score(self, rng, monkeypatch):
+        events = []
+        orig_fit, orig_score = KMeans._fit_async, KMeans._score_async
+
+        def spy_fit(self, x, y=None):
+            events.append("fit")
+            return orig_fit(self, x, y)
+
+        def spy_score(self, state, x, y=None):
+            events.append("score")
+            return orig_score(self, state, x, y)
+
+        monkeypatch.setattr(KMeans, "_fit_async", spy_fit)
+        monkeypatch.setattr(KMeans, "_score_async", spy_score)
+        x = ds.array(rng.rand(90, 3).astype(np.float32))
+        GridSearchCV(KMeans(random_state=0, max_iter=3),
+                     {"n_clusters": [2, 3, 4]}, cv=2, refit=False).fit(x)
+        # per fold: 3 fits then 3 scores — never interleaved
+        assert events == ["fit"] * 3 + ["score"] * 3 + ["fit"] * 3 + ["score"] * 3
+
+
+class TestScorerStrings:
+    def test_accuracy_scorer(self, rng):
+        x = np.vstack([rng.randn(30, 2) - 3, rng.randn(30, 2) + 3]).astype(np.float32)
+        y = np.r_[np.zeros(30), np.ones(30)].astype(np.float32)
+        sh = rng.permutation(60)
+        xa, ya = ds.array(x[sh]), ds.array(y[sh][:, None])
+        gs = GridSearchCV(KNeighborsClassifier(), {"n_neighbors": [1, 3]},
+                          cv=2, scoring="accuracy", refit=False)
+        gs.fit(xa, ya)
+        assert gs.best_score_ > 0.9
+
+    def test_r2_scorer(self, rng):
+        from dislib_tpu.regression import LinearRegression
+        x = rng.rand(80, 3).astype(np.float32)
+        y = (x @ np.array([1.0, -2.0, 0.5]) + 0.3).astype(np.float32)
+        gs = GridSearchCV(LinearRegression(), {"fit_intercept": [True, False]},
+                          cv=2, scoring="r2", refit=False)
+        gs.fit(ds.array(x), ds.array(y[:, None]))
+        assert gs.best_score_ > 0.99
+        assert gs.best_params_ == {"fit_intercept": True}
+
+    def test_unknown_scorer_raises(self, rng):
+        x = ds.array(rng.rand(20, 2))
+        with pytest.raises(ValueError, match="unknown scorer"):
+            GridSearchCV(KMeans(), {"n_clusters": [2]}, cv=2,
+                         scoring="zzz").fit(x)

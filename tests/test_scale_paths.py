@@ -1,0 +1,113 @@
+"""Scale-path tests: the streamed/chunked implementations the quadratic
+estimators switch to past single-chip memory limits (VERDICT round-1 #4)
+must be oracle-equal to the dense paths, and must provably not allocate the
+m×m buffer."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import DBSCAN, Daura
+from dislib_tpu.cluster import dbscan as dbscan_mod
+from dislib_tpu.cluster import daura as daura_mod
+from dislib_tpu.neighbors import NearestNeighbors
+from dislib_tpu.neighbors import base as nb
+from dislib_tpu.ops import tiled as tiled_mod
+
+
+class TestChunkedKNeighbors:
+    def test_chunked_matches_direct(self, rng):
+        x = rng.rand(150, 5).astype(np.float32)
+        q = rng.rand(40, 5).astype(np.float32)
+        xa, qa = ds.array(x, block_size=(32, 5)), ds.array(q, block_size=(16, 5))
+        nn = NearestNeighbors(n_neighbors=4).fit(xa)
+        d_ref, i_ref = (a.collect() for a in nn.kneighbors(qa))
+        d_ch, i_ch = nb._kneighbors(qa._data, xa._data, qa.shape, xa.shape,
+                                    4, chunk=16)
+        np.testing.assert_allclose(np.asarray(d_ch)[:40], d_ref, rtol=1e-5,
+                                   atol=1e-5)
+        assert np.array_equal(np.asarray(i_ch)[:40], i_ref.astype(np.int32))
+
+    def test_chunked_tie_break_matches(self, rng):
+        # duplicated fitted rows: equal distances must keep the lowest index
+        base = rng.rand(8, 3).astype(np.float32)
+        x = np.vstack([base, base, base])
+        q = base + 0.0
+        xa, qa = ds.array(x), ds.array(q)
+        d_dir, i_dir = nb._kneighbors(qa._data, xa._data, qa.shape, xa.shape,
+                                      3, chunk=1024)
+        d_ch, i_ch = nb._kneighbors(qa._data, xa._data, qa.shape, xa.shape,
+                                    3, chunk=4)
+        assert np.array_equal(np.asarray(i_dir)[:8], np.asarray(i_ch)[:8])
+
+    def test_no_quadratic_buffer(self):
+        """Memory-shape assertion: the chunked lowering's temporaries stay
+        far below the mq x mf distance matrix the direct path allocates."""
+        import jax.numpy as jnp
+        mq, mf, d, k, chunk = 256, 8192, 8, 5, 512
+        qp = jnp.zeros((mq, d), jnp.float32)
+        fp = jnp.zeros((mf, d), jnp.float32)
+        compiled = nb._kneighbors.lower(qp, fp, (mq, d), (mf, d), k,
+                                        chunk=chunk).compile()
+        mem = compiled.memory_analysis()
+        if mem is None:
+            pytest.skip("backend reports no memory analysis")
+        quadratic = mq * mf * 4
+        assert mem.temp_size_in_bytes < quadratic, \
+            f"temp {mem.temp_size_in_bytes} >= m^2 buffer {quadratic}"
+
+
+def _blob_data(rng, n=120):
+    t = rng.rand(n // 2) * 2 * np.pi
+    c1 = np.c_[np.cos(t), np.sin(t)] + 0.05 * rng.randn(n // 2, 2)
+    c2 = np.c_[np.cos(t) + 6.0, np.sin(t)] + 0.05 * rng.randn(n // 2, 2)
+    noise = rng.rand(6, 2) * 2 + np.array([2.5, 4.0])
+    return np.vstack([c1, c2, noise]).astype(np.float32)
+
+
+class TestTiledDBSCAN:
+    def test_tiled_matches_dense(self, rng, monkeypatch):
+        x = _blob_data(rng)
+        dense = DBSCAN(eps=0.4, min_samples=5).fit(ds.array(x))
+        monkeypatch.setattr(dbscan_mod, "_DENSE_MAX", 0)
+        monkeypatch.setattr(tiled_mod, "TILE", 32)
+        tiled = DBSCAN(eps=0.4, min_samples=5).fit(ds.array(x))
+        assert np.array_equal(dense.labels_, tiled.labels_)
+        assert dense.n_clusters_ == tiled.n_clusters_
+        assert np.array_equal(dense.core_sample_indices_,
+                              tiled.core_sample_indices_)
+
+    def test_tiled_chain(self, rng, monkeypatch):
+        # 1-D chain spanning many tiles: worst case for propagation depth
+        monkeypatch.setattr(dbscan_mod, "_DENSE_MAX", 0)
+        monkeypatch.setattr(tiled_mod, "TILE", 16)
+        x = np.c_[np.arange(100) * 0.5, np.zeros(100)].astype(np.float32)
+        est = DBSCAN(eps=0.6, min_samples=2).fit(ds.array(x))
+        assert est.n_clusters_ == 1
+        assert np.all(est.labels_ == 0)
+
+
+class TestTiledDaura:
+    def test_tiled_matches_dense(self, rng, monkeypatch):
+        n_atoms = 4
+        x = (rng.randn(70, 3 * n_atoms) * 2).astype(np.float32)
+        dense = Daura(cutoff=3.0).fit(ds.array(x))
+        monkeypatch.setattr(daura_mod, "_DENSE_MAX", 0)
+        monkeypatch.setattr(tiled_mod, "TILE", 16)
+        tiled = Daura(cutoff=3.0).fit(ds.array(x))
+        assert np.array_equal(dense.labels_, tiled.labels_)
+        assert [c[0] for c in dense.clusters_] == [c[0] for c in tiled.clusters_]
+
+
+class TestCSVMDegenerate:
+    def test_empty_sv_fallback_warns(self, rng):
+        from dislib_tpu.classification import CascadeSVM
+        x = rng.randn(24, 3).astype(np.float32)
+        y = (rng.rand(24) > 0.5).astype(np.float32)
+        xa, ya = ds.array(x), ds.array(y[:, None])
+        with pytest.warns(RuntimeWarning, match="no support vector"):
+            est = CascadeSVM(c=1e-12, max_iter=1, kernel="linear").fit(xa, ya)
+        assert est.support_vectors_count_ == 1
+        # decision function is usable (finite), not identically broken
+        dec = est.decision_function(xa).collect()
+        assert np.isfinite(dec).all()
