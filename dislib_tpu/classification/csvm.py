@@ -148,8 +148,9 @@ class CascadeSVM(BaseEstimator):
             sv_idx = top_idx[keep]
             self._sv_alpha = top_alpha[keep].astype(np.float32)
             w = float(objs[0])       # top node's dual objective (same solve)
-            if self.verbose:
-                print(f"CascadeSVM iter {it}: W={w:.6f}, SVs={len(sv_idx)}")
+            from dislib_tpu.utils.dlog import verbose_logger
+            verbose_logger("csvm", self.verbose).info(
+                "iter %d: W=%.6f, SVs=%d", it, w, len(sv_idx))
             if self.check_convergence and last_w is not None:
                 if abs(w - last_w) <= self.tol * max(abs(w), 1e-12):
                     self.converged_ = True
@@ -197,7 +198,9 @@ class CascadeSVM(BaseEstimator):
     def predict(self, x: Array) -> Array:
         dec = self.decision_function(x).collect().ravel()
         labels = self.classes_[(dec > 0).astype(np.int64)]
-        out = jnp.asarray(labels.astype(np.float32)[:, None])
+        # integer class values stay integral (float32 exact only to 2^24)
+        dt = np.int32 if np.issubdtype(labels.dtype, np.integer) else np.float32
+        out = jnp.asarray(labels.astype(dt)[:, None])
         return Array._from_logical_padded(_repad(out, (x.shape[0], 1)),
                                           (x.shape[0], 1))
 

@@ -53,12 +53,19 @@ class KNeighborsClassifier(BaseEstimator):
         if self.n_neighbors > self._fit_x.shape[0]:
             raise ValueError(f"n_neighbors {self.n_neighbors} > fitted samples "
                              f"{self._fit_x.shape[0]}")
-        labels = _knn_predict(x._data, self._fit_x._data, x.shape,
-                              self._fit_x.shape, self._codes,
-                              jnp.asarray(self.classes_, jnp.float32),
-                              self.n_neighbors, self.weights == "distance",
-                              _nb._CHUNK)
-        return Array._from_logical_padded(labels, (x.shape[0], 1))
+        # the device kernel votes in int32 code space; class values are
+        # mapped on host so integer labels never round-trip through float32
+        codes = _knn_predict(x._data, self._fit_x._data, x.shape,
+                             self._fit_x.shape, self._codes,
+                             len(self.classes_), self.n_neighbors,
+                             self.weights == "distance", _nb._CHUNK)
+        labels = self.classes_[np.asarray(jax.device_get(codes)).ravel()
+                               [: x.shape[0]]]
+        dt = np.int32 if np.issubdtype(labels.dtype, np.integer) else np.float32
+        out = jnp.asarray(labels.astype(dt)[:, None])
+        from dislib_tpu.data.array import _repad
+        return Array._from_logical_padded(_repad(out, (x.shape[0], 1)),
+                                          (x.shape[0], 1))
 
     def score(self, x: Array, y: Array) -> float:
         pred = self.predict(x).collect().ravel()
@@ -69,22 +76,20 @@ class KNeighborsClassifier(BaseEstimator):
             raise RuntimeError("KNeighborsClassifier is not fitted")
 
 
-@partial(jax.jit, static_argnames=("q_shape", "f_shape", "k", "use_dist",
-                                   "chunk"))
+@partial(jax.jit, static_argnames=("q_shape", "f_shape", "n_classes", "k",
+                                   "use_dist", "chunk"))
 @precise
-def _knn_predict(qp, fp, q_shape, f_shape, codes, classes, k, use_dist,
+def _knn_predict(qp, fp, q_shape, f_shape, codes, n_classes, k, use_dist,
                  chunk):
     dist_k, idx = _kneighbors(qp, fp, q_shape, f_shape, k, chunk=chunk)
     neigh_codes = codes[idx]                                  # (mq_pad, k)
-    n_classes = classes.shape[0]
     onehot = jax.nn.one_hot(neigh_codes, n_classes, dtype=jnp.float32)
     if use_dist:
         wts = 1.0 / jnp.maximum(dist_k, 1e-10)
         votes = jnp.sum(onehot * wts[:, :, None], axis=1)
     else:
         votes = jnp.sum(onehot, axis=1)
-    winner = jnp.argmax(votes, axis=1)
-    labels = classes[winner]
+    winner = jnp.argmax(votes, axis=1).astype(jnp.int32)
     mq = q_shape[0]
-    valid = lax.broadcasted_iota(jnp.int32, (labels.shape[0],), 0) < mq
-    return jnp.where(valid, labels, 0.0)[:, None]
+    valid = lax.broadcasted_iota(jnp.int32, (winner.shape[0],), 0) < mq
+    return jnp.where(valid, winner, 0)[:, None]

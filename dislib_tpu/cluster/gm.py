@@ -28,6 +28,7 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
+from dislib_tpu.utils.dlog import verbose_logger
 
 _LOG2PI = float(np.log(2.0 * np.pi))
 
@@ -52,12 +53,13 @@ class GaussianMixture(BaseEstimator):
     ----------
     weights_, means_, covariances_ : ndarrays
     converged_ : bool ;  n_iter_ : int ;  lower_bound_ : float
+    history_ : ndarray (n_iter_,) — per-iteration lower bound (SURVEY §6).
     """
 
     def __init__(self, n_components=1, covariance_type="full", tol=1e-3,
                  reg_covar=1e-6, max_iter=100, init_params="kmeans",
                  weights_init=None, means_init=None, precisions_init=None,
-                 arity=50, random_state=None):
+                 arity=50, random_state=None, verbose=False):
         self.n_components = n_components
         self.covariance_type = covariance_type
         self.tol = tol
@@ -69,6 +71,7 @@ class GaussianMixture(BaseEstimator):
         self.precisions_init = precisions_init
         self.arity = arity
         self.random_state = random_state
+        self.verbose = verbose
 
     # ------------------------------------------------------------------
 
@@ -122,18 +125,22 @@ class GaussianMixture(BaseEstimator):
         else:
             resp0 = self._init_resp(x)
             overrides = self._explicit_inits(n)
+        history = []
+        log = verbose_logger("gm", self.verbose)
         while not converged:
             chunk = self.max_iter - it if checkpoint is None else \
                 min(checkpoint.every, self.max_iter - it)
             if chunk <= 0:
                 break
-            weights, means, covs, lb_dev, n_done, conv = _gm_fit(
+            weights, means, covs, lb_dev, n_done, conv, hist = _gm_fit(
                 x._data, x.shape, resp0, self.covariance_type,
                 float(self.reg_covar), float(self.tol), chunk, overrides,
                 prev_lb0=lb)
             it += int(n_done)
             lb = float(lb_dev)
             converged = bool(conv)
+            history.extend(np.asarray(jax.device_get(hist))[: int(n_done)])
+            log.info("iter %d: lower_bound=%.6g", it, lb)
             overrides = (weights, means, covs)
             if checkpoint is not None:
                 checkpoint.save({
@@ -150,7 +157,17 @@ class GaussianMixture(BaseEstimator):
         self.lower_bound_ = lb if lb is not None else -np.inf
         self.n_iter_ = it
         self.converged_ = converged
+        self.history_ = np.asarray(history, dtype=np.float64)
         return self
+
+    def score(self, x: Array, y=None) -> float:
+        """Mean per-sample log-likelihood under the fitted mixture (sklearn
+        convention) — also what GridSearchCV maximises by default."""
+        self._check_fitted()
+        return float(_gm_loglik(x._data, x.shape, jnp.asarray(self.weights_),
+                                jnp.asarray(self.means_),
+                                jnp.asarray(self.covariances_),
+                                self.covariance_type))
 
     def _explicit_inits(self, d):
         """(weights, means, covs) overrides from the *_init params (reference
@@ -292,21 +309,35 @@ def _gm_fit(xp, shape, resp0, cov_type, reg_covar, tol, max_iter,
         return resp, ll
 
     def step(carry):
-        weights, means, covs, prev_lb, _, it = carry
+        weights, means, covs, prev_lb, _, it, hist = carry
         resp, lb = e_step(weights, means, covs)
         weights, means, covs = m_step(resp)
         conv = jnp.abs(lb - prev_lb) < tol
-        return weights, means, covs, lb, conv, it + 1
+        return weights, means, covs, lb, conv, it + 1, hist.at[it].set(lb)
 
     def cond(carry):
-        _, _, _, lb, conv, it = carry
+        _, _, _, lb, conv, it, _ = carry
         return (~conv) & (it < max_iter)
 
     lb0 = jnp.asarray(-jnp.inf, xv.dtype) if prev_lb0 is None else \
         jnp.asarray(prev_lb0, xv.dtype)
-    init = (weights0, means0, covs0, lb0, jnp.asarray(False), jnp.int32(0))
-    weights, means, covs, lb, conv, n_iter = lax.while_loop(cond, step, init)
-    return weights, means, covs, lb, n_iter, conv
+    init = (weights0, means0, covs0, lb0, jnp.asarray(False), jnp.int32(0),
+            jnp.zeros((max_iter,), xv.dtype))
+    weights, means, covs, lb, conv, n_iter, hist = \
+        lax.while_loop(cond, step, init)
+    return weights, means, covs, lb, n_iter, conv, hist
+
+
+@partial(jax.jit, static_argnames=("shape", "cov_type"))
+@precise
+def _gm_loglik(xp, shape, weights, means, covs, cov_type):
+    m, n = shape
+    xv = xp[:, :n]
+    prec = _chol_precisions(covs, cov_type, n)
+    logp = _log_prob(xv, means, prec, cov_type, n) + jnp.log(weights)[None, :]
+    lse = jax.scipy.special.logsumexp(logp, axis=1)
+    w = (lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m).astype(xv.dtype)
+    return jnp.sum(lse * w) / m
 
 
 @partial(jax.jit, static_argnames=("shape", "cov_type"))
@@ -316,6 +347,7 @@ def _gm_predict(xp, shape, weights, means, covs, cov_type):
     xv = xp[:, :n]
     prec = _chol_precisions(covs, cov_type, n)
     logp = _log_prob(xv, means, prec, cov_type, n) + jnp.log(weights)[None, :]
-    labels = jnp.argmax(logp, axis=1).astype(jnp.float32)
+    # component ids stay int32 (float32 is exact only below 2^24)
+    labels = jnp.argmax(logp, axis=1).astype(jnp.int32)
     valid = lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m
-    return jnp.where(valid, labels, 0.0)[:, None]
+    return jnp.where(valid, labels, 0)[:, None]

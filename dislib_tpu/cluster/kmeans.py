@@ -39,6 +39,7 @@ from dislib_tpu.data.sparse import SparseArray, _spmm, _spmm_t
 from dislib_tpu.ops import distances_sq as _distances_sq
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
+from dislib_tpu.utils.dlog import verbose_logger
 
 
 class KMeans(BaseEstimator):
@@ -59,16 +60,19 @@ class KMeans(BaseEstimator):
     centers_ : ndarray (n_clusters, n_features)
     n_iter_ : int
     inertia_ : float — within-cluster sum of squared distances.
+    history_ : ndarray (n_iter_,) — per-iteration inertia (SURVEY §6
+        observability row).
     """
 
     def __init__(self, n_clusters=8, init="random", max_iter=10, tol=1e-4,
-                 arity=50, random_state=None):
+                 arity=50, random_state=None, verbose=False):
         self.n_clusters = n_clusters
         self.init = init
         self.max_iter = max_iter
         self.tol = tol
         self.arity = arity
         self.random_state = random_state
+        self.verbose = verbose
 
     # -- fitting -------------------------------------------------------------
 
@@ -116,19 +120,27 @@ class KMeans(BaseEstimator):
         else:
             centers = self._init_centers(x)
         inertia = None
+        history = []
+        log = verbose_logger("kmeans", self.verbose)
         while not done:
             chunk = self.max_iter - it if checkpoint is None else \
                 min(checkpoint.every, self.max_iter - it)
             if chunk <= 0:
                 break
             if isinstance(x, SparseArray):
-                centers, n_done, inertia, shift = _kmeans_fit_sparse(
-                    x._bcoo, x.row_norms_sq(), centers, chunk, float(self.tol))
+                data, lrows, cols, rowsq = x.sharded_rows()
+                centers, n_done, inertia, shift, hist = \
+                    _kmeans_fit_sparse_sharded(
+                        data, lrows, cols, rowsq, centers, x.shape[0], chunk,
+                        float(self.tol), _mesh.get_mesh())
             else:
-                centers, n_done, inertia, shift = _kmeans_fit(
+                centers, n_done, inertia, shift, hist = _kmeans_fit(
                     x._data, x.shape, centers, chunk, float(self.tol))
             it += int(n_done)
+            history.extend(np.asarray(jax.device_get(hist))[: int(n_done)])
             done = float(shift) < self.tol
+            log.info("iter %d: inertia=%.6g shift=%.3g", it,
+                     float(inertia), float(shift))
             if checkpoint is not None:
                 checkpoint.save({"centers": np.asarray(jax.device_get(centers)),
                                  "n_iter": it, "converged": done})
@@ -136,6 +148,7 @@ class KMeans(BaseEstimator):
                 break
         self.centers_ = np.asarray(jax.device_get(centers))
         self.n_iter_ = it
+        self.history_ = np.asarray(history, dtype=np.float64)
         # inertia is None only when resuming an already-finished fit
         self.inertia_ = float(inertia) if inertia is not None else \
             -self.score(x)
@@ -153,10 +166,12 @@ class KMeans(BaseEstimator):
     def _fit_finalize(self, state):
         if state is None:
             return
-        centers, n_iter, inertia, _ = state
+        centers, n_iter, inertia, _, hist = state
         self.centers_ = np.asarray(jax.device_get(centers))
         self.n_iter_ = int(n_iter)
         self.inertia_ = float(inertia)
+        self.history_ = np.asarray(
+            jax.device_get(hist), dtype=np.float64)[: self.n_iter_]
 
     def _score_async(self, state, x, y=None):
         if state is None or isinstance(x, SparseArray):
@@ -172,7 +187,7 @@ class KMeans(BaseEstimator):
         if isinstance(x, SparseArray):
             d = _sparse_distances(x._bcoo, x.row_norms_sq(),
                                   jnp.asarray(self.centers_))
-            labels = jnp.argmin(d, axis=1).astype(jnp.float32)[:, None]
+            labels = jnp.argmin(d, axis=1).astype(jnp.int32)[:, None]
             return Array._from_logical_padded(_repad(labels, (x.shape[0], 1)),
                                               (x.shape[0], 1))
         labels = _kmeans_predict(x._data, x.shape, jnp.asarray(self.centers_))
@@ -206,7 +221,7 @@ def _kmeans_fit(xp, shape, centers0, max_iter, tol):
     k = centers0.shape[0]
 
     def step(carry):
-        centers, _, it, _ = carry
+        centers, _, it, _, hist = carry
         d = _distances_sq(xv, centers)
         labels = jnp.argmin(d, axis=1)
         onehot = jax.nn.one_hot(labels, k, dtype=xv.dtype) * w[:, None]
@@ -217,16 +232,16 @@ def _kmeans_fit(xp, shape, centers0, max_iter, tol):
                                 centers)
         shift = jnp.sum((new_centers - centers) ** 2)
         inertia = jnp.sum(jnp.min(d, axis=1) * w)
-        return new_centers, shift, it + 1, inertia
+        return new_centers, shift, it + 1, inertia, hist.at[it].set(inertia)
 
     def cond(carry):
-        _, shift, it, _ = carry
+        _, shift, it, _, _ = carry
         return (it < max_iter) & (shift >= tol)
 
     init = (centers0, jnp.asarray(jnp.inf, xv.dtype), jnp.int32(0),
-            jnp.asarray(0.0, xv.dtype))
-    centers, shift, n_iter, inertia = lax.while_loop(cond, step, init)
-    return centers, n_iter, inertia, shift
+            jnp.asarray(0.0, xv.dtype), jnp.zeros((max_iter,), xv.dtype))
+    centers, shift, n_iter, inertia, hist = lax.while_loop(cond, step, init)
+    return centers, n_iter, inertia, shift, hist
 
 
 @partial(jax.jit, static_argnames=("shape",))
@@ -235,10 +250,12 @@ def _kmeans_predict(xp, shape, centers):
     m, n = shape
     xv = xp[:, :n]
     d = _distances_sq(xv, centers)
-    labels = jnp.argmin(d, axis=1).astype(jnp.float32)
+    # labels stay int32 (consistent with the kNN indices path — float32 is
+    # exact only below 2^24)
+    labels = jnp.argmin(d, axis=1).astype(jnp.int32)
     # zero out padded rows to keep the Array invariant
     valid = lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m
-    labels = jnp.where(valid, labels, 0.0)
+    labels = jnp.where(valid, labels, 0)
     return labels[:, None]
 
 
@@ -249,36 +266,70 @@ def _sparse_distances(bcoo, rowsq, centers):
     return jnp.maximum(rowsq[:, None] - 2.0 * cross + c_sq[None, :], 0.0)
 
 
-@partial(jax.jit, static_argnames=("max_iter",))
-@precise
-def _kmeans_fit_sparse(bcoo, rowsq, centers0, max_iter, tol):
-    """Sparse-path Lloyd's: same on-device while_loop as `_kmeans_fit`, with
-    the two GEMMs replaced by BCOO contractions (no padding — sparse arrays
-    are not mesh-padded; see `dislib_tpu/data/sparse.py`)."""
+@partial(jax.jit, static_argnames=("m", "max_iter", "mesh"))
+def _kmeans_fit_sparse_sharded(data, lrows, cols, rowsq, centers0, m,
+                               max_iter, tol, mesh):
+    """Sparse-path Lloyd's on the row-sharded rectangular representation
+    (`SparseArray.sharded_rows`): per iteration each shard computes its
+    rows' distance cross-term shard-locally (gather centersᵀ at the entry
+    columns, scale, segment-sum by local row), and the per-cluster (Σx,
+    count) partials combine with ONE `psum` over the rows axis — the same
+    communication structure as the dense `_kmeans_fit` (SURVEY §8 hard
+    part 2: sharded spmm + psum, not a single-device BCOO)."""
+    p = mesh.shape[_mesh.ROWS]
+    m_local = rowsq.shape[1]
     k = centers0.shape[0]
 
-    def step(carry):
-        centers, _, it, _ = carry
-        d = _sparse_distances(bcoo, rowsq, centers)
-        labels = jnp.argmin(d, axis=1)
-        onehot = jax.nn.one_hot(labels, k, dtype=centers.dtype)
-        sums = _spmm_t(bcoo, onehot).T               # (k, n)
-        counts = jnp.sum(onehot, axis=0)
-        new_centers = jnp.where(counts[:, None] > 0,
-                                sums / jnp.maximum(counts, 1.0)[:, None],
-                                centers)
-        shift = jnp.sum((new_centers - centers) ** 2)
-        inertia = jnp.sum(jnp.min(d, axis=1))
-        return new_centers, shift, it + 1, inertia
+    def shard_fn(d_s, lr_s, cc_s, rsq_s, c0):
+        d_e, lr, cc, rsq = d_s[0], lr_s[0], cc_s[0], rsq_s[0]
+        offset = lax.axis_index(_mesh.ROWS) * m_local
+        valid = (offset + lax.broadcasted_iota(jnp.int32, (m_local,), 0)) < m
 
-    def cond(carry):
-        _, shift, it, _ = carry
-        return (it < max_iter) & (shift >= tol)
+        def step(carry):
+            centers, _, it, _, hist = carry
+            c_sq = jnp.sum(centers * centers, axis=1)
+            # cross = x_local @ centersᵀ, one gather + segment_sum
+            contrib = centers.T[cc] * d_e[:, None]           # (nnz, k)
+            cross = jax.ops.segment_sum(contrib, lr, num_segments=m_local)
+            dist = jnp.maximum(rsq[:, None] - 2.0 * cross + c_sq[None, :],
+                               0.0)
+            labels = jnp.argmin(dist, axis=1)
+            onehot = jax.nn.one_hot(labels, k, dtype=centers.dtype) \
+                * valid[:, None].astype(centers.dtype)
+            counts = lax.psum(jnp.sum(onehot, axis=0), _mesh.ROWS)
+            # sums = xᵀ onehot: shard-local partial + psum
+            contrib2 = onehot[lr] * d_e[:, None]             # (nnz, k)
+            partial = jax.ops.segment_sum(contrib2, cc,
+                                          num_segments=centers.shape[1])
+            sums = lax.psum(partial, _mesh.ROWS).T           # (k, n)
+            inertia = lax.psum(
+                jnp.sum(jnp.min(dist, axis=1)
+                        * valid.astype(centers.dtype)), _mesh.ROWS)
+            new_centers = jnp.where(counts[:, None] > 0,
+                                    sums / jnp.maximum(counts, 1.0)[:, None],
+                                    centers)
+            shift = jnp.sum((new_centers - centers) ** 2)
+            return new_centers, shift, it + 1, inertia, hist.at[it].set(inertia)
 
-    init = (centers0, jnp.asarray(jnp.inf, centers0.dtype), jnp.int32(0),
-            jnp.asarray(0.0, centers0.dtype))
-    centers, shift, n_iter, inertia = lax.while_loop(cond, step, init)
-    return centers, n_iter, inertia, shift
+        def cond(carry):
+            _, shift, it, _, _ = carry
+            return (it < max_iter) & (shift >= tol)
+
+        init = (c0, jnp.asarray(jnp.inf, c0.dtype), jnp.int32(0),
+                jnp.asarray(0.0, c0.dtype), jnp.zeros((max_iter,), c0.dtype))
+        return lax.while_loop(cond, step, init)
+
+    from jax.sharding import PartitionSpec as P
+    # replication checking stays ON: every loop-carry element descends from
+    # psum outputs, so the varying-axes analysis proves the P() out_specs
+    centers, shift, n_iter, inertia, hist = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(_mesh.ROWS), P(_mesh.ROWS), P(_mesh.ROWS), P(_mesh.ROWS),
+                  P(None, None)),
+        out_specs=(P(), P(), P(), P(), P()),
+        check_vma=True,
+    )(data, lrows, cols, rowsq, centers0)
+    return centers, n_iter, inertia, shift, hist
 
 
 @partial(jax.jit, static_argnames=("shape",))

@@ -224,10 +224,15 @@ class Array:
             out_shape = _broadcast_shape(self._shape, other._shape)
             data = _ew_array_op(self._data, other._data, self._shape, other._shape,
                                 out_shape, op)
-            return Array(data, out_shape, self._reg_shape, False)
+            return Array(data, out_shape, self._reg_shape,
+                         self._sparse and other._sparse)
         data = _ew_scalar_op(self._data, float(other) if not isinstance(other, bool) else other,
                              self._shape, op)
-        return Array(data, self._shape, self._reg_shape, False)
+        # scalar mul/div/pow map zeros to zeros; add/sub of a nonzero
+        # scalar destroys sparsity (the flag is metadata — data is dense)
+        preserves = op in ("mul", "div", "pow") or float(other) == 0.0
+        return Array(data, self._shape, self._reg_shape,
+                     self._sparse and preserves)
 
     def __add__(self, o):  return self._ew(o, "add")
     def __radd__(self, o): return self._ew(o, "add")
@@ -293,15 +298,23 @@ class Array:
 
     def iterator(self, axis=0):
         """Yield row-block (axis=0) or col-block (axis=1) sub-arrays, one per
-        `block_size` stripe — reference `Array._iterator` (SURVEY §3.1)."""
+        `block_size` stripe — reference `Array._iterator` (SURVEY §3.1).
+
+        Stripes are cheap contiguous slices of the padded backing (lax.slice
+        + repad), not general gathers — each yield costs one slice op."""
         n = self._shape[axis]
         step = self._reg_shape[axis]
+        m, c = self._shape
         for start in range(0, n, step):
             stop = min(start + step, n)
             if axis == 0:
-                yield self[start:stop, :]
+                logical = self._data[start:stop, :c]
+                shape = (stop - start, c)
             else:
-                yield self[:, start:stop]
+                logical = self._data[:m, start:stop]
+                shape = (m, stop - start)
+            yield Array._from_logical_padded(_repad(logical, shape), shape,
+                                             None, self._sparse)
 
 
 def _broadcastable(a, b):
@@ -461,18 +474,19 @@ def array(x, block_size=None) -> Array:
         x = x.astype(np.float32)
     if block_size is None:
         block_size = _default_block_size(x.shape, None)
-    _check_block_size(x.shape, block_size)
+    block_size = _check_block_size(x.shape, block_size)
     return Array._from_logical(jnp.asarray(x), reg_shape=block_size, sparse=sparse)
 
 
 def _check_block_size(shape, block_size):
+    """Validate and return the effective block size: oversized blocks clamp
+    to the logical shape (physical layout is mesh-determined anyway — the
+    block size only drives `iterator` stripes and `_reg_shape` metadata)."""
     br, bc = block_size
     if br <= 0 or bc <= 0:
         raise ValueError("block_size entries must be positive")
-    if br > shape[0] and shape[0] > 0 or bc > shape[1] and shape[1] > 0:
-        # reference allows block_size larger than shape only when it equals it;
-        # we accept and clamp (layout is mesh-determined anyway).
-        pass
+    return (min(br, shape[0]) if shape[0] > 0 else br,
+            min(bc, shape[1]) if shape[1] > 0 else bc)
 
 
 def random_array(shape, block_size=None, random_state=None) -> Array:

@@ -10,11 +10,17 @@ accept them and per-block NumPy kernels dispatch to scipy.sparse ops.
 TPU-native design and its honest limits:
 
 - Storage is one `jax.experimental.sparse.BCOO` on device — O(nnz) memory,
-  the role CSR plays for the reference.  No padding is needed: sparse
-  compute is not mesh-sharded in this build (BCOO's ragged buffers do not
-  shard cleanly over a Mesh); products against dense operands materialise
-  MXU-shaped dense results which ARE placed with the library sharding.
-  Row-sharded BCOO (per-shard nnz balancing) is future work.
+  the role CSR plays for the reference.  Dense products against it
+  materialise MXU-shaped results placed with the library sharding.
+- **Row-sharded representation** (`ShardedRows`): the nonzeros are bucketed
+  by row shard into rectangular (p, nnz_max) buffers — data, shard-local
+  row ids, column ids — padded per shard with zero-valued entries so every
+  shard is the same shape (BCOO's ragged buffers do not shard over a Mesh;
+  rectangular buffers do).  `x @ B` is then shard-local (each shard owns
+  disjoint output rows: gather B rows at the entry columns, scale,
+  segment-sum by local row) and `xᵀ @ C` is a shard-local partial plus ONE
+  `psum` over the rows axis — the identical communication structure to the
+  dense KMeans path.  Sparse KMeans runs entirely on this representation.
 - Per-estimator choice (recorded as SURVEY §8 directs):
   * KMeans — native sparse path (`fit`/`predict` accept SparseArray; the
     distance cross-term and the per-cluster sums are `bcoo_dot_general`
@@ -34,6 +40,7 @@ from jax.experimental import sparse as jsparse
 
 from dislib_tpu.data.array import Array
 from dislib_tpu.ops.base import precise
+from dislib_tpu.parallel import mesh as _mesh
 
 __all__ = ["SparseArray"]
 
@@ -152,6 +159,86 @@ class SparseArray:
         data, idx = self._bcoo.data, self._bcoo.indices
         return jax.ops.segment_sum(data * data, idx[:, 0],
                                    num_segments=self._shape[0])
+
+    # -- elementwise (weak-#6 parity: keep sparsity where it is exact) -------
+
+    def _scaled(self, factor):
+        bcoo = jsparse.BCOO((self._bcoo.data * jnp.float32(factor),
+                             self._bcoo.indices), shape=self._bcoo.shape)
+        return SparseArray(bcoo, reg_shape=self._reg_shape)
+
+    def __mul__(self, other):
+        if np.isscalar(other):
+            return self._scaled(other)
+        return NotImplemented
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if np.isscalar(other):
+            return self._scaled(1.0 / other)
+        return NotImplemented
+
+    def __neg__(self):
+        return self._scaled(-1.0)
+
+    def __add__(self, other):
+        """sparse + sparse stays sparse (concatenated-duplicate BCOO);
+        sparse + dense densifies (a dense result anyway)."""
+        if isinstance(other, SparseArray):
+            if other.shape != self.shape:
+                raise ValueError(f"shape mismatch {self.shape} + {other.shape}")
+            data = jnp.concatenate([self._bcoo.data, other._bcoo.data])
+            idx = jnp.concatenate([self._bcoo.indices, other._bcoo.indices])
+            bcoo = jsparse.BCOO((data, idx),
+                                shape=self._bcoo.shape).sum_duplicates()
+            return SparseArray(bcoo, reg_shape=self._reg_shape)
+        if isinstance(other, Array):
+            return self.to_dense() + other
+        return NotImplemented
+
+    def __sub__(self, other):
+        if isinstance(other, SparseArray):
+            return self + other._scaled(-1.0)
+        if isinstance(other, Array):
+            return self.to_dense() - other
+        return NotImplemented
+
+    # -- row-sharded representation ------------------------------------------
+
+    def sharded_rows(self, mesh=None):
+        """(data, local_rows, cols, rowsq) rectangular per-shard buffers,
+        leading axis = shard over the mesh 'rows' axis; padding entries are
+        (v=0, row=0, col=0) so they contribute nothing.  Cached per mesh."""
+        mesh = mesh or _mesh.get_mesh()
+        p = mesh.shape[_mesh.ROWS]
+        cached = getattr(self, "_sharded_cache", None)
+        if cached is not None and cached[0] == p:
+            return cached[1]
+        m = self._shape[0]
+        m_local = -(-m // p)
+        idx = np.asarray(jax.device_get(self._bcoo.indices))
+        val = np.asarray(jax.device_get(self._bcoo.data))
+        shard = idx[:, 0] // m_local
+        counts = np.bincount(shard, minlength=p)
+        nnz_max = max(1, int(counts.max()))
+        data = np.zeros((p, nnz_max), np.float32)
+        lrows = np.zeros((p, nnz_max), np.int32)
+        cols = np.zeros((p, nnz_max), np.int32)
+        for s in range(p):
+            sel = shard == s
+            k = int(counts[s])
+            data[s, :k] = val[sel]
+            lrows[s, :k] = idx[sel, 0] - s * m_local
+            cols[s, :k] = idx[sel, 1]
+        rowsq = np.zeros((p, m_local), np.float32)
+        np.add.at(rowsq, (shard, idx[:, 0] - shard * m_local), val * val)
+        sh = jax.sharding.NamedSharding(mesh,
+                                        jax.sharding.PartitionSpec(_mesh.ROWS))
+        out = tuple(jax.device_put(jnp.asarray(a), sh)
+                    for a in (data, lrows, cols, rowsq))
+        self._sharded_cache = (p, out)
+        return out
 
 
 @jax.jit

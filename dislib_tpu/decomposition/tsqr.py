@@ -71,16 +71,19 @@ def _tsqr_shardmap(av, mesh, p):
         q2, r = jnp.linalg.qr(r_stack, mode="reduced")       # redundant per shard
         idx = lax.axis_index(_mesh.ROWS)
         q2_i = lax.dynamic_slice(q2, (idx * n, 0), (n, n))
+        # R is computed identically on every shard, but the static
+        # varying-axes analysis can't see that through the local QR; a
+        # psum/p makes the replication PROVABLE so check_vma stays ON
+        # (SURVEY §6 race-detection row: shard_map replication checking is
+        # the collective-correctness sanitizer).  Cost: one (n, n) psum.
+        r = lax.psum(r, _mesh.ROWS) / p
         return q1 @ q2_i, r
 
-    # check_vma=False: R comes out of an identical computation on the
-    # all_gathered stack on every shard — replicated in fact, but the static
-    # varying-axes analysis can't prove it. Tests assert QR == A.
     q, r = jax.shard_map(
         local, mesh=mesh,
         in_specs=P(_mesh.ROWS, None),
         out_specs=(P(_mesh.ROWS, None), P(None, None)),
-        check_vma=False,
+        check_vma=True,
     )(av)
     return q, r
 

@@ -30,6 +30,7 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
+from dislib_tpu.utils.dlog import verbose_logger
 
 
 def soft_threshold(v, k):
@@ -60,16 +61,18 @@ class ADMM(BaseEstimator):
     ----------
     z_ : ndarray (n_features,) — consensus solution.
     n_iter_ : int ;  converged_ : bool
+    history_ : ndarray (n_iter_,) — per-iteration primal residual (SURVEY §6).
     """
 
     def __init__(self, z_prox=None, prox_kappa=0.0, rho=1.0, max_iter=100,
-                 abstol=1e-4, reltol=1e-2):
+                 abstol=1e-4, reltol=1e-2, verbose=False):
         self.z_prox = z_prox
         self.prox_kappa = prox_kappa
         self.rho = rho
         self.max_iter = max_iter
         self.abstol = abstol
         self.reltol = reltol
+        self.verbose = verbose
 
     def fit(self, x: Array, y: Array):
         """Solve consensus least-squares + prox over row-partitions of (x, y)."""
@@ -78,7 +81,7 @@ class ADMM(BaseEstimator):
         if x.shape[0] != y.shape[0]:
             raise ValueError(f"x and y row counts differ: {x.shape[0]} != {y.shape[0]}")
         prox = self.z_prox if self.z_prox is not None else identity_prox
-        z, n_iter, converged = _admm_fit(
+        z, n_iter, converged, hist = _admm_fit(
             x._data, y._data, x.shape, (y.shape[0], y.shape[1]),
             float(self.rho), jnp.float32(self.prox_kappa),
             float(self.abstol), float(self.reltol),
@@ -86,6 +89,11 @@ class ADMM(BaseEstimator):
         self.z_ = np.asarray(jax.device_get(z)).ravel()
         self.n_iter_ = int(n_iter)
         self.converged_ = bool(converged)
+        self.history_ = np.asarray(
+            jax.device_get(hist), dtype=np.float64)[: self.n_iter_]
+        verbose_logger("admm", self.verbose).info(
+            "converged=%s n_iter=%d primal_residual=%.3g", self.converged_,
+            self.n_iter_, self.history_[-1] if len(self.history_) else np.nan)
         return self
 
 
@@ -108,7 +116,7 @@ def _admm_fit(xp, yp, x_shape, y_shape, rho, kappa, abstol, reltol, max_iter, pr
             return jax.scipy.linalg.solve_triangular(chol.T, w, lower=False)
 
         def step(carry):
-            x_i, z, u_i, _, _, it = carry
+            x_i, z, u_i, _, _, it, hist = carry
             x_i = solve(atb + rho * (z - u_i))
             z_old = z
             zbar = lax.pmean(x_i + u_i, _mesh.ROWS)
@@ -123,21 +131,28 @@ def _admm_fit(xp, yp, x_shape, y_shape, rho, kappa, abstol, reltol, max_iter, pr
             e_dual = (jnp.sqrt(jnp.asarray(n * p, x_i.dtype)) * abstol + reltol *
                       jnp.sqrt(lax.psum(jnp.sum((rho * u_i) ** 2), _mesh.ROWS)))
             conv = (r < e_pri) & (s < e_dual)
-            return x_i, z, u_i, conv, r, it + 1
+            return x_i, z, u_i, conv, r, it + 1, hist.at[it].set(r)
 
         def cond(carry):
-            _, _, _, conv, _, it = carry
+            _, _, _, conv, _, it, _ = carry
             return (~conv) & (it < max_iter)
 
         zeros = jnp.zeros((n,), xv.dtype)
-        x_i, z, u_i, conv, _, it = lax.while_loop(
-            cond, step, (zeros, zeros, zeros, jnp.asarray(False), jnp.asarray(0.0, xv.dtype), jnp.int32(0)))
-        return z[None, :], it, conv
+        # x_i/u_i are shard-varying through the loop; mark the (constant)
+        # initial values varying too so the carry's vma types line up and
+        # replication checking can stay ON for the whole shard_map
+        x0 = lax.pcast(zeros, _mesh.ROWS, to="varying")
+        u0 = lax.pcast(zeros, _mesh.ROWS, to="varying")
+        x_i, z, u_i, conv, _, it, hist = lax.while_loop(
+            cond, step, (x0, zeros, u0, jnp.asarray(False),
+                         jnp.asarray(0.0, xv.dtype), jnp.int32(0),
+                         jnp.zeros((max_iter,), xv.dtype)))
+        return z[None, :], it, conv, hist
 
-    z, it, conv = jax.shard_map(
+    z, it, conv, hist = jax.shard_map(
         agent, mesh=mesh,
         in_specs=(P(_mesh.ROWS, None), P(_mesh.ROWS, None)),
-        out_specs=(P(None, None), P(), P()),
-        check_vma=False,
+        out_specs=(P(None, None), P(), P(), P()),
+        check_vma=True,
     )(xv, yv)
-    return z[0], it, conv
+    return z[0], it, conv, hist

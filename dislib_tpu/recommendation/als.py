@@ -38,6 +38,7 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
+from dislib_tpu.utils.dlog import verbose_logger
 
 
 class ALS(BaseEstimator):
@@ -53,7 +54,7 @@ class ALS(BaseEstimator):
         Convergence threshold on |ΔRMSE| between iterations.
     max_iter : int, default 100
     random_state : int or None
-    verbose : bool — kept for API parity.
+    verbose : bool — log per-chunk RMSE under the dslib.als logger.
     arity : int — accepted and ignored (reference reduction-tree fan-in;
         reduction topology is XLA's job now).
 
@@ -64,6 +65,7 @@ class ALS(BaseEstimator):
     converged_ : bool
     n_iter_ : int
     rmse_ : float — RMSE over the convergence ratings at the last iteration.
+    history_ : ndarray (n_iter_,) — per-iteration held-out RMSE (SURVEY §6).
     """
 
     def __init__(self, n_f=8, lambda_=0.065, tol=1e-4, max_iter=100,
@@ -112,17 +114,21 @@ class ALS(BaseEstimator):
                 rmse = float(snap["rmse"])
                 it = int(snap["n_iter"])
                 conv = bool(snap.get("converged", False))
+        history = []
+        log = verbose_logger("als", self.verbose)
         while not conv:
             chunk = self.max_iter - it if checkpoint is None else \
                 min(checkpoint.every, self.max_iter - it)
             if chunk <= 0:
                 break
-            u, v, rmse_dev, n_done, conv_dev = _als_fit(
+            u, v, rmse_dev, n_done, conv_dev, hist = _als_fit(
                 x._data, test_p, x.shape, int(self.n_f), float(self.lambda_),
                 float(self.tol), chunk, int(seed), init_state=state)
             it += int(n_done)
             rmse = float(rmse_dev)
             conv = bool(conv_dev)
+            history.extend(np.asarray(jax.device_get(hist))[: int(n_done)])
+            log.info("iter %d: rmse=%.6g", it, rmse)
             state = (u, v, rmse)
             if checkpoint is not None:
                 checkpoint.save({"users": np.asarray(jax.device_get(u)),
@@ -138,6 +144,7 @@ class ALS(BaseEstimator):
         self.rmse_ = float(rmse)
         self.n_iter_ = it
         self.converged_ = conv
+        self.history_ = np.asarray(history, dtype=np.float64)
         return self
 
     def predict_user(self, user_id: int) -> np.ndarray:
@@ -203,17 +210,18 @@ def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed,
         return jnp.sqrt(jnp.sum(se) / jnp.maximum(jnp.sum(tmask), 1.0))
 
     def step(carry):
-        u, v, prev_rmse, it, _ = carry
+        u, v, prev_rmse, it, _, hist = carry
         u = _solve_factors(rp, mask, v, lambda_, n_f)
         v = _solve_factors(rp.T, mask.T, u, lambda_, n_f)
         cur = rmse(u, v)
         conv = jnp.abs(prev_rmse - cur) < tol
-        return u, v, cur, it + 1, conv
+        return u, v, cur, it + 1, conv, hist.at[it].set(cur)
 
     def cond(carry):
-        *_, it, conv = carry
+        _, _, _, it, conv, _ = carry
         return (it < max_iter) & (~conv)
 
-    init = (u0, v0, prev0, jnp.int32(0), jnp.asarray(False))
-    u, v, cur, n_iter, conv = lax.while_loop(cond, step, init)
-    return u, v, cur, n_iter, conv
+    init = (u0, v0, prev0, jnp.int32(0), jnp.asarray(False),
+            jnp.zeros((max_iter,), rp.dtype))
+    u, v, cur, n_iter, conv, hist = lax.while_loop(cond, step, init)
+    return u, v, cur, n_iter, conv, hist

@@ -39,10 +39,25 @@ class FitCheckpoint:
             raise ValueError("every must be >= 1")
 
     def save(self, state: dict) -> None:
-        """Atomically persist a dict of ndarrays/scalars."""
-        tmp = self.path + ".tmp.npz"      # np.savez wants an .npz suffix
-        np.savez(tmp, **state)
-        os.replace(tmp, self.path)
+        """Atomically persist a dict of ndarrays/scalars.
+
+        A unique tmp file (mkstemp) in the target directory keeps concurrent
+        fits sharing a path from clobbering each other's staging file, and
+        the fsync-before-replace ensures the rename never lands ahead of the
+        data on power loss."""
+        import tempfile
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        fd, tmp = tempfile.mkstemp(suffix=".npz", dir=d)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **state)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
 
     def load(self) -> dict | None:
         """Return the saved state, or None if no checkpoint exists."""

@@ -1,0 +1,31 @@
+"""`dslib.*` logging namespace (SURVEY.md §6 metrics/logging row: "Python
+`logging` under `dslib.*` namespace with per-estimator `verbose`").
+
+The reference leaves logging to the COMPSs runtime's log tree; here each
+estimator logs fit summaries under ``dslib.<estimator>``.  ``verbose=True``
+on an estimator attaches a stderr handler at INFO for its logger (idempotent)
+so per-fit progress is visible without any logging config.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_ROOT = "dslib"
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"{_ROOT}.{name}")
+
+
+def verbose_logger(name: str, verbose: bool) -> logging.Logger:
+    """Logger for an estimator fit; verbose=True ensures INFO is emitted."""
+    log = get_logger(name)
+    if verbose and not getattr(log, "_dslib_handler", False):
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter("%(name)s: %(message)s"))
+        log.addHandler(h)
+        log._dslib_handler = True
+    if verbose:
+        log.setLevel(logging.INFO)
+    return log

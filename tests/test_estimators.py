@@ -231,3 +231,50 @@ class TestReviewRegressions:
         nn = NearestNeighbors(n_neighbors=2).fit(ds.array(rng.rand(10, 2)))
         _, i = nn.kneighbors(ds.array(rng.rand(4, 2)))
         assert np.issubdtype(i.collect().dtype, np.integer)
+
+
+class TestObservability:
+    """SURVEY §6 metrics row: per-iteration history_ with
+    len(history_) == n_iter_ on every iterative estimator."""
+
+    def test_kmeans_history(self, rng):
+        from dislib_tpu.cluster import KMeans
+        x = ds.array(rng.rand(100, 4).astype(np.float32))
+        km = KMeans(n_clusters=3, random_state=0, max_iter=7, tol=0.0).fit(x)
+        assert len(km.history_) == km.n_iter_ == 7
+        assert np.all(np.diff(km.history_) <= 1e-3)  # inertia non-increasing
+
+    def test_gmm_history_and_score(self, rng):
+        from dislib_tpu.cluster import GaussianMixture
+        x = ds.array(np.vstack([rng.randn(60, 3) - 4,
+                                rng.randn(60, 3) + 4]).astype(np.float32))
+        gm = GaussianMixture(n_components=2, max_iter=6, tol=0.0,
+                             random_state=0).fit(x)
+        assert len(gm.history_) == gm.n_iter_
+        assert gm.history_[-1] == pytest.approx(gm.lower_bound_, rel=1e-5)
+        # score = mean log-likelihood, matches the final lower bound here
+        assert gm.score(x) == pytest.approx(gm.lower_bound_, rel=1e-3)
+
+    def test_admm_history(self, rng):
+        from dislib_tpu.optimization import ADMM
+        x = rng.rand(64, 5).astype(np.float32)
+        y = (x @ rng.rand(5).astype(np.float32))[:, None]
+        est = ADMM(max_iter=20).fit(ds.array(x), ds.array(y))
+        assert len(est.history_) == est.n_iter_
+        assert est.history_[-1] < est.history_[0]  # residual decreases
+
+    def test_als_history(self, rng):
+        from dislib_tpu.recommendation import ALS
+        ratings = (rng.rand(40, 25) * (rng.rand(40, 25) < 0.4)).astype(np.float32)
+        als = ALS(n_f=4, max_iter=5, tol=0.0, random_state=0).fit(
+            ds.array(ratings))
+        assert len(als.history_) == als.n_iter_ == 5
+        assert als.history_[-1] == pytest.approx(als.rmse_, rel=1e-5)
+
+    def test_verbose_logs(self, rng, caplog):
+        import logging
+        from dislib_tpu.cluster import KMeans
+        x = ds.array(rng.rand(50, 3).astype(np.float32))
+        with caplog.at_level(logging.INFO, logger="dslib.kmeans"):
+            KMeans(n_clusters=2, random_state=0, verbose=True).fit(x)
+        assert any("inertia" in r.message for r in caplog.records)

@@ -3,6 +3,7 @@
 import os
 
 import numpy as np
+import pytest
 
 import dislib_tpu as ds
 
@@ -74,3 +75,31 @@ class TestMdcrd:
         a = ds.load_mdcrd_file(path, n_atoms=2)
         assert a.shape == (2, 6)
         np.testing.assert_allclose(a.collect().ravel(), coords, atol=1e-3)
+
+
+class TestByteRangeIngest:
+    """Per-host parallel ingest (SURVEY §3.1 I/O, VERDICT r1 missing #7):
+    the byte-range splitter must partition a text file exactly — every line
+    in exactly one slice, concatenation order-preserving — for any host
+    count, including slices smaller than one line."""
+
+    @pytest.mark.parametrize("pcount", [1, 2, 3, 7, 16])
+    def test_ranges_partition_exactly(self, rng, tmp_path, pcount):
+        from dislib_tpu.data.io import _parse_txt_range
+        x = rng.rand(53, 4).astype(np.float32)
+        path = tmp_path / "rows.csv"
+        np.savetxt(path, x, delimiter=",")
+        parts = [_parse_txt_range(str(path), i, pcount, ",", np.float32)
+                 for i in range(pcount)]
+        got = np.concatenate([p for p in parts if p.size], axis=0)
+        np.testing.assert_allclose(got, x, rtol=1e-5)
+
+    def test_more_ranges_than_lines(self, rng, tmp_path):
+        from dislib_tpu.data.io import _parse_txt_range
+        x = rng.rand(3, 2).astype(np.float32)
+        path = tmp_path / "tiny.csv"
+        np.savetxt(path, x, delimiter=",")
+        parts = [_parse_txt_range(str(path), i, 11, ",", np.float32)
+                 for i in range(11)]
+        got = np.concatenate([p for p in parts if p.size], axis=0)
+        np.testing.assert_allclose(got, x, rtol=1e-5)

@@ -1,0 +1,79 @@
+"""Sanitizer modes (SURVEY.md §6 race-detection row; VERDICT r1 #9).
+
+The library's collective-correctness sanitizer — `shard_map`
+replication checking (`check_vma=True`) — is permanently ON in every
+shard_map (tsqr, ADMM, sparse KMeans), so the whole suite exercises it.
+This file adds the two CI sanitizer modes the reference's runtime-level
+checks map to:
+
+- `jax.debug_nans`: any NaN materialising in a fit raises immediately
+  (the analog of the runtime's failed-task surfacing);
+- `jax.disable_jit`: the same device code runs op-by-op in eager mode —
+  catches tracing-only assumptions (shapes, dtypes, Python control flow).
+
+Kept to small shapes so the no-jit paths stay fast.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import KMeans, GaussianMixture
+from dislib_tpu.optimization import ADMM
+
+
+@pytest.fixture
+def small(rng):
+    return ds.array(rng.rand(48, 4).astype(np.float32), block_size=(8, 4))
+
+
+class TestDebugNans:
+    def test_kmeans_fit_clean(self, rng, small):
+        with jax.debug_nans(True):
+            km = KMeans(n_clusters=2, random_state=0, max_iter=3).fit(small)
+        assert np.isfinite(km.centers_).all()
+
+    def test_gmm_fit_clean(self, rng, small):
+        with jax.debug_nans(True):
+            gm = GaussianMixture(n_components=2, max_iter=3,
+                                 random_state=0).fit(small)
+        assert np.isfinite(gm.lower_bound_)
+
+    def test_nan_input_is_caught(self, rng):
+        bad = rng.rand(16, 3).astype(np.float32)
+        bad[3, 1] = np.nan
+        with jax.debug_nans(True):
+            with pytest.raises(Exception, match="[Nn]a[Nn]"):
+                KMeans(n_clusters=2, random_state=0, max_iter=2).fit(
+                    ds.array(bad))
+
+    def test_tsqr_clean(self, rng):
+        x = ds.array(rng.rand(64, 6).astype(np.float32))
+        with jax.debug_nans(True):
+            q, r = ds.tsqr(x)
+            assert np.isfinite(q.collect()).all()
+
+
+class TestNoJit:
+    def test_kmeans_no_jit_matches_jit(self, rng, small):
+        init = np.asarray(small.collect()[:2])
+        jit_km = KMeans(n_clusters=2, init=init, max_iter=3, tol=0.0).fit(small)
+        with jax.disable_jit():
+            eager_km = KMeans(n_clusters=2, init=init, max_iter=3,
+                              tol=0.0).fit(small)
+        np.testing.assert_allclose(eager_km.centers_, jit_km.centers_,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_admm_no_jit(self, rng):
+        x = rng.rand(32, 3).astype(np.float32)
+        y = (x @ np.ones(3, np.float32))[:, None]
+        with jax.disable_jit():
+            est = ADMM(max_iter=5).fit(ds.array(x), ds.array(y))
+        assert len(est.history_) == est.n_iter_ == 5
+
+    def test_matmul_no_jit(self, rng):
+        a, b = rng.rand(9, 5), rng.rand(5, 7)
+        with jax.disable_jit():
+            got = ds.matmul(ds.array(a), ds.array(b)).collect()
+        np.testing.assert_allclose(got, a @ b, rtol=1e-4)

@@ -107,3 +107,69 @@ class TestSvmlightSparse:
                         np.float32)
         np.testing.assert_allclose(got, want)
         np.testing.assert_allclose(y.collect().ravel(), [1, 0, 1])
+
+
+class TestShardedRows:
+    def test_spmm_equivalence(self, rng):
+        """sharded_rows buffers reproduce x @ B and x.T @ C exactly."""
+        import jax.numpy as jnp
+        import scipy.sparse as sp
+        from dislib_tpu.parallel import mesh as _mesh
+        dense = (rng.rand(37, 9) * (rng.rand(37, 9) < 0.3)).astype(np.float32)
+        xs = SparseArray.from_scipy(sp.csr_matrix(dense))
+        data, lrows, cols, rowsq = xs.sharded_rows()
+        p, m_local = rowsq.shape
+        # reconstruct the dense matrix from the sharded buffers
+        rec = np.zeros((p * m_local, 9), np.float32)
+        d, lr, cc = (np.asarray(a) for a in (data, lrows, cols))
+        for s in range(p):
+            np.add.at(rec[s * m_local:(s + 1) * m_local], (lr[s], cc[s]), d[s])
+        np.testing.assert_allclose(rec[:37], dense, rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(rowsq).reshape(-1)[:37], (dense ** 2).sum(1), rtol=1e-5)
+
+    def test_sparse_kmeans_matches_dense_on_mesh(self, rng):
+        """Oracle equality dense vs sharded-sparse path on the multi-device
+        mesh (SURVEY §8 hard part 2 done-criterion)."""
+        import scipy.sparse as sp
+        dense = (rng.rand(200, 6) * (rng.rand(200, 6) < 0.4)).astype(np.float32)
+        init = dense[:3].copy()
+        km_d = KMeans(n_clusters=3, init=init, max_iter=15, tol=0.0).fit(
+            ds.array(dense))
+        km_s = KMeans(n_clusters=3, init=init, max_iter=15, tol=0.0).fit(
+            SparseArray.from_scipy(sp.csr_matrix(dense)))
+        np.testing.assert_allclose(km_s.centers_, km_d.centers_,
+                                   rtol=1e-3, atol=1e-3)
+        assert abs(km_s.inertia_ - km_d.inertia_) / km_d.inertia_ < 1e-3
+
+
+class TestSparseElementwise:
+    def test_scalar_ops_stay_sparse(self, rng):
+        import scipy.sparse as sp
+        dense = (rng.rand(10, 5) * (rng.rand(10, 5) < 0.5)).astype(np.float32)
+        xs = SparseArray.from_scipy(sp.csr_matrix(dense))
+        out = (xs * 2.0) / 4.0
+        assert isinstance(out, SparseArray)
+        np.testing.assert_allclose(out.collect().toarray(), dense / 2.0,
+                                   rtol=1e-6)
+        neg = -xs
+        np.testing.assert_allclose(neg.collect().toarray(), -dense, rtol=1e-6)
+
+    def test_sparse_add_sub(self, rng):
+        import scipy.sparse as sp
+        a = (rng.rand(8, 4) * (rng.rand(8, 4) < 0.5)).astype(np.float32)
+        b = (rng.rand(8, 4) * (rng.rand(8, 4) < 0.5)).astype(np.float32)
+        sa = SparseArray.from_scipy(sp.csr_matrix(a))
+        sb = SparseArray.from_scipy(sp.csr_matrix(b))
+        tot = sa + sb
+        assert isinstance(tot, SparseArray)
+        np.testing.assert_allclose(tot.collect().toarray(), a + b, rtol=1e-6)
+        diff = sa - sb
+        np.testing.assert_allclose(diff.collect().toarray(), a - b, rtol=1e-6)
+
+    def test_shape_mismatch_raises(self, rng):
+        import scipy.sparse as sp
+        sa = SparseArray.from_scipy(sp.csr_matrix(np.eye(4, dtype=np.float32)))
+        sb = SparseArray.from_scipy(sp.csr_matrix(np.eye(5, dtype=np.float32)))
+        with pytest.raises(ValueError):
+            sa + sb
