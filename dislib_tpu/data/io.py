@@ -48,13 +48,28 @@ def _read_line_range(path, idx, count):
         return f.read(hi - lo)
 
 
-def _parse_txt_range(path, idx, count, delimiter, dtype):
-    """Parse one byte-range slice of a delimited text file (per-host work)."""
-    buf = _read_line_range(path, idx, count)
+def _parse_txt_buf(buf, delimiter, dtype):
+    """Parse a delimited-text byte buffer: native multi-threaded parser
+    (dislib_tpu.native fastio, C++) when available and the target dtype is
+    float32, NumPy otherwise — the native layer is never a correctness
+    dependency."""
     if not buf.strip():
         return np.zeros((0, 0), dtype=dtype)
+    if np.dtype(dtype) == np.float32:
+        from dislib_tpu import native as _native
+        if _native.get_lib() is not None:
+            try:
+                return _native.parse_text(buf, delimiter=delimiter)
+            except _native.NativeUnavailable:
+                pass     # ragged/malformed: np.loadtxt raises the real error
     return np.loadtxt(_io.BytesIO(buf), delimiter=delimiter, dtype=dtype,
                       ndmin=2)
+
+
+def _parse_txt_range(path, idx, count, delimiter, dtype):
+    """Parse one byte-range slice of a delimited text file (per-host work)."""
+    return _parse_txt_buf(_read_line_range(path, idx, count), delimiter,
+                          dtype)
 
 
 def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32):
@@ -67,7 +82,10 @@ def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32):
     import jax
     pcount = jax.process_count()
     if pcount <= 1:
-        data = np.loadtxt(path, delimiter=delimiter, dtype=dtype, ndmin=2)
+        with open(path, "rb") as f:
+            data = _parse_txt_buf(f.read(), delimiter, dtype)
+        if data.size == 0:
+            data = np.loadtxt(path, delimiter=delimiter, dtype=dtype, ndmin=2)
         return _ds_array(data, block_size=block_size)
     from jax.experimental import multihost_utils
     local = _parse_txt_range(path, jax.process_index(), pcount, delimiter,
@@ -100,7 +118,33 @@ def load_npy_file(path, block_size=None):
 def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True):
     """Load a svmlight/libsvm file -> (x, y) ds-arrays (reference parity).
 
-    Hand-rolled parser (no sklearn dependency in the library path)."""
+    Hand-rolled parser (no sklearn dependency in the library path); native
+    C++ single-pass CSR parser (`dislib_tpu.native.parse_svmlight`) when
+    available, pure-Python fallback otherwise.  Duplicate feature indices
+    sum (CSR semantics, = sklearn's loader) on both paths."""
+    from dislib_tpu import native as _native
+    parsed = None
+    if _native.get_lib() is not None:
+        try:
+            with open(path, "rb") as f:
+                parsed = _native.parse_svmlight(f.read())
+        except _native.NativeUnavailable:
+            parsed = None                    # malformed → Python path raises
+    if parsed is not None:
+        labels_a, indptr, indices, data, nfeat = parsed
+        n = labels_a.shape[0]
+        m = n_features if n_features is not None else nfeat
+        import scipy.sparse as sp
+        csr = sp.csr_matrix((data, indices, indptr), shape=(n, m))
+        if store_sparse:
+            from dislib_tpu.data.sparse import SparseArray
+            x = SparseArray.from_scipy(csr, block_size=block_size)
+        else:
+            x = _ds_array(csr.toarray().astype(np.float32),
+                          block_size=block_size)
+        y = _ds_array(labels_a.reshape(-1, 1),
+                      block_size=(block_size[0], 1) if block_size else None)
+        return x, y
     rows, labels = [], []
     max_feat = 0
     with open(path) as f:
@@ -115,7 +159,7 @@ def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True
                 if tok.startswith("#"):
                     break
                 k, v = tok.split(":")
-                feats[int(k)] = float(v)
+                feats[int(k)] = feats.get(int(k), 0.0) + float(v)
             if feats:
                 max_feat = max(max_feat, max(feats))
             rows.append(feats)
@@ -141,12 +185,23 @@ def load_mdcrd_file(path, block_size=None, n_atoms=None, copy_first=False):
     (reference: load_mdcrd_file for the Daura/MD pipeline)."""
     if n_atoms is None:
         raise ValueError("n_atoms is required for mdcrd parsing")
-    values = []
-    with open(path) as f:
-        next(f)  # title line
-        for line in f:
-            values.extend(float(line[i:i + 8]) for i in range(0, len(line.rstrip("\n")), 8)
-                          if line[i:i + 8].strip())
+    from dislib_tpu import native as _native
+    values = None
+    if _native.get_lib() is not None:
+        try:
+            with open(path, "rb") as f:
+                values = _native.parse_mdcrd(f.read())
+        except _native.NativeUnavailable:
+            values = None                    # bad field → Python path raises
+    if values is None:
+        vals = []
+        with open(path) as f:
+            next(f)  # title line
+            for line in f:
+                vals.extend(float(line[i:i + 8])
+                            for i in range(0, len(line.rstrip("\n")), 8)
+                            if line[i:i + 8].strip())
+        values = np.asarray(vals, dtype=np.float32)
     per_frame = 3 * n_atoms
     n_frames = len(values) // per_frame
     data = np.asarray(values[: n_frames * per_frame], dtype=np.float32)
