@@ -29,6 +29,24 @@ from dislib_tpu import cluster, classification, regression, neighbors, \
     preprocessing, optimization, model_selection, recommendation, \
     trees  # noqa: E402,F401
 
+# estimator classes re-exported at top level so every name in the SURVEY §8
+# parity contract is importable from `dislib_tpu` directly (their canonical
+# homes stay the reference-parity submodules above)
+from dislib_tpu.cluster import KMeans, GaussianMixture, DBSCAN, Daura
+from dislib_tpu.classification import CascadeSVM, KNeighborsClassifier
+from dislib_tpu.trees import (
+    RandomForestClassifier, RandomForestRegressor,
+    DecisionTreeClassifier, DecisionTreeRegressor,
+)
+from dislib_tpu.neighbors import NearestNeighbors
+from dislib_tpu.regression import LinearRegression, Lasso
+from dislib_tpu.optimization import ADMM
+from dislib_tpu.recommendation import ALS
+from dislib_tpu.preprocessing import StandardScaler, MinMaxScaler
+from dislib_tpu.model_selection import (
+    KFold, GridSearchCV, RandomizedSearchCV,
+)
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -40,4 +58,11 @@ __all__ = [
     "matmul", "kron", "svd", "qr",
     "tsqr", "random_svd", "lanczos_svd", "PCA",
     "shuffle", "train_test_split", "save_model", "load_model",
+    "KMeans", "GaussianMixture", "DBSCAN", "Daura",
+    "CascadeSVM", "KNeighborsClassifier",
+    "RandomForestClassifier", "RandomForestRegressor",
+    "DecisionTreeClassifier", "DecisionTreeRegressor",
+    "NearestNeighbors", "LinearRegression", "Lasso", "ADMM", "ALS",
+    "StandardScaler", "MinMaxScaler",
+    "KFold", "GridSearchCV", "RandomizedSearchCV",
 ]

@@ -8,8 +8,11 @@ the small bidiagonal system is solved replicated on every device.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from dislib_tpu.data.array import Array
 
@@ -27,32 +30,30 @@ def lanczos_svd(a: Array, k: int = 6, bs: int | None = None, rank: int | None = 
     k = singular_values or rank or k
     m, n = a.shape
     steps = min(num_iterations or max(2 * k, k + 8), min(m, n))
-    av = a._data[:m, :n].astype(jnp.float32)
-    u, s, v = _gkl(av, steps, int(0 if random_state is None else random_state))
-    return (Array._from_logical(u[:, :k]),
+    # run on the padded sharded backing (pad rows/cols are zero, so GEMVs
+    # are exact and the operand never gathers; the Lanczos vector v is
+    # masked once at init and its pad entries stay exactly zero)
+    u, s, v = _gkl(a._data.astype(jnp.float32), n, steps,
+                   jnp.uint32(0 if random_state is None else random_state))
+    return (Array._from_logical(u[:m, :k]),
             Array._from_logical(s[:k].reshape(1, -1)),
-            Array._from_logical(v[:, :k]))
+            Array._from_logical(v[:n, :k]))
 
 
-def _gkl(a, steps, seed):
+@partial(jax.jit, static_argnames=("n_valid", "steps"))
+def _gkl(a, n_valid, steps, seed):
     m, n = a.shape
     key = jax.random.PRNGKey(seed)
     v0 = jax.random.normal(key, (n,), dtype=jnp.float32)
+    v0 = v0 * (lax.broadcasted_iota(jnp.int32, (n,), 0) < n_valid)
     v0 = v0 / jnp.linalg.norm(v0)
 
-    vs = jnp.zeros((n, steps), jnp.float32)
-    us = jnp.zeros((m, steps), jnp.float32)
-    alphas = jnp.zeros((steps,), jnp.float32)
-    betas = jnp.zeros((steps,), jnp.float32)
-
-    v = v0
-    beta = jnp.float32(0.0)
-    u = jnp.zeros((m,), jnp.float32)
-    # python loop: steps is static & modest; each iteration is sharded GEMV
-    for j in range(steps):
+    def body(j, carry):
+        vs, us, alphas, betas, v, u, beta = carry
         vs = vs.at[:, j].set(v)
         u = a @ v - beta * u
-        # full reorthogonalisation against previous U
+        # full reorthogonalisation against previous U (unfilled cols are
+        # zero and contribute nothing)
         u = u - us @ (us.T @ u)
         alpha = jnp.linalg.norm(u)
         u = u / jnp.where(alpha < 1e-30, 1.0, alpha)
@@ -64,6 +65,14 @@ def _gkl(a, steps, seed):
         beta = jnp.linalg.norm(w)
         betas = betas.at[j].set(beta)
         v = w / jnp.where(beta < 1e-30, 1.0, beta)
+        return vs, us, alphas, betas, v, u, beta
+
+    init = (jnp.zeros((n, steps), jnp.float32),
+            jnp.zeros((m, steps), jnp.float32),
+            jnp.zeros((steps,), jnp.float32),
+            jnp.zeros((steps,), jnp.float32),
+            v0, jnp.zeros((m,), jnp.float32), jnp.float32(0.0))
+    vs, us, alphas, betas, _, _, _ = lax.fori_loop(0, steps, body, init)
 
     # bidiagonal B: alphas on diag, betas[0:-1] on superdiag
     b = jnp.diag(alphas) + jnp.diag(betas[:-1], k=1)

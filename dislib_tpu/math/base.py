@@ -125,25 +125,30 @@ def svd(a: Array, compute_uv: bool = True, sort: bool = True,
     a round commute — the same property the reference's task graph exploits
     for parallelism across pairs)."""
     m, n = a.shape
-    av = a._data[: m, : n].astype(jnp.float32)
-    u, s, v = _jacobi_svd(av, eps, max_sweeps)
-    if sort:
-        order = jnp.argsort(-s)
-        s = s[order]
-        u = u[:, order]
-        v = v[:, order]
-    s_arr = Array._from_logical(s.reshape(1, -1))
+    # Operate on the full padded backing: pad rows/cols are zero under the
+    # pad-and-mask invariant, so they contribute nothing to column dot
+    # products and their rotations are exact no-ops (off-diagonal = 0) —
+    # the input stays row-sharded on the mesh instead of being gathered by
+    # an eager logical slice (round-2 fix for the replicated-SVD ceiling).
+    u, s, v = _jacobi_svd(a._data.astype(jnp.float32), n, sort, eps,
+                          max_sweeps)
+    s_arr = Array._from_logical(s[:n].reshape(1, -1))
     if not compute_uv:
         return s_arr
-    return (Array._from_logical(u), s_arr, Array._from_logical(v))
+    u_arr = Array._from_logical_padded(u, (m, n), None, False)
+    # v already satisfies the (n, n) pad-and-mask invariant: pad rows/cols
+    # zeroed in-kernel and the stable sort keeps valid columns first
+    v_arr = Array._from_logical_padded(v, (n, n), None, False)
+    return (u_arr, s_arr, v_arr)
 
 
-@partial(jax.jit, static_argnames=("max_sweeps",))
+@partial(jax.jit, static_argnames=("n_valid", "sort", "max_sweeps"))
 @precise
-def _jacobi_svd(a, eps, max_sweeps):
+def _jacobi_svd(a, n_valid, sort, eps, max_sweeps):
     m, n = a.shape
     # round-robin pairings: n-1 rounds, each pairing all columns once
     pairs = _round_robin_pairs(n)
+    shard = _mesh.data_sharding()
 
     def rotate_round(carry, pr):
         u, v = carry
@@ -171,6 +176,10 @@ def _jacobi_svd(a, eps, max_sweeps):
     def sweep(carry):
         u, v, _, it = carry
         (u, v), offs = lax.scan(rotate_round, (u, v), pairs)
+        # keep U row-sharded across sweeps (rotations are column-local, so
+        # the mesh's row axis carries through each round; without the
+        # constraint SPMD may gather the carry after the column scatters)
+        u = lax.with_sharding_constraint(u, shard)
         return u, v, jnp.max(offs), it + 1
 
     def cond(carry):
@@ -182,6 +191,18 @@ def _jacobi_svd(a, eps, max_sweeps):
     u, v, _, _ = lax.while_loop(cond, sweep, (u0, v0, jnp.asarray(jnp.inf), 0))
     s = jnp.linalg.norm(u, axis=0)
     u = u / jnp.where(s < 1e-30, 1.0, s)[None, :]
+    # re-zero the pad block: rotations keep pad columns exactly zero in U,
+    # but V's pad diagonal starts at 1 (eye) and must not leak into the
+    # pad-and-mask invariant of the returned arrays
+    col_ok = lax.broadcasted_iota(jnp.int32, (n,), 0) < n_valid
+    s = jnp.where(col_ok, s, 0.0)
+    u = u * col_ok[None, :].astype(u.dtype)
+    v = v * (col_ok[None, :] & col_ok[:, None]).astype(v.dtype)
+    if sort:
+        order = jnp.argsort(-s, stable=True)   # pad zeros stay behind valid
+        s = s[order]
+        u = u[:, order]
+        v = v[:, order]
     return u, s, v
 
 

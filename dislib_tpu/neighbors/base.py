@@ -24,15 +24,25 @@ from jax import lax
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops.base import distances_sq, precise
+from dislib_tpu.ops.ring import ring_kneighbors
+from dislib_tpu.parallel import mesh as _mesh
 
 
 class NearestNeighbors(BaseEstimator):
-    """Exact brute-force kNN index over a ds-array."""
+    """Exact brute-force kNN index over a ds-array.
+
+    ``ring`` selects the multi-device schedule: True rotates fitted shards
+    around the mesh 'rows' axis via ppermute with a running top-k (the
+    fitted set never materialises on one chip — `ops/ring.py`); False
+    forces the single-program path (direct or fitted-row-chunked GEMM);
+    None (default) auto-picks ring when the mesh has >1 row shard and the
+    fit set is large enough for scale-out to matter."""
 
     _private_fitted_attrs = ("_fit_data",)
 
-    def __init__(self, n_neighbors=5):
+    def __init__(self, n_neighbors=5, ring=None):
         self.n_neighbors = n_neighbors
+        self.ring = ring
 
     def fit(self, x: Array, y=None):
         self._fit_data = x
@@ -50,8 +60,18 @@ class NearestNeighbors(BaseEstimator):
         f = self._fit_data
         if not 1 <= k <= f.shape[0]:
             raise ValueError(f"n_neighbors {k} not in [1, {f.shape[0]}]")
-        d, idx = _kneighbors(x._data, f._data, x.shape, f.shape, k,
-                             chunk=_CHUNK)
+        mesh = _mesh.get_mesh()
+        ring = getattr(self, "ring", None)
+        use_ring = ring is True or (ring is None
+                                    and mesh.shape[_mesh.ROWS] > 1
+                                    and f.shape[0] >= _RING_MIN)
+        if use_ring and mesh.shape[_mesh.ROWS] > 1:
+            d, idx = _kneighbors_ring(x._data.astype(jnp.float32),
+                                      f._data.astype(jnp.float32),
+                                      mesh, k, x.shape[0], f.shape[0])
+        else:
+            d, idx = _kneighbors(x._data, f._data, x.shape, f.shape, k,
+                                 chunk=_CHUNK)
         d_arr = Array._from_logical_padded(_repad(d, (x.shape[0], k)), (x.shape[0], k))
         # indices stay int32 (exact for any realistic row count; float32 would
         # corrupt indices past 2^24)
@@ -64,6 +84,17 @@ class NearestNeighbors(BaseEstimator):
 # fitted-row chunk for the streaming path; fit sets up to 2×_CHUNK rows use
 # the direct single-GEMM path (module-level so tests can shrink it)
 _CHUNK = 4096
+
+# fit-set size above which a >1-row mesh auto-routes to the ring schedule
+_RING_MIN = 1 << 16
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "mq", "m_fit"))
+def _kneighbors_ring(qp, fp, mesh, k, mq, m_fit):
+    d2, idx = ring_kneighbors(qp, fp, mesh, k, m_fit)
+    dist = jnp.sqrt(jnp.maximum(d2, 0.0))
+    valid_q = lax.broadcasted_iota(jnp.int32, (dist.shape[0], 1), 0) < mq
+    return jnp.where(valid_q, dist, 0.0), jnp.where(valid_q, idx, 0)
 
 
 @partial(jax.jit, static_argnames=("q_shape", "f_shape", "k", "chunk"))

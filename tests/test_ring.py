@@ -1,0 +1,80 @@
+"""Ring-parallel kNN (ops/ring.py): oracle equivalence with the direct path
+on the 8-virtual-device mesh, including irregular shapes and padded rows."""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.neighbors import NearestNeighbors
+from dislib_tpu.parallel import mesh as _mesh
+
+
+def _oracle_knn(q, f, k):
+    d = ((q * q).sum(1)[:, None] - 2.0 * (q @ f.T)
+         + (f * f).sum(1)[None, :])
+    idx = np.argsort(d, axis=1, kind="stable")[:, :k]
+    dist = np.sqrt(np.maximum(np.take_along_axis(d, idx, axis=1), 0.0))
+    return dist, idx
+
+
+@pytest.mark.parametrize("mq,mf,n,k", [
+    (40, 64, 6, 3),
+    (37, 53, 5, 5),       # irregular: pad rows on both operands
+    (16, 200, 3, 7),
+])
+def test_ring_matches_direct_and_oracle(mq, mf, n, k):
+    rng = np.random.RandomState(0)
+    q = rng.rand(mq, n).astype(np.float32)
+    f = rng.rand(mf, n).astype(np.float32)
+    xq = ds.array(q, block_size=(8, n))
+    xf = ds.array(f, block_size=(8, n))
+
+    nn_ring = NearestNeighbors(n_neighbors=k, ring=True).fit(xf)
+    d_r, i_r = nn_ring.kneighbors(xq)
+    nn_dir = NearestNeighbors(n_neighbors=k, ring=False).fit(xf)
+    d_d, i_d = nn_dir.kneighbors(xq)
+
+    d_o, i_o = _oracle_knn(q, f, k)
+    np.testing.assert_allclose(np.asarray(d_r.collect()), d_o,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(d_d.collect()), d_o,
+                               rtol=1e-4, atol=1e-4)
+    # random data → distinct distances → index agreement is well-defined
+    np.testing.assert_array_equal(np.asarray(i_r.collect()), i_o)
+    np.testing.assert_array_equal(np.asarray(i_d.collect()), i_o)
+
+
+def test_ring_auto_routing_threshold():
+    from dislib_tpu.neighbors import base as nb
+    rng = np.random.RandomState(1)
+    f = rng.rand(64, 4).astype(np.float32)
+    x = ds.array(f, block_size=(16, 4))
+    old = nb._RING_MIN
+    nb._RING_MIN = 32          # force auto-route on small data
+    try:
+        nn = NearestNeighbors(n_neighbors=2).fit(x)     # ring=None → auto
+        d_auto, i_auto = nn.kneighbors(x)
+    finally:
+        nb._RING_MIN = old
+    d_o, i_o = _oracle_knn(f, f, 2)
+    # self-distances: the ‖q‖²−2qᵀf+‖f‖² expansion leaves O(√eps) noise
+    # where the true distance is 0, hence the looser atol
+    np.testing.assert_allclose(np.asarray(d_auto.collect()), d_o,
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_array_equal(np.asarray(i_auto.collect()), i_o)
+
+
+def test_ring_k_exceeds_per_shard_rows():
+    """k larger than any single shard's fitted rows: the running merge must
+    accumulate across ring steps, not rely on one visiting shard."""
+    rng = np.random.RandomState(2)
+    q = rng.rand(24, 4).astype(np.float32)
+    f = rng.rand(32, 4).astype(np.float32)
+    xq, xf = ds.array(q, block_size=(8, 4)), ds.array(f, block_size=(8, 4))
+    k = 20  # > 32/4 = 8 rows per shard on the 4-row mesh
+    d_r, i_r = NearestNeighbors(n_neighbors=k, ring=True).fit(xf) \
+        .kneighbors(xq)
+    d_o, i_o = _oracle_knn(q, f, k)
+    np.testing.assert_allclose(np.asarray(d_r.collect()), d_o,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_array_equal(np.asarray(i_r.collect()), i_o)
