@@ -18,10 +18,33 @@ Measurement rules (BASELINE.md):
 """
 
 import json
+import os
+import threading
 import time
 import traceback
 
 import numpy as np
+
+# per-config watchdog: a wedged device tunnel (observed round 2: axon claim
+# hanging indefinitely inside a C call) must not hang the round forever.
+# SIGALRM can't fire while the main thread is blocked in C, so the watchdog
+# is a daemon THREAD that emits the error JSON itself and hard-exits —
+# partial evidence beats a silent hang (a wedged backend would hang every
+# remaining config anyway).
+_CONFIG_TIMEOUT_S = 900
+
+
+def _watchdog(name):
+    def on_timeout():
+        _emit({"metric": name, "value": None, "unit": None,
+               "vs_baseline": None,
+               "error": f"watchdog: exceeded {_CONFIG_TIMEOUT_S}s "
+                        "(wedged device backend?)"})
+        os._exit(2)
+    t = threading.Timer(_CONFIG_TIMEOUT_S, on_timeout)
+    t.daemon = True
+    t.start()
+    return t
 
 
 def _median_time(fn, repeats=5):
@@ -46,12 +69,15 @@ def _emit(payload):
 
 
 def _guard(name, fn):
+    t = _watchdog(name)
     try:
         _emit(fn())
     except Exception as e:  # noqa: BLE001 — resilience is the whole point
         _emit({"metric": name, "value": None, "unit": None, "vs_baseline": None,
                "error": f"{type(e).__name__}: {e}",
                "trace": traceback.format_exc(limit=3)})
+    finally:
+        t.cancel()
 
 
 # ---------------------------------------------------------------------------
@@ -258,8 +284,18 @@ def bench_gmm(m, n, k, iters=5):
 
 
 def main():
-    import dislib_tpu as ds
-    ds.init()
+    # backend bring-up under the watchdog too: if the device tunnel is
+    # wedged, record that fact as JSON instead of hanging silently
+    t = _watchdog("backend_init")
+    try:
+        import dislib_tpu as ds
+        ds.init()
+    except Exception as e:  # noqa: BLE001
+        _emit({"metric": "backend_init", "value": None, "unit": None,
+               "vs_baseline": None, "error": f"{type(e).__name__}: {e}"})
+        return
+    finally:
+        t.cancel()
 
     # BASELINE.md configs 1-5, then the two north stars (KMeans ★ LAST)
     _guard("kmeans_10000x100_k8_iter_per_sec",

@@ -30,10 +30,16 @@ from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
+from dislib_tpu.ops.ring import ring_neigh_count_min
+from dislib_tpu.parallel import mesh as _mesh
 
 # padded frame counts above this stream the RMSD adjacency in tiles
 # (module-level so tests can force the path)
 _DENSE_MAX = 16384
+
+# ring-distribute the streamed passes over the mesh 'rows' axis (None=auto:
+# >1 row shard and past _DENSE_MAX; module-level so tests can force it)
+_RING = None
 
 
 class Daura(BaseEstimator):
@@ -57,7 +63,15 @@ class Daura(BaseEstimator):
         if x.shape[1] % 3 != 0:
             raise ValueError("Daura expects rows of 3*n_atoms coordinates")
         n_atoms = x.shape[1] // 3
-        if x._data.shape[0] <= _DENSE_MAX:
+        mesh = _mesh.get_mesh()
+        use_ring = _RING is True or (
+            _RING is None and mesh.shape[_mesh.ROWS] > 1
+            and x._data.shape[0] > _DENSE_MAX)
+        if use_ring:      # forced _RING=True also runs (correct) on 1 row
+            labels, medoids = _daura_fit_ring(x._data, x.shape,
+                                              float(self.cutoff), n_atoms,
+                                              mesh)
+        elif x._data.shape[0] <= _DENSE_MAX:
             labels, medoids = _daura_fit(x._data, x.shape, float(self.cutoff),
                                          n_atoms)
         else:
@@ -142,6 +156,39 @@ def _daura_fit_tiled(xp, shape, cutoff, n_atoms, tile):
         counts = jnp.where(active, counts, -1)
         medoid = jnp.argmax(counts).astype(jnp.int32)
         mrow = distances_sq(xv[medoid][None, :], xv)[0]
+        members = ((mrow <= cut2) | (ids == medoid)) & active
+        labels = jnp.where(members, cid, labels)
+        medoids = medoids.at[cid].set(medoid)
+        return active & ~members, labels, medoids, cid + 1
+
+    labels0 = jnp.full((mp,), -1, jnp.int32)
+    medoids0 = jnp.full((mp,), -1, jnp.int32)
+    _, labels, medoids, _ = lax.while_loop(
+        lambda c: jnp.any(c[0]), body, (valid, labels0, medoids0, jnp.int32(0)))
+    return labels, medoids
+
+
+@partial(jax.jit, static_argnames=("shape", "n_atoms", "mesh"))
+@precise
+def _daura_fit_ring(xp, shape, cutoff, n_atoms, mesh):
+    """`_daura_fit_tiled` with the per-round active-neighbor counts
+    ring-distributed over the mesh 'rows' axis (ops/ring.py): frames stay
+    row-sharded, only the medoid's (1, m) distance row and the greedy
+    control flow are global."""
+    m, n = shape
+    cut2 = jnp.asarray(cutoff * cutoff * n_atoms, xp.dtype)
+    mp = xp.shape[0]
+
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
+    ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
+
+    def body(carry):
+        active, labels, medoids, cid = carry
+        counts, _ = ring_neigh_count_min(xp, cut2, ids, active,
+                                         jnp.int32(mp), mesh)
+        counts = jnp.where(active, counts, -1)
+        medoid = jnp.argmax(counts).astype(jnp.int32)
+        mrow = distances_sq(xp[medoid][None, :], xp)[0]
         members = ((mrow <= cut2) | (ids == medoid)) & active
         labels = jnp.where(members, cid, labels)
         medoids = medoids.at[cid].set(medoid)

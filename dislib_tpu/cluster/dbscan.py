@@ -46,10 +46,17 @@ from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
+from dislib_tpu.ops.ring import ring_neigh_count_min
+from dislib_tpu.parallel import mesh as _mesh
 
 # padded row counts above this stream the adjacency in tiles instead of
 # materialising the m×m matrix (module-level so tests can force the path)
 _DENSE_MAX = 16384
+
+# ring-distribute the streamed passes over the mesh 'rows' axis when the
+# mesh has >1 row shard and the fit crosses this padded-row threshold;
+# None = auto, True/False force (module-level so tests can force the path)
+_RING = None
 
 
 class DBSCAN(BaseEstimator):
@@ -78,7 +85,14 @@ class DBSCAN(BaseEstimator):
         self.max_samples = max_samples
 
     def fit(self, x: Array, y=None):
-        if x._data.shape[0] <= _DENSE_MAX:
+        mesh = _mesh.get_mesh()
+        use_ring = _RING is True or (
+            _RING is None and mesh.shape[_mesh.ROWS] > 1
+            and x._data.shape[0] > _DENSE_MAX)
+        if use_ring:      # forced _RING=True also runs (correct) on 1 row
+            raw, core = _dbscan_fit_ring(x._data, x.shape, float(self.eps),
+                                         int(self.min_samples), mesh)
+        elif x._data.shape[0] <= _DENSE_MAX:
             raw, core = _dbscan_fit(x._data, x.shape, float(self.eps),
                                     int(self.min_samples))
         else:
@@ -186,6 +200,45 @@ def _dbscan_fit_tiled(xp, shape, eps, min_samples, tile):
 
     _, border_label = _tiled.neigh_count_min(xv, eps2, label, core,
                                              sentinel, tile)
+    final = jnp.where(core, label, jnp.where(valid, border_label, sentinel))
+    final = jnp.where(final < sentinel, final, -1)
+    return final, core
+
+
+@partial(jax.jit, static_argnames=("shape", "min_samples", "mesh"))
+@precise
+def _dbscan_fit_ring(xp, shape, eps, min_samples, mesh):
+    """Same algorithm as `_dbscan_fit_tiled`, ε-passes ring-distributed over
+    the mesh 'rows' axis (`ops/ring.ring_neigh_count_min`): each device
+    keeps only its row shard resident, label vectors stay row-sharded, and
+    the pointer-jump gather is a sharded global gather handled by SPMD."""
+    m, n = shape
+    mp = xp.shape[0]
+    sentinel = jnp.int32(mp)
+    eps2 = jnp.asarray(eps * eps, xp.dtype)
+
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
+    ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
+
+    counts, _ = ring_neigh_count_min(xp, eps2, ids, valid, sentinel, mesh)
+    core = (counts >= min_samples) & valid
+
+    label0 = jnp.where(core, ids, sentinel)
+
+    def body(carry):
+        label, _ = carry
+        _, neigh_min = ring_neigh_count_min(xp, eps2, label, core, sentinel,
+                                            mesh)
+        new = jnp.where(core, jnp.minimum(label, neigh_min), sentinel)
+        jumped = jnp.where(new < sentinel, new[jnp.minimum(new, mp - 1)],
+                           sentinel)
+        new = jnp.minimum(new, jumped)
+        return new, jnp.any(new != label)
+
+    label, _ = lax.while_loop(lambda c: c[1], body, (label0, jnp.bool_(True)))
+
+    _, border_label = ring_neigh_count_min(xp, eps2, label, core, sentinel,
+                                           mesh)
     final = jnp.where(core, label, jnp.where(valid, border_label, sentinel))
     final = jnp.where(final < sentinel, final, -1)
     return final, core

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from dislib_tpu.ops.base import precise
+from dislib_tpu.ops.base import distances_sq, precise
 from dislib_tpu.parallel import mesh as _mesh
 
 
@@ -84,3 +84,112 @@ def ring_kneighbors(qp, fp, mesh, k, m_fit):
         out_specs=(P(_mesh.ROWS, None), P(_mesh.ROWS, None)),
         check_vma=True,
     )(qp, fp)
+
+
+# ---------------------------------------------------------------------------
+# ring ε-neighborhood pass (DBSCAN / Daura scale-out)
+# ---------------------------------------------------------------------------
+
+# inner streaming tile edge within one ring step (per-device memory is
+# O(tile²) for the distance piece; module-level so tests can shrink it)
+RING_TILE = 2048
+
+
+@partial(jax.jit, static_argnames=("mesh",))
+@precise
+def ring_neigh_count_min(xp, eps2, vals, colmask, sentinel, mesh):
+    """Per-row (ε-neighbor count, min over neighbor vals) of a row-sharded
+    dataset against itself — `ops/tiled.neigh_count_min` distributed over
+    the mesh 'rows' axis.
+
+    Schedule: features are all-gathered over 'cols' once (contracting-dim
+    gather, paid once per call), then each device's row shard stays resident
+    while (shard, vals, colmask, ids) rotate around the 'rows' ring via
+    ppermute; each visit streams in (tile × tile) distance pieces so peak
+    memory per device is O(tile²).  adj(i,j) = (d²(i,j) ≤ eps2 ∨ i = j) ∧
+    colmask_j, exactly the single-device contract.
+
+    xp (mp, np) canonically sharded; vals/colmask (mp,) row-sharded.
+    Returns (counts int32 (mp,), mins (mp,) of vals.dtype), row-sharded.
+    """
+    nrows = mesh.shape[_mesh.ROWS]
+
+    def local(x, v, cm):
+        x = lax.all_gather(x, _mesh.COLS, axis=1, tiled=True)  # (m_loc, np)
+        m_loc = x.shape[0]
+        my = lax.axis_index(_mesh.ROWS)
+        row_ids = my * m_loc + lax.broadcasted_iota(jnp.int32, (m_loc,), 0)
+        perm = [(i, (i + 1) % nrows) for i in range(nrows)]
+        # pad the shard to a tile multiple (shapes are static in-shard):
+        # pad rows carry id −1 and colmask False, so they can never be
+        # neighbors of anything; their own outputs are cropped below
+        tile = min(RING_TILE, m_loc)
+        nt = -(-m_loc // tile)
+        m_t = nt * tile
+        x = jnp.pad(x, ((0, m_t - m_loc), (0, 0)))
+        row_ids = jnp.pad(row_ids, (0, m_t - m_loc), constant_values=-1)
+        v = jnp.pad(v, (0, m_t - m_loc), constant_values=sentinel)
+        cm = jnp.pad(cm, (0, m_t - m_loc), constant_values=False)
+
+        def pair_pass(xc, idc, vc, cmc, cnt, mn):
+            """Accumulate (cnt, mn) of local rows vs the visiting shard."""
+            x_t = x.reshape(nt, tile, x.shape[1])
+            r_t = row_ids.reshape(nt, tile)
+            xc_t = xc.reshape(nt, tile, x.shape[1])
+            id_t = idc.reshape(nt, tile)
+            v_t = vc.reshape(nt, tile)
+            cm_t = cmc.reshape(nt, tile)
+            cnt_t = cnt.reshape(nt, tile)
+            mn_t = mn.reshape(nt, tile)
+
+            def row_body(_, rx):
+                xrow, rid, c0, m0 = rx
+
+                def col_body(acc, cx):
+                    xcol, cid, vv, cmm = cx
+                    d2 = distances_sq(xrow, xcol)
+                    adj = ((d2 <= eps2)
+                           | (rid[:, None] == cid[None, :])) & cmm[None, :]
+                    c_acc = acc[0] + jnp.sum(adj, axis=1)
+                    m_acc = jnp.minimum(
+                        acc[1], jnp.min(jnp.where(adj, vv[None, :], sentinel),
+                                        axis=1))
+                    return (c_acc, m_acc), None
+
+                (c_out, m_out), _ = lax.scan(col_body, (c0, m0),
+                                             (xc_t, id_t, v_t, cm_t))
+                return None, (c_out, m_out)
+
+            _, (cnt_o, mn_o) = lax.scan(row_body, None,
+                                        (x_t, r_t, cnt_t, mn_t))
+            return cnt_o.reshape(m_t), mn_o.reshape(m_t)
+
+        def step(s, carry):
+            xc, idc, vc, cmc, cnt, mn = carry
+            cnt, mn = pair_pass(xc, idc, vc, cmc, cnt, mn)
+            xc = lax.ppermute(xc, _mesh.ROWS, perm)
+            idc = lax.ppermute(idc, _mesh.ROWS, perm)
+            vc = lax.ppermute(vc, _mesh.ROWS, perm)
+            cmc = lax.ppermute(cmc, _mesh.ROWS, perm)
+            return xc, idc, vc, cmc, cnt, mn
+
+        init = (x, row_ids, v, cm,
+                lax.pcast(jnp.zeros((m_t,), jnp.int32),
+                          (_mesh.ROWS, _mesh.COLS), to="varying"),
+                lax.pcast(jnp.full((m_t,), sentinel, v.dtype),
+                          (_mesh.ROWS, _mesh.COLS), to="varying"))
+        _, _, _, _, cnt, mn = lax.fori_loop(0, nrows, step, init)
+        cnt, mn = cnt[:m_loc], mn[:m_loc]      # crop the tile pad
+        # every rank in a mesh row computes identical results from the
+        # all-gathered features; pmax makes that invariance provable so
+        # check_vma stays ON
+        cnt = lax.pmax(cnt, _mesh.COLS)
+        mn = lax.pmin(mn, _mesh.COLS)
+        return cnt, mn
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(_mesh.ROWS, _mesh.COLS), P(_mesh.ROWS), P(_mesh.ROWS)),
+        out_specs=(P(_mesh.ROWS), P(_mesh.ROWS)),
+        check_vma=True,
+    )(xp, vals, colmask)

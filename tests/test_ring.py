@@ -64,6 +64,52 @@ def test_ring_auto_routing_threshold():
     np.testing.assert_array_equal(np.asarray(i_auto.collect()), i_o)
 
 
+def test_ring_dbscan_matches_dense():
+    """DBSCAN with ε-passes ring-distributed over the mesh rows axis gives
+    the exact labels of the dense single-program path."""
+    from dislib_tpu.cluster import dbscan as dbm
+    rng = np.random.RandomState(3)
+    # three separated blobs + outliers
+    blobs = [rng.randn(30, 3) * 0.05 + c for c in
+             ([0, 0, 0], [3, 3, 3], [-3, 2, 0])]
+    pts = np.vstack(blobs + [rng.uniform(-8, 8, (7, 3))]).astype(np.float32)
+    x = ds.array(pts, block_size=(16, 3))
+
+    ref = dbm.DBSCAN(eps=0.5, min_samples=4).fit(x)        # dense path
+    old = dbm._RING
+    dbm._RING = True
+    try:
+        got = dbm.DBSCAN(eps=0.5, min_samples=4).fit(x)    # ring path
+    finally:
+        dbm._RING = old
+    np.testing.assert_array_equal(got.labels_, ref.labels_)
+    np.testing.assert_array_equal(got.core_sample_indices_,
+                                  ref.core_sample_indices_)
+    assert got.n_clusters_ == ref.n_clusters_ == 3
+
+
+def test_ring_daura_matches_dense():
+    from dislib_tpu.cluster import daura as dm
+    rng = np.random.RandomState(4)
+    # frames = 3*n_atoms coords; two tight conformation clusters + strays
+    f1 = rng.randn(20, 12) * 0.02
+    f2 = rng.randn(20, 12) * 0.02 + 2.0
+    pts = np.vstack([f1, f2, rng.uniform(-5, 5, (5, 12))]).astype(np.float32)
+    x = ds.array(pts, block_size=(16, 12))
+
+    ref = dm.Daura(cutoff=0.5).fit(x)
+    old = dm._RING
+    dm._RING = True
+    try:
+        got = dm.Daura(cutoff=0.5).fit(x)
+    finally:
+        dm._RING = old
+    np.testing.assert_array_equal(got.labels_, ref.labels_)
+    assert len(got.clusters_) == len(ref.clusters_)
+    for a, b in zip(got.clusters_, ref.clusters_):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_ring_k_exceeds_per_shard_rows():
     """k larger than any single shard's fitted rows: the running merge must
     accumulate across ring steps, not rely on one visiting shard."""
