@@ -162,6 +162,23 @@ class SparseArray:
 
     # -- elementwise (weak-#6 parity: keep sparsity where it is exact) -------
 
+    def square(self) -> "SparseArray":
+        """Elementwise x² — sparsity-preserving (0² = 0)."""
+        bcoo = jsparse.BCOO((self._bcoo.data * self._bcoo.data,
+                             self._bcoo.indices), shape=self._bcoo.shape)
+        return SparseArray(bcoo, reg_shape=self._reg_shape)
+
+    def scale_cols(self, v) -> "SparseArray":
+        """Column-wise scaling x[:, j] * v[j] — sparsity-preserving (the
+        scalers' sparse transform: no densification)."""
+        v = jnp.asarray(v).reshape(-1)
+        if v.shape[0] != self._shape[1]:
+            raise ValueError(f"scale vector length {v.shape[0]} != "
+                             f"{self._shape[1]} columns")
+        bcoo = jsparse.BCOO((self._bcoo.data * v[self._bcoo.indices[:, 1]],
+                             self._bcoo.indices), shape=self._bcoo.shape)
+        return SparseArray(bcoo, reg_shape=self._reg_shape)
+
     def _scaled(self, factor):
         bcoo = jsparse.BCOO((self._bcoo.data * jnp.float32(factor),
                              self._bcoo.indices), shape=self._bcoo.shape)

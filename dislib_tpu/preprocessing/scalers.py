@@ -5,6 +5,13 @@ inverse_transform; SURVEY.md §3.3).
 TPU-native: fit statistics are the Array reductions (one psum over the row
 axis); transform is a broadcasted elementwise op on the sharded data — no
 communication at all.
+
+Sparse awareness (reference parity, SURVEY §3.3 scalers row: "sparse-aware,
+no centering of sparse unless dense"): StandardScaler accepts a SparseArray
+when ``with_mean=False`` — fit uses sparsity-preserving moment sums and
+transform scales columns without densifying; centering a sparse input
+raises, as in sklearn.  MinMaxScaler is dense-only (its affine shift
+destroys sparsity).
 """
 
 from __future__ import annotations
@@ -13,6 +20,11 @@ import numpy as np
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
+
+
+def _is_sparse(x):
+    from dislib_tpu.data.sparse import SparseArray
+    return isinstance(x, SparseArray)
 
 
 class StandardScaler(BaseEstimator):
@@ -26,6 +38,18 @@ class StandardScaler(BaseEstimator):
         self.with_std = with_std
 
     def fit(self, x: Array, y=None):
+        if _is_sparse(x):
+            if self.with_mean:
+                raise ValueError(
+                    "cannot center a SparseArray (densifies); use "
+                    "with_mean=False or x.to_dense()")
+            # one-pass moments are the sparse tradeoff (centering would
+            # densify); acceptable exactly because with_mean=False use
+            # implies data not far off the origin
+            self.mean_ = x.mean(axis=0)
+            ex2 = x.square().mean(axis=0)
+            self.var_ = ex2 - self.mean_ * self.mean_
+            return self
         m = x.shape[0]
         mean = x.mean(axis=0)
         # two-pass variance: mean((x-μ)²), biased (ddof=0) like the reference.
@@ -41,6 +65,12 @@ class StandardScaler(BaseEstimator):
 
     def transform(self, x: Array) -> Array:
         self._check_fitted()
+        if _is_sparse(x):
+            if self.with_mean:
+                raise ValueError("cannot center a SparseArray")
+            if not self.with_std:
+                return x
+            return x.scale_cols(1.0 / _sqrt_vec(self.var_))
         out = x
         if self.with_mean:
             out = out - self.mean_
@@ -50,6 +80,12 @@ class StandardScaler(BaseEstimator):
 
     def inverse_transform(self, x: Array) -> Array:
         self._check_fitted()
+        if _is_sparse(x):
+            if self.with_mean:
+                raise ValueError("cannot center a SparseArray")
+            if not self.with_std:
+                return x
+            return x.scale_cols(_sqrt_vec(self.var_))
         out = x
         if self.with_std:
             out = out * _safe_sqrt(self.var_)
@@ -69,6 +105,9 @@ class MinMaxScaler(BaseEstimator):
         self.feature_range = feature_range
 
     def fit(self, x: Array, y=None):
+        if _is_sparse(x):
+            raise TypeError("MinMaxScaler is dense-only (its affine shift "
+                            "densifies); use x.to_dense()")
         self.data_min_ = x.min(axis=0)
         self.data_max_ = x.max(axis=0)
         return self
@@ -100,6 +139,13 @@ def _safe_sqrt(v: Array) -> Array:
     d = jnp.sqrt(jnp.maximum(v._data, 0.0))
     d = jnp.where(d == 0.0, 1.0, d)
     return Array(_zero_pad(d, v._shape), v._shape, v._reg_shape)
+
+
+def _sqrt_vec(v: Array):
+    """1-D jnp vector of sqrt(max(v, 0)) with zeros → 1 (no-op scale)."""
+    import jax.numpy as jnp
+    d = jnp.sqrt(jnp.maximum(v._data[: 1, : v._shape[1]].reshape(-1), 0.0))
+    return jnp.where(d == 0.0, 1.0, d)
 
 
 def _nonzero(v: Array) -> Array:

@@ -13,11 +13,15 @@ TPU-native redesign:
   one GEMM (``mask @ (v_f · v_g)`` reshaped to (m, f, f)) plus ``b = R @ V``
   — MXU-bound — followed by a batched Cholesky solve.  The item step is the
   same kernel on the transpose.
-- Ratings are dense-with-mask (SURVEY §8 "Sparse support" fallback):
-  entry==0 means unobserved, exactly the information the reference's CSR
-  sparsity structure carries.  The ds-array padding region is zero by
-  invariant, so padded rows/cols solve to λI·x=0 → zero factors and never
-  perturb the observed entries.
+- Dense `Array` ratings are dense-with-mask (SURVEY §8 "Sparse support"
+  fallback): entry==0 means unobserved, exactly the information the
+  reference's CSR sparsity structure carries.  The ds-array padding region
+  is zero by invariant, so padded rows/cols solve to λI·x=0 → zero factors
+  and never perturb the observed entries.
+- `SparseArray` ratings take a TRUE sparse path (`_als_fit_sparse`): the
+  normal equations are segment-sums over the observed (user, item, rating)
+  triplets — O(nnz·f²) work/memory, no densification — matching the
+  reference's CSR-block `_update_chunk` economics.
 - Convergence (|ΔRMSE| < tol, on train or held-out test ratings) is decided
   ON DEVICE inside the while_loop — host syncs once per fit, not per
   iteration (the reference syncs the RMSE scalar every iteration).
@@ -90,7 +94,44 @@ class ALS(BaseEstimator):
         """
         if self.max_iter < 1:
             raise ValueError("max_iter must be >= 1")
-        if test is None:
+        from dislib_tpu.data.sparse import SparseArray
+        sparse_in = isinstance(x, SparseArray)
+        if sparse_in:
+            # true sparse path: the normal equations are built by
+            # segment-sums over the observed (user, item, rating) triplets —
+            # O(nnz·f²) work/memory instead of the dense path's O(m·n·f²)
+            # mask GEMM; no densification ever happens
+            rows_d, cols_d, vals = _triplets(x)
+            if test is None:
+                t_trip = (rows_d, cols_d, vals)
+            else:
+                if isinstance(test, SparseArray):
+                    if test.shape != x.shape:
+                        raise ValueError(f"test ratings shape {test.shape} "
+                                         f"!= ratings shape {x.shape}")
+                    t_trip = _triplets(test)
+                else:
+                    import scipy.sparse as sp
+                    t = test.collect() if isinstance(test, Array) else test
+                    if sp.issparse(t):            # never densify held-out data
+                        if t.shape != x.shape:
+                            raise ValueError(f"test ratings shape {t.shape} "
+                                             f"!= ratings shape {x.shape}")
+                        coo = t.tocoo()
+                        keep = coo.data != 0
+                        t_trip = (jnp.asarray(coo.row[keep], jnp.int32),
+                                  jnp.asarray(coo.col[keep], jnp.int32),
+                                  jnp.asarray(coo.data[keep], jnp.float32))
+                    else:
+                        t = np.asarray(t)
+                        if t.shape != x.shape:
+                            raise ValueError(f"test ratings shape {t.shape} "
+                                             f"!= ratings shape {x.shape}")
+                        tr, tc = np.nonzero(t)
+                        t_trip = (jnp.asarray(tr, jnp.int32),
+                                  jnp.asarray(tc, jnp.int32),
+                                  jnp.asarray(t[tr, tc], jnp.float32))
+        elif test is None:
             test_p = x._data
         else:
             t = test.collect() if isinstance(test, Array) else np.asarray(test)
@@ -103,7 +144,8 @@ class ALS(BaseEstimator):
         if checkpoint is not None:
             snap = checkpoint.load()
             if snap is not None:
-                want = (x._data.shape[0], int(self.n_f))
+                want = ((x.shape[0] if sparse_in else x._data.shape[0]),
+                        int(self.n_f))
                 if snap["users"].shape != want:
                     raise ValueError(
                         f"checkpoint users shape {snap['users'].shape} does "
@@ -121,9 +163,16 @@ class ALS(BaseEstimator):
                 min(checkpoint.every, self.max_iter - it)
             if chunk <= 0:
                 break
-            u, v, rmse_dev, n_done, conv_dev, hist = _als_fit(
-                x._data, test_p, x.shape, int(self.n_f), float(self.lambda_),
-                float(self.tol), chunk, int(seed), init_state=state)
+            if sparse_in:
+                u, v, rmse_dev, n_done, conv_dev, hist = _als_fit_sparse(
+                    rows_d, cols_d, vals, *t_trip, x.shape[0], x.shape[1],
+                    int(self.n_f), float(self.lambda_), float(self.tol),
+                    chunk, int(seed), init_state=state)
+            else:
+                u, v, rmse_dev, n_done, conv_dev, hist = _als_fit(
+                    x._data, test_p, x.shape, int(self.n_f),
+                    float(self.lambda_), float(self.tol), chunk, int(seed),
+                    init_state=state)
             it += int(n_done)
             rmse = float(rmse_dev)
             conv = bool(conv_dev)
@@ -157,6 +206,19 @@ class ALS(BaseEstimator):
     def _check_fitted(self):
         if not hasattr(self, "users_"):
             raise RuntimeError("ALS is not fitted")
+
+
+def _triplets(x):
+    """(rows, cols, vals) int32/f32 device triplets of a SparseArray with
+    explicit zeros dropped — 0 means unobserved everywhere in ALS, matching
+    the dense-with-mask path, so an explicitly-stored 0 must not become an
+    observed rating."""
+    idx = np.asarray(jax.device_get(x._bcoo.indices))
+    val = np.asarray(jax.device_get(x._bcoo.data))
+    keep = val != 0
+    return (jnp.asarray(idx[keep, 0], jnp.int32),
+            jnp.asarray(idx[keep, 1], jnp.int32),
+            jnp.asarray(val[keep], jnp.float32))
 
 
 def _pad_like(t: np.ndarray, x: Array):
@@ -225,3 +287,88 @@ def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed,
             jnp.zeros((max_iter,), rp.dtype))
     u, v, cur, n_iter, conv, hist = lax.while_loop(cond, step, init)
     return u, v, cur, n_iter, conv, hist
+
+
+@partial(jax.jit, static_argnames=("m", "n", "n_f", "max_iter"))
+@precise
+def _als_fit_sparse(rows, cols, vals, trows, tcols, tvals, m, n, n_f,
+                    lambda_, tol, max_iter, seed, init_state=None):
+    """ALS over observed triplets only: per-row normal equations assembled
+    with `segment_sum` over the nnz entries (the reference's CSR-block
+    `_update_chunk` role, collapsed to two segment reductions + one batched
+    Cholesky per half-step).  The (chunk, f²) outer-product intermediate is
+    streamed over nnz chunks so peak memory is O(chunk·f²) + O((m+n)·f²),
+    never O(nnz·f²).  Device placement: single-program (factors replicated);
+    the per-entry gathers/scatters don't shard cleanly across a mesh — the
+    recorded scale ceiling is (m+n)·f² factor storage per device."""
+    key = jax.random.PRNGKey(seed)
+    ku, kv = jax.random.split(key)
+    u0 = jax.random.uniform(ku, (m, n_f), vals.dtype)
+    v0 = jax.random.uniform(kv, (n, n_f), vals.dtype)
+    prev0 = jnp.asarray(jnp.inf, vals.dtype)
+    if init_state is not None:                 # mid-fit checkpoint resume
+        u0, v0, prev0 = init_state
+        prev0 = jnp.asarray(prev0, vals.dtype)
+    eye = jnp.eye(n_f, dtype=vals.dtype)
+
+    nnz = vals.shape[0]
+    chunk = min(nnz, _SPARSE_CHUNK)
+    n_chunks = -(-nnz // chunk)
+    pad = n_chunks * chunk - nnz
+    # pad triplets with (row 0, col 0, val 0) + zero weight so they add 0
+    rows_p = jnp.pad(rows, (0, pad))
+    cols_p = jnp.pad(cols, (0, pad))
+    vals_p = jnp.pad(vals, (0, pad))
+    w_p = jnp.pad(jnp.ones_like(vals), (0, pad))
+
+    def solve(seg_c, other, idx_c, nseg):
+        """Stream the normal-equation sums over nnz chunks: seg_c/idx_c are
+        (n_chunks, chunk) row/col ids, `other` the opposite factor matrix."""
+
+        def body(acc, cx):
+            sc, ic, vc, wc = cx
+            g = other[ic] * wc[:, None]               # pad rows → all-zero
+            b = jax.ops.segment_sum(vc[:, None] * g, sc, num_segments=nseg)
+            outer = (g[:, :, None] * g[:, None, :]).reshape(chunk, n_f * n_f)
+            a = jax.ops.segment_sum(outer, sc, num_segments=nseg)
+            cnt = jax.ops.segment_sum(wc, sc, num_segments=nseg)
+            return (acc[0] + a, acc[1] + b, acc[2] + cnt), None
+
+        acc0 = (jnp.zeros((nseg, n_f * n_f), vals.dtype),
+                jnp.zeros((nseg, n_f), vals.dtype),
+                jnp.zeros((nseg,), vals.dtype))
+        (a, b, counts), _ = lax.scan(
+            body, acc0,
+            (seg_c.reshape(n_chunks, chunk), idx_c.reshape(n_chunks, chunk),
+             vals_p.reshape(n_chunks, chunk), w_p.reshape(n_chunks, chunk)))
+        a = a.reshape(nseg, n_f, n_f)
+        # unobserved rows: A = λ·I, b = 0 → zero factors (harmless)
+        reg = lambda_ * jnp.maximum(counts, 1.0)
+        a = a + reg[:, None, None] * eye
+        chol = jax.scipy.linalg.cho_factor(a)
+        return jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
+
+    def rmse(u, v):
+        pred = jnp.sum(u[trows] * v[tcols], axis=1)
+        return jnp.sqrt(jnp.sum((pred - tvals) ** 2)
+                        / jnp.maximum(tvals.shape[0], 1))
+
+    def step(carry):
+        u, v, prev_rmse, it, _, hist = carry
+        u = solve(rows_p, v, cols_p, m)
+        v = solve(cols_p, u, rows_p, n)
+        cur = rmse(u, v)
+        conv = jnp.abs(prev_rmse - cur) < tol
+        return u, v, cur, it + 1, conv, hist.at[it].set(cur)
+
+    def cond(carry):
+        _, _, _, it, conv, _ = carry
+        return (it < max_iter) & (~conv)
+
+    init = (u0, v0, prev0, jnp.int32(0), jnp.asarray(False),
+            jnp.zeros((max_iter,), vals.dtype))
+    return lax.while_loop(cond, step, init)
+
+
+# nnz chunk for the streamed normal-equation sums (O(chunk·f²) peak)
+_SPARSE_CHUNK = 1 << 18

@@ -173,3 +173,49 @@ class TestSparseElementwise:
         sb = SparseArray.from_scipy(sp.csr_matrix(np.eye(5, dtype=np.float32)))
         with pytest.raises(ValueError):
             sa + sb
+
+
+class TestSparseScaler:
+    """StandardScaler sparse awareness (SURVEY §3.3: no centering of
+    sparse; scale without densifying)."""
+
+    def _data(self):
+        rng = np.random.RandomState(7)
+        dense = rng.rand(60, 9).astype(np.float32)
+        dense[dense < 0.6] = 0.0
+        return dense
+
+    def test_sparse_scaler_matches_dense(self):
+        import scipy.sparse as sp
+        import dislib_tpu as ds
+        from dislib_tpu.data.sparse import SparseArray
+        from dislib_tpu.preprocessing import StandardScaler
+        dense = self._data()
+        xs = SparseArray.from_scipy(sp.csr_matrix(dense))
+        xd = ds.array(dense, block_size=(16, 9))
+
+        s_sp = StandardScaler(with_mean=False).fit(xs)
+        s_d = StandardScaler(with_mean=False).fit(xd)
+        np.testing.assert_allclose(np.asarray(s_sp.var_.collect()),
+                                   np.asarray(s_d.var_.collect()),
+                                   rtol=1e-4, atol=1e-5)
+        t_sp = s_sp.transform(xs)
+        t_d = s_d.transform(xd)
+        out = t_sp.collect()
+        out = out.toarray() if hasattr(out, "toarray") else np.asarray(out)
+        np.testing.assert_allclose(out, np.asarray(t_d.collect()),
+                                   rtol=1e-4, atol=1e-5)
+        # round trip
+        back = s_sp.inverse_transform(t_sp).collect()
+        back = back.toarray() if hasattr(back, "toarray") else np.asarray(back)
+        np.testing.assert_allclose(back, dense, rtol=1e-4, atol=1e-5)
+
+    def test_sparse_centering_raises(self):
+        import scipy.sparse as sp
+        from dislib_tpu.data.sparse import SparseArray
+        from dislib_tpu.preprocessing import StandardScaler, MinMaxScaler
+        xs = SparseArray.from_scipy(sp.csr_matrix(self._data()))
+        with pytest.raises(ValueError):
+            StandardScaler(with_mean=True).fit(xs)
+        with pytest.raises(TypeError):
+            MinMaxScaler().fit(xs)
