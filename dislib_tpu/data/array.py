@@ -159,8 +159,18 @@ class Array:
     # -- sync points ---------------------------------------------------------
 
     def collect(self) -> np.ndarray:
-        """Materialise on host — the analog of compss_wait_on + merge (SURVEY §4.6)."""
-        out = np.asarray(jax.device_get(self._data))
+        """Materialise on host — the analog of compss_wait_on + merge (SURVEY §4.6).
+
+        Multi-host jobs: a row-sharded global array spans non-addressable
+        devices, so the gather is a `process_allgather` over DCN (every
+        host ends with the full logical array, the reference's
+        gather-to-master contract)."""
+        if not self._data.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            out = np.asarray(multihost_utils.process_allgather(
+                self._data, tiled=True))
+        else:
+            out = np.asarray(jax.device_get(self._data))
         out = out[: self._shape[0], : self._shape[1]]
         if self._sparse:
             import scipy.sparse as sp

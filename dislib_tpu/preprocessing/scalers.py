@@ -133,19 +133,18 @@ class MinMaxScaler(BaseEstimator):
             raise RuntimeError("MinMaxScaler is not fitted")
 
 
-def _safe_sqrt(v: Array) -> Array:
-    import jax.numpy as jnp
-    from dislib_tpu.data.array import _zero_pad
-    d = jnp.sqrt(jnp.maximum(v._data, 0.0))
-    d = jnp.where(d == 0.0, 1.0, d)
-    return Array(_zero_pad(d, v._shape), v._shape, v._reg_shape)
-
-
 def _sqrt_vec(v: Array):
     """1-D jnp vector of sqrt(max(v, 0)) with zeros → 1 (no-op scale)."""
     import jax.numpy as jnp
     d = jnp.sqrt(jnp.maximum(v._data[: 1, : v._shape[1]].reshape(-1), 0.0))
     return jnp.where(d == 0.0, 1.0, d)
+
+
+def _safe_sqrt(v: Array) -> Array:
+    """`_sqrt_vec` as a padded (1, n) Array (dense transform shape)."""
+    from dislib_tpu.data.array import _repad
+    d = _sqrt_vec(v).reshape(1, -1)
+    return Array(_repad(d, v._shape), v._shape, v._reg_shape)
 
 
 def _nonzero(v: Array) -> Array:

@@ -102,39 +102,17 @@ class ALS(BaseEstimator):
             # O(nnz·f²) work/memory instead of the dense path's O(m·n·f²)
             # mask GEMM; no densification ever happens
             rows_d, cols_d, vals = _triplets(x)
-            if test is None:
-                t_trip = (rows_d, cols_d, vals)
-            else:
-                if isinstance(test, SparseArray):
-                    if test.shape != x.shape:
-                        raise ValueError(f"test ratings shape {test.shape} "
-                                         f"!= ratings shape {x.shape}")
-                    t_trip = _triplets(test)
-                else:
-                    import scipy.sparse as sp
-                    t = test.collect() if isinstance(test, Array) else test
-                    if sp.issparse(t):            # never densify held-out data
-                        if t.shape != x.shape:
-                            raise ValueError(f"test ratings shape {t.shape} "
-                                             f"!= ratings shape {x.shape}")
-                        coo = t.tocoo()
-                        keep = coo.data != 0
-                        t_trip = (jnp.asarray(coo.row[keep], jnp.int32),
-                                  jnp.asarray(coo.col[keep], jnp.int32),
-                                  jnp.asarray(coo.data[keep], jnp.float32))
-                    else:
-                        t = np.asarray(t)
-                        if t.shape != x.shape:
-                            raise ValueError(f"test ratings shape {t.shape} "
-                                             f"!= ratings shape {x.shape}")
-                        tr, tc = np.nonzero(t)
-                        t_trip = (jnp.asarray(tr, jnp.int32),
-                                  jnp.asarray(tc, jnp.int32),
-                                  jnp.asarray(t[tr, tc], jnp.float32))
+            t_trip = (rows_d, cols_d, vals) if test is None \
+                else _test_triplets(test, x.shape)
         elif test is None:
             test_p = x._data
         else:
-            t = test.collect() if isinstance(test, Array) else np.asarray(test)
+            import scipy.sparse as sp
+            if isinstance(test, SparseArray):
+                t = np.asarray(test.collect().toarray())
+            else:
+                t = test.collect() if isinstance(test, Array) else test
+                t = np.asarray(t.toarray() if sp.issparse(t) else t)
             if t.shape != x.shape:
                 raise ValueError(
                     f"test ratings shape {t.shape} != ratings shape {x.shape}")
@@ -206,6 +184,36 @@ class ALS(BaseEstimator):
     def _check_fitted(self):
         if not hasattr(self, "users_"):
             raise RuntimeError("ALS is not fitted")
+
+
+def _test_triplets(test, want_shape):
+    """Held-out ratings → (rows, cols, vals) triplets with 0 = unobserved;
+    accepts SparseArray, scipy sparse, ds-array, or ndarray without ever
+    densifying a sparse input."""
+    from dislib_tpu.data.sparse import SparseArray
+    import scipy.sparse as sp
+    if isinstance(test, SparseArray):
+        if test.shape != want_shape:
+            raise ValueError(f"test ratings shape {test.shape} != "
+                             f"ratings shape {want_shape}")
+        return _triplets(test)
+    t = test.collect() if isinstance(test, Array) else test
+    if sp.issparse(t):
+        if t.shape != want_shape:
+            raise ValueError(f"test ratings shape {t.shape} != "
+                             f"ratings shape {want_shape}")
+        coo = t.tocoo()
+        keep = coo.data != 0
+        return (jnp.asarray(coo.row[keep], jnp.int32),
+                jnp.asarray(coo.col[keep], jnp.int32),
+                jnp.asarray(coo.data[keep], jnp.float32))
+    t = np.asarray(t)
+    if t.shape != want_shape:
+        raise ValueError(f"test ratings shape {t.shape} != "
+                         f"ratings shape {want_shape}")
+    tr, tc = np.nonzero(t)
+    return (jnp.asarray(tr, jnp.int32), jnp.asarray(tc, jnp.int32),
+            jnp.asarray(t[tr, tc], jnp.float32))
 
 
 def _triplets(x):
@@ -312,7 +320,11 @@ def _als_fit_sparse(rows, cols, vals, trows, tcols, tvals, m, n, n_f,
     eye = jnp.eye(n_f, dtype=vals.dtype)
 
     nnz = vals.shape[0]
-    chunk = min(nnz, _SPARSE_CHUNK)
+    # chunk scales inversely with f² so the (chunk, f²) outer-product
+    # intermediate stays within a fixed element budget at any factor count;
+    # max(1, ...) keeps the nnz == 0 edge (no observed ratings → A = λI,
+    # zero factors, rmse 0) well-formed
+    chunk = max(1, min(nnz, _SPARSE_CHUNK, _SPARSE_BUDGET // (n_f * n_f)))
     n_chunks = -(-nnz // chunk)
     pad = n_chunks * chunk - nnz
     # pad triplets with (row 0, col 0, val 0) + zero weight so they add 0
@@ -370,5 +382,7 @@ def _als_fit_sparse(rows, cols, vals, trows, tcols, tvals, m, n, n_f,
     return lax.while_loop(cond, step, init)
 
 
-# nnz chunk for the streamed normal-equation sums (O(chunk·f²) peak)
+# nnz chunk cap for the streamed normal-equation sums, and the element
+# budget for the (chunk, f²) intermediate (chunk·f² ≤ _SPARSE_BUDGET)
 _SPARSE_CHUNK = 1 << 18
+_SPARSE_BUDGET = 1 << 22

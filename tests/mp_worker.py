@@ -1,0 +1,63 @@
+"""Worker script for the multi-process (multi-"host") integration test.
+
+Launched by tests/test_multiprocess.py as N separate processes, each with 4
+virtual CPU devices — the DCN analog of the reference's COMPSs
+workers-as-local-processes CI rig (SURVEY §5): process boundaries are real,
+collectives cross them via gloo, and the library's own distributed
+bootstrap (`dislib_tpu.parallel.distributed.initialize`) does the wiring.
+
+Each worker: joins the job → builds the global mesh → per-host byte-range
+text ingest → KMeans fit → rank 0 writes centers + ingest checksum to
+`out_path`.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    rank = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    port = sys.argv[3]
+    csv_path = sys.argv[4]
+    out_path = sys.argv[5]
+
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from dislib_tpu.parallel import distributed
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nprocs, process_id=rank)
+    assert jax.process_count() == nprocs
+
+    import numpy as np
+    import dislib_tpu as ds
+    from dislib_tpu.cluster import KMeans
+
+    ds.init((jax.device_count(), 1))        # rows axis spans the "DCN"
+
+    # per-host parallel ingest: each process parses only its byte range
+    x = ds.load_txt_file(csv_path, block_size=(16, 5))
+
+    init = np.asarray(x.collect())[:3].copy()
+    km = KMeans(n_clusters=3, init=init, max_iter=5, tol=0.0)
+    km.fit(x)
+
+    # SPMD discipline: EVERY rank runs the same collectives in the same
+    # order (collect() is a process_allgather) — only the file write is
+    # rank-conditional
+    centers = np.asarray(km.centers_)
+    checksum = float(np.asarray(x.collect()).sum())
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump({"centers": centers.tolist(),
+                       "checksum": checksum,
+                       "shape": list(x.shape)}, f)
+    print(f"worker {rank} done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
