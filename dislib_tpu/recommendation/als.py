@@ -192,25 +192,22 @@ def _test_triplets(test, want_shape):
     densifying a sparse input."""
     from dislib_tpu.data.sparse import SparseArray
     import scipy.sparse as sp
-    if isinstance(test, SparseArray):
-        if test.shape != want_shape:
-            raise ValueError(f"test ratings shape {test.shape} != "
-                             f"ratings shape {want_shape}")
-        return _triplets(test)
-    t = test.collect() if isinstance(test, Array) else test
+    t = test
+    if isinstance(t, Array) and not isinstance(t, SparseArray):
+        t = t.collect()
+    if not (isinstance(t, SparseArray) or sp.issparse(t)):
+        t = np.asarray(t)
+    if tuple(t.shape) != tuple(want_shape):
+        raise ValueError(f"test ratings shape {tuple(t.shape)} != "
+                         f"ratings shape {tuple(want_shape)}")
+    if isinstance(t, SparseArray):
+        return _triplets(t)
     if sp.issparse(t):
-        if t.shape != want_shape:
-            raise ValueError(f"test ratings shape {t.shape} != "
-                             f"ratings shape {want_shape}")
         coo = t.tocoo()
         keep = coo.data != 0
         return (jnp.asarray(coo.row[keep], jnp.int32),
                 jnp.asarray(coo.col[keep], jnp.int32),
                 jnp.asarray(coo.data[keep], jnp.float32))
-    t = np.asarray(t)
-    if t.shape != want_shape:
-        raise ValueError(f"test ratings shape {t.shape} != "
-                         f"ratings shape {want_shape}")
     tr, tc = np.nonzero(t)
     return (jnp.asarray(tr, jnp.int32), jnp.asarray(tc, jnp.int32),
             jnp.asarray(t[tr, tc], jnp.float32))

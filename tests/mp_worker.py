@@ -42,20 +42,38 @@ def main():
     # per-host parallel ingest: each process parses only its byte range
     x = ds.load_txt_file(csv_path, block_size=(16, 5))
 
-    init = np.asarray(x.collect())[:3].copy()
-    km = KMeans(n_clusters=3, init=init, max_iter=5, tol=0.0)
+    xs_host = np.asarray(x.collect())       # ONE cross-process allgather
+    km = KMeans(n_clusters=3, init=xs_host[:3].copy(), max_iter=5, tol=0.0)
     km.fit(x)
+
+    # tp: 2-D-sharded GEMM across the process boundary
+    c = ds.matmul(x, x, transpose_b=True)
+    gram_trace = float(np.trace(np.asarray(c.collect())))
+
+    # sp analog: shard_map tsQR (all_gather(R) rides the cross-process axis)
+    q, r = ds.tsqr(x)
+    qh, rh = np.asarray(q.collect()), np.asarray(r.collect())
+    qr_err = float(np.abs(qh @ rh - xs_host).max())
+
+    # ring schedule: ppermute rotation crosses the process boundary
+    from dislib_tpu.neighbors import NearestNeighbors
+    d_ring, _ = NearestNeighbors(n_neighbors=3, ring=True).fit(x) \
+        .kneighbors(x)
+    ring_d = np.asarray(d_ring.collect())
 
     # SPMD discipline: EVERY rank runs the same collectives in the same
     # order (collect() is a process_allgather) — only the file write is
     # rank-conditional
     centers = np.asarray(km.centers_)
-    checksum = float(np.asarray(x.collect()).sum())
+    checksum = float(xs_host.sum())
     if rank == 0:
         with open(out_path, "w") as f:
             json.dump({"centers": centers.tolist(),
                        "checksum": checksum,
-                       "shape": list(x.shape)}, f)
+                       "shape": list(x.shape),
+                       "gram_trace": gram_trace,
+                       "qr_err": qr_err,
+                       "ring_d_sum": float(ring_d.sum())}, f)
     print(f"worker {rank} done", flush=True)
 
 

@@ -24,6 +24,8 @@ for f in tests/test_*.py; do
   DSLIB_TEST_TPU=1 timeout "$TMO" python -m pytest "$f" -q --no-header 2>&1 \
     | tail -3
   rc=${PIPESTATUS[0]}
+  grep -v " $f$" "$LOG" > "$LOG.tmp" || true   # one line per file
+  mv "$LOG.tmp" "$LOG"
   if [ "$rc" -eq 0 ]; then
     echo "PASS $f" >> "$LOG"
   else
