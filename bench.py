@@ -219,7 +219,7 @@ def bench_tsqr(m, n):
         q, r = ds.tsqr(a)
         _sync(q, r)
     t = _median_time(run)
-    return {"metric": "tsqr_65536x256_wall_s (baseline: numpy qr single-node)",
+    return {"metric": f"tsqr_{m}x{n}_wall_s (baseline: numpy qr single-node)",
             "value": round(t, 4), "unit": "s",
             "vs_baseline": round(cpu_wall / t, 2)}
 
@@ -296,6 +296,19 @@ def main():
         return
     finally:
         t.cancel()
+
+    # BENCH_SMOKE=1: every config at ~1/100 scale — validates the whole
+    # harness (gates, proxies, JSON, watchdog) on CPU without the chip
+    import os
+    if os.environ.get("BENCH_SMOKE"):
+        _guard("kmeans_smoke", lambda: bench_kmeans(1000, 20, 4, 5, "smoke"))
+        _guard("matmul_smoke", lambda: bench_matmul(512, "smoke"))
+        _guard("tsqr_smoke", lambda: bench_tsqr(2048, 64))
+        _guard("randomsvd_smoke", lambda: bench_randomsvd(1024, 128, nsv=16))
+        _guard("gmm_smoke", lambda: bench_gmm(2000, 8, 3, 2))
+        _guard("kmeans_smoke_star", lambda: bench_kmeans(4000, 20, 4, 5,
+                                                         "smoke_star"))
+        return
 
     # BASELINE.md configs 1-5, then the two north stars (KMeans ★ LAST)
     _guard("kmeans_10000x100_k8_iter_per_sec",

@@ -528,6 +528,7 @@ def _seed_from(random_state):
 
 
 def zeros(shape, block_size=None, dtype=jnp.float32) -> Array:
+    """All-zeros ds-array (reference: ds.zeros)."""
     q = _mesh.pad_quantum()
     pshape = _padded_shape(shape, q)
     data = jax.device_put(jnp.zeros(pshape, dtype), _mesh.data_sharding())
@@ -535,6 +536,7 @@ def zeros(shape, block_size=None, dtype=jnp.float32) -> Array:
 
 
 def full(shape, fill_value, block_size=None, dtype=jnp.float32) -> Array:
+    """Constant-filled ds-array (reference: ds.full)."""
     q = _mesh.pad_quantum()
     pshape = _padded_shape(shape, q)
     data = _full_op(pshape, tuple(int(s) for s in shape), float(fill_value), dtype)
@@ -548,14 +550,17 @@ def _full_op(pshape, shape, fill_value, dtype):
 
 
 def ones(shape, block_size=None, dtype=jnp.float32) -> Array:
+    """All-ones ds-array."""
     return full(shape, 1.0, block_size, dtype)
 
 
 def identity(n, block_size=None, dtype=jnp.float32) -> Array:
+    """n×n identity ds-array (reference: ds.identity)."""
     return eye(n, n, block_size, dtype)
 
 
 def eye(n, m=None, block_size=None, dtype=jnp.float32) -> Array:
+    """n×m eye ds-array (ones on the main diagonal; reference: ds.eye)."""
     m = n if m is None else m
     q = _mesh.pad_quantum()
     pshape = _padded_shape((n, m), q)
@@ -597,6 +602,7 @@ def concat_rows(arrays) -> Array:
 
 
 def concat_cols(arrays) -> Array:
+    """Concatenate ds-arrays along columns (block-grid hstack role)."""
     datas = [a._data[: a._shape[0], : a._shape[1]] for a in arrays]
     out = jnp.concatenate(datas, axis=1)
     return Array._from_logical(out, reg_shape=arrays[0]._reg_shape)

@@ -59,6 +59,7 @@ def initialize(coordinator_address: str | None = None,
 
 
 def is_initialized() -> bool:
+    """True once this process has joined a multi-host job."""
     return _initialized
 
 
@@ -68,6 +69,7 @@ def process_info() -> tuple[int, int]:
 
 
 def shutdown() -> None:
+    """Leave the multi-host job (jax.distributed.shutdown), if joined."""
     global _initialized
     if _initialized:
         jax.distributed.shutdown()

@@ -77,6 +77,7 @@ def get_mesh() -> Mesh:
 
 
 def set_mesh(mesh: Mesh) -> None:
+    """Install `mesh` as the library-wide default."""
     global _default_mesh
     _default_mesh = mesh
 
