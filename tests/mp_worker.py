@@ -16,33 +16,79 @@ import os
 import sys
 
 
+def _bootstrap(rank, nprocs, port, csv_path):
+    """Shared worker bring-up: join the job, build the mesh, ingest.
+    Returns (ds, x, xs_host) — xs_host from the ONE collect allgather."""
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from dislib_tpu.parallel import distributed
+    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                           num_processes=nprocs, process_id=rank)
+    assert jax.process_count() == nprocs
+    import numpy as np
+    import dislib_tpu as ds
+    ds.init((jax.device_count(), 1))        # rows axis spans the "DCN"
+    # per-host parallel ingest: each process parses only its byte range
+    x = ds.load_txt_file(csv_path, block_size=(16, 5))
+    return ds, x, np.asarray(x.collect())
+
+
+def crashfit_main():
+    """Fault-injection mode (SURVEY §6 failure-detection row): all ranks
+    run a checkpointed KMeans fit; with DSLIB_TEST_CRASH_AFTER_SAVES=k set,
+    the whole job hard-dies (os._exit) right after the k-th durable
+    snapshot — the recoverable mid-job host-death scenario.  Re-running the
+    same command resumes from the snapshot and writes final centers."""
+    rank = int(sys.argv[2])
+    nprocs = int(sys.argv[3])
+    port = sys.argv[4]
+    csv_path = sys.argv[5]
+    ck_path = sys.argv[6]
+    out_path = sys.argv[7]
+
+    import numpy as np
+    from dislib_tpu.utils import checkpoint as ckm
+
+    crash_after = int(os.environ.get("DSLIB_TEST_CRASH_AFTER_SAVES", "0"))
+    if crash_after:
+        real_save = ckm.FitCheckpoint.save
+        state = {"n": 0}
+
+        def dying_save(self, payload):
+            real_save(self, payload)
+            state["n"] += 1
+            if state["n"] >= crash_after:
+                os._exit(17)          # abrupt host death, snapshot durable
+        ckm.FitCheckpoint.save = dying_save
+
+    _, x, xs_host = _bootstrap(rank, nprocs, port, csv_path)
+    from dislib_tpu.cluster import KMeans
+    km = KMeans(n_clusters=3, init=xs_host[:3].copy(), max_iter=12, tol=0.0)
+    km.fit(x, checkpoint=ckm.FitCheckpoint(ck_path, every=3))
+    centers = np.asarray(km.centers_)
+    if rank == 0:
+        with open(out_path, "w") as f:
+            json.dump({"centers": centers.tolist(),
+                       "n_iter": int(km.n_iter_)}, f)
+    print(f"crashfit worker {rank} done", flush=True)
+
+
 def main():
+    if sys.argv[1] == "crashfit":
+        crashfit_main()
+        return
     rank = int(sys.argv[1])
     nprocs = int(sys.argv[2])
     port = sys.argv[3]
     csv_path = sys.argv[4]
     out_path = sys.argv[5]
 
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-
-    from dislib_tpu.parallel import distributed
-    distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
-                           num_processes=nprocs, process_id=rank)
-    assert jax.process_count() == nprocs
-
     import numpy as np
-    import dislib_tpu as ds
+    ds, x, xs_host = _bootstrap(rank, nprocs, port, csv_path)
     from dislib_tpu.cluster import KMeans
 
-    ds.init((jax.device_count(), 1))        # rows axis spans the "DCN"
-
-    # per-host parallel ingest: each process parses only its byte range
-    x = ds.load_txt_file(csv_path, block_size=(16, 5))
-
-    xs_host = np.asarray(x.collect())       # ONE cross-process allgather
     km = KMeans(n_clusters=3, init=xs_host[:3].copy(), max_iter=5, tol=0.0)
     km.fit(x)
 

@@ -83,3 +83,66 @@ def test_two_process_kmeans_matches_single(tmp_path):
     dd = ((parsed[:, None, :] - parsed[None]) ** 2).sum(-1)
     k3 = np.sqrt(np.maximum(np.sort(dd, axis=1)[:, :3], 0.0))
     np.testing.assert_allclose(got["ring_d_sum"], k3.sum(), rtol=1e-3)
+
+
+def _run_crashfit(tmp_path, csv, tag, crash_after):
+    out = str(tmp_path / f"{tag}.json")
+    ck = str(tmp_path / f"{tag}.ck.npz")
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = os.path.dirname(_HERE)
+    if crash_after:
+        env["DSLIB_TEST_CRASH_AFTER_SAVES"] = str(crash_after)
+    else:
+        env.pop("DSLIB_TEST_CRASH_AFTER_SAVES", None)
+    procs = [subprocess.Popen(
+        [sys.executable, os.path.join(_HERE, "mp_worker.py"), "crashfit",
+         str(r), "2", str(port), csv, ck, out],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    rcs, outs = [], []
+    for p in procs:
+        try:
+            stdout, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        rcs.append(p.returncode)
+        outs.append(stdout.decode())
+    return rcs, outs, out, ck
+
+
+def test_kill_and_resume_equivalence(tmp_path):
+    """SURVEY §6 failure-detection: the whole 2-process job dies abruptly
+    after the 2nd durable snapshot; re-running the same launch resumes from
+    the snapshot and must land on the uninterrupted run's centers."""
+    rng = np.random.RandomState(1)
+    data = rng.rand(96, 5).astype(np.float32)
+    csv = str(tmp_path / "data.csv")
+    np.savetxt(csv, data, delimiter=",", fmt="%.6f")
+
+    # uninterrupted oracle (same chunking via the same checkpoint cadence)
+    rcs, outs, out_ok, _ = _run_crashfit(tmp_path, csv, "ok", crash_after=0)
+    assert rcs == [0, 0], outs
+    with open(out_ok) as f:
+        oracle = json.load(f)
+    assert oracle["n_iter"] == 12
+
+    # crash run: both ranks exit 17 after the 2nd snapshot (6 of 12 iters)
+    rcs, outs, out_crash, ck = _run_crashfit(tmp_path, csv, "crash",
+                                             crash_after=2)
+    assert rcs == [17, 17], outs
+    assert os.path.exists(ck) and not os.path.exists(out_crash)
+
+    # resume: same launch, no crash env — continues from the snapshot
+    rcs, outs, out_res, _ = _run_crashfit(tmp_path, csv, "crash",
+                                          crash_after=0)
+    assert rcs == [0, 0], outs
+    with open(out_res) as f:
+        resumed = json.load(f)
+    assert resumed["n_iter"] == 12
+    np.testing.assert_allclose(np.asarray(resumed["centers"]),
+                               np.asarray(oracle["centers"]),
+                               rtol=1e-5, atol=1e-6)

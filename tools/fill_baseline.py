@@ -67,12 +67,18 @@ def main():
              "above).\n\n" + table + "\n")
     marker = "## Measured results"
     if marker in text:
-        pre = text.split(marker)[0]
-        text = pre + block
+        pre, rest = text.split(marker, 1)
+        # replace only this section: resume at the next '## ' heading
+        nxt = re.search(r"^## (?!Measured results)", rest, re.MULTILINE)
+        tail = rest[nxt.start():] if nxt else ""
+        text = pre + block + ("\n" + tail if tail else "")
     else:
         text = text.rstrip() + "\n\n" + block
     open(path, "w").write(text)
-    print(f"BASELINE.md updated with {sum(1 for r in out_rows[2:] if 'not run' not in r)} measured rows")
+    n_ok = sum(1 for r in out_rows[2:]
+               if "not run" not in r and "ERROR:" not in r)
+    print(f"BASELINE.md updated with {n_ok} measured rows "
+          f"({len(out_rows) - 2 - n_ok} missing/error)")
 
 
 if __name__ == "__main__":
