@@ -65,7 +65,8 @@ class KMeans(BaseEstimator):
     """
 
     def __init__(self, n_clusters=8, init="random", max_iter=10, tol=1e-4,
-                 arity=50, random_state=None, verbose=False):
+                 arity=50, random_state=None, verbose=False,
+                 fast_distance=None):
         self.n_clusters = n_clusters
         self.init = init
         self.max_iter = max_iter
@@ -73,6 +74,17 @@ class KMeans(BaseEstimator):
         self.arity = arity
         self.random_state = random_state
         self.verbose = verbose
+        # E-step distance GEMM at backend-default (bf16 MXU) precision:
+        # assignment-only speed/exactness knob — possible argmin flips for
+        # near-tied boundary points (~‖x‖²/256 cross-term error).  None
+        # reads DSLIB_KMEANS_FAST_DISTANCE (launch-script default).
+        self.fast_distance = fast_distance
+
+    def _fast(self) -> bool:
+        if self.fast_distance is not None:
+            return bool(self.fast_distance)
+        import os
+        return os.environ.get("DSLIB_KMEANS_FAST_DISTANCE", "0") == "1"
 
     # -- fitting -------------------------------------------------------------
 
@@ -135,7 +147,8 @@ class KMeans(BaseEstimator):
                         float(self.tol), _mesh.get_mesh())
             else:
                 centers, n_done, inertia, shift, hist = _kmeans_fit(
-                    x._data, x.shape, centers, chunk, float(self.tol))
+                    x._data, x.shape, centers, chunk, float(self.tol),
+                    fast=self._fast())
             it += int(n_done)
             history.extend(np.asarray(jax.device_get(hist))[: int(n_done)])
             done = float(shift) < self.tol
@@ -161,7 +174,7 @@ class KMeans(BaseEstimator):
             return super()._fit_async(x, y)
         centers0 = self._init_centers(x)
         return _kmeans_fit(x._data, x.shape, centers0, self.max_iter,
-                           float(self.tol))
+                           float(self.tol), fast=self._fast())
 
     def _fit_finalize(self, state):
         if state is None:
@@ -211,9 +224,9 @@ class KMeans(BaseEstimator):
 # device kernels
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("shape", "max_iter"))
+@partial(jax.jit, static_argnames=("shape", "max_iter", "fast"))
 @precise
-def _kmeans_fit(xp, shape, centers0, max_iter, tol):
+def _kmeans_fit(xp, shape, centers0, max_iter, tol, fast=False):
     m, n = shape
     xv = xp[:, :n]  # crop padded cols; padded rows stay (weighted 0)
     xv = lax.with_sharding_constraint(xv, _mesh.row_sharding())
@@ -222,7 +235,8 @@ def _kmeans_fit(xp, shape, centers0, max_iter, tol):
 
     def step(carry):
         centers, _, it, _, hist = carry
-        d = _distances_sq(xv, centers)
+        d = _distances_sq(xv, centers,
+                          precision="default" if fast else None)
         labels = jnp.argmin(d, axis=1)
         onehot = jax.nn.one_hot(labels, k, dtype=xv.dtype) * w[:, None]
         sums = onehot.T @ xv                 # (k, n) — row-axis psum under SPMD

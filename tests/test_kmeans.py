@@ -74,3 +74,26 @@ class TestKMeans:
         sk = SkKMeans(n_clusters=3, init=init.copy(), n_init=1, max_iter=10,
                       tol=0.0, algorithm="lloyd").fit(x)
         np.testing.assert_allclose(km.centers_, sk.cluster_centers_, atol=1e-3)
+
+
+def test_fast_distance_flag_matches(monkeypatch):
+    """DSLIB_KMEANS_FAST_DISTANCE routes the E-step distance GEMM to default
+    matmul precision; on the CPU rig precision is a no-op, so results must
+    be identical — this pins the flag's plumbing, the TPU accuracy gate
+    lives in bench.py."""
+    import os
+    import dislib_tpu as ds
+    from dislib_tpu.cluster import KMeans
+
+    rng = np.random.RandomState(5)
+    data = rng.rand(200, 6).astype(np.float32)
+    x = ds.array(data, block_size=(32, 6))
+    init = data[:4].copy()
+    km_ref = KMeans(n_clusters=4, init=init, max_iter=7, tol=0.0).fit(x)
+    km_fast = KMeans(n_clusters=4, init=init, max_iter=7, tol=0.0,
+                     fast_distance=True).fit(x)
+    monkeypatch.setenv("DSLIB_KMEANS_FAST_DISTANCE", "1")
+    km_env = KMeans(n_clusters=4, init=init, max_iter=7, tol=0.0).fit(x)
+    np.testing.assert_allclose(km_env.centers_, km_ref.centers_, rtol=1e-6)
+    np.testing.assert_allclose(km_fast.centers_, km_ref.centers_, rtol=1e-6)
+    assert km_fast.n_iter_ == km_ref.n_iter_
