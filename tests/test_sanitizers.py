@@ -77,3 +77,26 @@ class TestNoJit:
         with jax.disable_jit():
             got = ds.matmul(ds.array(a), ds.array(b)).collect()
         np.testing.assert_allclose(got, a @ b, rtol=1e-4)
+
+
+class TestRingSanitizers:
+    """The ppermute ring paths under the same two CI sanitizer modes."""
+
+    def test_ring_knn_debug_nans(self, rng):
+        x = ds.array(rng.rand(40, 4).astype(np.float32), block_size=(8, 4))
+        from dislib_tpu.neighbors import NearestNeighbors
+        with jax.debug_nans(True):
+            d, i = NearestNeighbors(n_neighbors=3, ring=True).fit(x) \
+                .kneighbors(x)
+        assert np.isfinite(np.asarray(d.collect())).all()
+
+    def test_ring_dbscan_no_jit(self, rng, monkeypatch):
+        from dislib_tpu.cluster import dbscan as dbm
+        pts = np.vstack([rng.randn(12, 3) * 0.05,
+                         rng.randn(12, 3) * 0.05 + 3]).astype(np.float32)
+        x = ds.array(pts, block_size=(8, 3))
+        ref = dbm.DBSCAN(eps=0.5, min_samples=3).fit(x).labels_  # dense path
+        monkeypatch.setattr(dbm, "_RING", True)
+        with jax.disable_jit():
+            got = dbm.DBSCAN(eps=0.5, min_samples=3).fit(x).labels_
+        np.testing.assert_array_equal(got, ref)
