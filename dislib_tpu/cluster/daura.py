@@ -30,7 +30,7 @@ from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
-from dislib_tpu.ops.ring import ring_neigh_count_min
+from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
 from dislib_tpu.parallel import mesh as _mesh
 
 # padded frame counts above this stream the RMSD adjacency in tiles
@@ -64,10 +64,7 @@ class Daura(BaseEstimator):
             raise ValueError("Daura expects rows of 3*n_atoms coordinates")
         n_atoms = x.shape[1] // 3
         mesh = _mesh.get_mesh()
-        use_ring = _RING is True or (
-            _RING is None and mesh.shape[_mesh.ROWS] > 1
-            and x._data.shape[0] > _DENSE_MAX)
-        if use_ring:      # forced _RING=True also runs (correct) on 1 row
+        if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
             labels, medoids = _daura_fit_ring(x._data, x.shape,
                                               float(self.cutoff), n_atoms,
                                               mesh)

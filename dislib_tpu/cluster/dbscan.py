@@ -46,7 +46,7 @@ from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
-from dislib_tpu.ops.ring import ring_neigh_count_min
+from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
 from dislib_tpu.parallel import mesh as _mesh
 
 # padded row counts above this stream the adjacency in tiles instead of
@@ -86,10 +86,7 @@ class DBSCAN(BaseEstimator):
 
     def fit(self, x: Array, y=None):
         mesh = _mesh.get_mesh()
-        use_ring = _RING is True or (
-            _RING is None and mesh.shape[_mesh.ROWS] > 1
-            and x._data.shape[0] > _DENSE_MAX)
-        if use_ring:      # forced _RING=True also runs (correct) on 1 row
+        if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
             raw, core = _dbscan_fit_ring(x._data, x.shape, float(self.eps),
                                          int(self.min_samples), mesh)
         elif x._data.shape[0] <= _DENSE_MAX:

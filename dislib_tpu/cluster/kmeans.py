@@ -28,6 +28,8 @@ from __future__ import annotations
 
 from functools import partial
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -83,7 +85,6 @@ class KMeans(BaseEstimator):
     def _fast(self) -> bool:
         if self.fast_distance is not None:
             return bool(self.fast_distance)
-        import os
         return os.environ.get("DSLIB_KMEANS_FAST_DISTANCE", "0") == "1"
 
     # -- fitting -------------------------------------------------------------

@@ -48,6 +48,20 @@ def _read_line_range(path, idx, count):
         return f.read(hi - lo)
 
 
+def _native_parse(parser_name, path):
+    """Run a `dislib_tpu.native` parser over a whole file, or return None
+    when the native layer is unavailable or defers (malformed input — the
+    Python fallback then raises the user-facing error)."""
+    from dislib_tpu import native as _native
+    if _native.get_lib() is None:
+        return None
+    try:
+        with open(path, "rb") as f:
+            return getattr(_native, parser_name)(f.read())
+    except _native.NativeUnavailable:
+        return None
+
+
 def _parse_txt_buf(buf, delimiter, dtype):
     """Parse a delimited-text byte buffer: native multi-threaded parser
     (dislib_tpu.native fastio, C++) when available and the target dtype is
@@ -122,14 +136,7 @@ def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True
     C++ single-pass CSR parser (`dislib_tpu.native.parse_svmlight`) when
     available, pure-Python fallback otherwise.  Duplicate feature indices
     sum (CSR semantics, = sklearn's loader) on both paths."""
-    from dislib_tpu import native as _native
-    parsed = None
-    if _native.get_lib() is not None:
-        try:
-            with open(path, "rb") as f:
-                parsed = _native.parse_svmlight(f.read())
-        except _native.NativeUnavailable:
-            parsed = None                    # malformed → Python path raises
+    parsed = _native_parse("parse_svmlight", path)
     if parsed is not None:
         labels_a, indptr, indices, data, nfeat = parsed
         n = labels_a.shape[0]
@@ -185,14 +192,7 @@ def load_mdcrd_file(path, block_size=None, n_atoms=None, copy_first=False):
     (reference: load_mdcrd_file for the Daura/MD pipeline)."""
     if n_atoms is None:
         raise ValueError("n_atoms is required for mdcrd parsing")
-    from dislib_tpu import native as _native
-    values = None
-    if _native.get_lib() is not None:
-        try:
-            with open(path, "rb") as f:
-                values = _native.parse_mdcrd(f.read())
-        except _native.NativeUnavailable:
-            values = None                    # bad field → Python path raises
+    values = _native_parse("parse_mdcrd", path)
     if values is None:
         vals = []
         with open(path) as f:

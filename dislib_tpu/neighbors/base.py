@@ -24,7 +24,7 @@ from jax import lax
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops.base import distances_sq, precise
-from dislib_tpu.ops.ring import ring_kneighbors
+from dislib_tpu.ops.ring import ring_auto, ring_kneighbors
 from dislib_tpu.parallel import mesh as _mesh
 
 
@@ -61,11 +61,14 @@ class NearestNeighbors(BaseEstimator):
         if not 1 <= k <= f.shape[0]:
             raise ValueError(f"n_neighbors {k} not in [1, {f.shape[0]}]")
         mesh = _mesh.get_mesh()
-        ring = getattr(self, "ring", None)
-        use_ring = ring is True or (ring is None
-                                    and mesh.shape[_mesh.ROWS] > 1
-                                    and f.shape[0] >= _RING_MIN)
-        if use_ring and mesh.shape[_mesh.ROWS] > 1:
+        # getattr: models loaded from pre-`ring` snapshots lack the attr.
+        # The trailing rows>1 guard stays even for forced ring=True: unlike
+        # the ε-pass, ring_kneighbors is not inner-tiled, so on a 1-row
+        # mesh it would materialise the full (mq, mf) distance block —
+        # the chunked single-program path is the memory-safe equivalent.
+        if ring_auto(getattr(self, "ring", None), mesh,
+                     f.shape[0] >= _RING_MIN) \
+                and mesh.shape[_mesh.ROWS] > 1:
             d, idx = _kneighbors_ring(x._data.astype(jnp.float32),
                                       f._data.astype(jnp.float32),
                                       mesh, k, x.shape[0], f.shape[0])

@@ -95,6 +95,16 @@ def ring_kneighbors(qp, fp, mesh, k, m_fit):
 RING_TILE = 2048
 
 
+def ring_auto(flag, mesh, large):
+    """Shared ring-routing policy: ``flag`` True forces the ring schedule,
+    False forces it off, None auto-picks it when the mesh has >1 row shard
+    and the caller's own size predicate ``large`` holds (each consumer owns
+    its threshold semantics)."""
+    if flag is not None:
+        return bool(flag)
+    return mesh.shape[_mesh.ROWS] > 1 and large
+
+
 @partial(jax.jit, static_argnames=("mesh",))
 @precise
 def ring_neigh_count_min(xp, eps2, vals, colmask, sentinel, mesh):
