@@ -1,0 +1,40 @@
+"""End-to-end clustering pipeline: CSV ingest → scale → PCA → KMeans →
+save/load roundtrip.
+
+Run anywhere: `python examples/clustering_pipeline.py` (real TPU under the
+default env; CPU with JAX_PLATFORMS=cpu).
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import KMeans
+from dislib_tpu.decomposition import PCA
+from dislib_tpu.preprocessing import StandardScaler
+
+ds.init()
+
+# three gaussian blobs, written to a CSV and loaded back (native C++ parser)
+rng = np.random.RandomState(0)
+blobs = np.vstack([rng.randn(400, 8) * 0.3 + c
+                   for c in (0.0, 3.0, -3.0)]).astype(np.float32)
+workdir = tempfile.mkdtemp()
+csv = os.path.join(workdir, "blobs.csv")
+np.savetxt(csv, blobs, delimiter=",")
+
+x = ds.load_txt_file(csv, block_size=(200, 8))
+print("loaded:", x)
+
+xs = StandardScaler().fit_transform(x)
+xp = PCA(n_components=4).fit_transform(xs)
+km = KMeans(n_clusters=3, random_state=0, max_iter=50).fit(xp)
+print(f"fit: n_iter={km.n_iter_} inertia={km.inertia_:.2f}")
+
+model_path = os.path.join(workdir, "model.json")
+ds.save_model(km, model_path)
+km2 = ds.load_model(model_path)
+labels = np.asarray(km2.predict(xp).collect()).ravel()
+print("cluster sizes after save/load roundtrip:", np.bincount(labels))

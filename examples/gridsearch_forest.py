@@ -1,0 +1,27 @@
+"""Hyperparameter search over a random forest with cross-validation.
+
+Run: `python examples/gridsearch_forest.py`
+"""
+
+import numpy as np
+
+import dislib_tpu as ds
+from dislib_tpu.model_selection import GridSearchCV
+from dislib_tpu.trees import RandomForestClassifier
+
+ds.init()
+
+rng = np.random.RandomState(0)
+x_host = rng.rand(600, 10).astype(np.float32)
+y_host = (x_host[:, 0] + x_host[:, 3] > 1.0).astype(np.float32)
+
+x = ds.array(x_host, block_size=(100, 10))
+y = ds.array(y_host.reshape(-1, 1), block_size=(100, 1))
+
+gs = GridSearchCV(RandomForestClassifier(random_state=0),
+                  {"n_estimators": [5, 15], "max_depth": [4, 8]},
+                  cv=3, scoring="accuracy")
+gs.fit(x, y)
+print("best params:", gs.best_params_)
+print("mean test scores:", np.round(gs.cv_results_["mean_test_score"], 3))
+print("refit score:", gs.best_estimator_.score(x, y))
