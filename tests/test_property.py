@@ -79,3 +79,24 @@ def test_elementwise_and_transpose(ab, seed2):
     np.testing.assert_allclose(np.asarray(x.T.collect()), data.T, rtol=1e-6)
     # transpose round-trip keeps the pad-and-mask invariant intact
     np.testing.assert_allclose(np.asarray(x.T.T.collect()), data, rtol=1e-6)
+
+
+@given(st.integers(0, 2**16), st.integers(5, 30), st.integers(3, 12))
+@_settings
+def test_sparse_roundtrip_and_ops(seed, m, n):
+    import scipy.sparse as sp
+    from dislib_tpu.data.sparse import SparseArray
+    rng = np.random.RandomState(seed)
+    dense = rng.rand(m, n).astype(np.float32)
+    dense[dense < 0.6] = 0.0
+    xs = SparseArray.from_scipy(sp.csr_matrix(dense))
+    np.testing.assert_allclose(np.asarray(xs.collect().toarray()), dense,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(xs.sum(axis=0).collect()).ravel(),
+                               dense.sum(0), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(xs.square().collect().toarray()), dense ** 2, rtol=1e-5)
+    got = (xs + xs._scaled(-1.0)).collect().toarray()
+    np.testing.assert_allclose(got, np.zeros_like(dense), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(xs.T.collect().toarray()), dense.T,
+                               rtol=1e-6)
