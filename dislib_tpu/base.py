@@ -13,6 +13,10 @@ import inspect
 from copy import deepcopy
 
 
+#: classes already reported as lacking an async fit path (notice once each)
+_ASYNC_FALLBACK_NOTICED: set = set()
+
+
 class BaseEstimator:
     """Minimal sklearn-compatible base: constructor args are hyperparameters."""
 
@@ -55,7 +59,16 @@ class BaseEstimator:
         `_fit_finalize`/`_score_async`.  The default falls back to the
         synchronous `fit` and returns None (JAX async dispatch still
         overlaps the device work; the fallback only loses the cross-trial
-        pipelining of convergence-scalar reads)."""
+        pipelining of convergence-scalar reads).  The degradation is logged
+        once per class so a search that quietly serialises is visible."""
+        cls = type(self).__name__
+        if cls not in _ASYNC_FALLBACK_NOTICED:
+            _ASYNC_FALLBACK_NOTICED.add(cls)
+            from dislib_tpu.utils.dlog import get_logger
+            get_logger("search").info(
+                "%s does not implement _fit_async; search trials over it run "
+                "synchronous fits (device work still overlaps, cross-trial "
+                "pipelining of host reads is lost)", cls)
         self.fit(x, y) if y is not None else self.fit(x)
         return None
 

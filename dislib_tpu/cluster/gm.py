@@ -80,10 +80,16 @@ class GaussianMixture(BaseEstimator):
         m, n = x.shape
         k = self.n_components
         if self.init_params == "kmeans":
-            from dislib_tpu.cluster.kmeans import KMeans
+            # run the KMeans device kernels directly so the init stays on
+            # device end-to-end — no host read between here and the EM loop
+            # (keeps `_fit_async` dispatch-only for GridSearchCV, SURVEY §4.5)
+            from dislib_tpu.cluster.kmeans import (KMeans, _kmeans_fit,
+                                                   _kmeans_predict)
             km = KMeans(n_clusters=k, max_iter=10, tol=1e-4,
-                        random_state=self.random_state).fit(x)
-            labels = km.predict(x)._data[:, 0].astype(jnp.int32)
+                        random_state=self.random_state)
+            centers = _kmeans_fit(x._data, x.shape, km._init_centers(x),
+                                  10, 1e-4)[0]
+            labels = _kmeans_predict(x._data, x.shape, centers)[:, 0]
             resp = jax.nn.one_hot(labels, k, dtype=jnp.float32)
         elif self.init_params == "random":
             seed = 0 if self.random_state is None else int(self.random_state)
@@ -168,6 +174,40 @@ class GaussianMixture(BaseEstimator):
                                 jnp.asarray(self.means_),
                                 jnp.asarray(self.covariances_),
                                 self.covariance_type))
+
+    # async trial protocol (SURVEY §4.5): the whole EM fit — including the
+    # KMeans init — is device dispatch only; GridSearchCV reads nothing back
+    # until every trial is in flight
+    def _fit_async(self, x, y=None):
+        if self.covariance_type not in ("full", "tied", "diag", "spherical"):
+            raise ValueError(f"bad covariance_type {self.covariance_type!r}")
+        if self.max_iter < 1:
+            raise ValueError("max_iter must be >= 1")
+        resp0 = self._init_resp(x)
+        overrides = self._explicit_inits(x.shape[1])
+        return _gm_fit(x._data, x.shape, resp0, self.covariance_type,
+                       float(self.reg_covar), float(self.tol), self.max_iter,
+                       overrides)
+
+    def _fit_finalize(self, state):
+        if state is None:
+            return
+        weights, means, covs, lb, n_iter, conv, hist = state
+        self.weights_ = np.asarray(jax.device_get(weights))
+        self.means_ = np.asarray(jax.device_get(means))
+        self.covariances_ = np.asarray(jax.device_get(covs))
+        self.lower_bound_ = float(lb)
+        self.n_iter_ = int(n_iter)
+        self.converged_ = bool(conv)
+        self.history_ = np.asarray(
+            jax.device_get(hist), dtype=np.float64)[: self.n_iter_]
+
+    def _score_async(self, state, x, y=None):
+        if state is None:
+            return super()._score_async(state, x, y)
+        weights, means, covs = state[0], state[1], state[2]
+        return _gm_loglik(x._data, x.shape, weights, means, covs,
+                          self.covariance_type)
 
     def _explicit_inits(self, d):
         """(weights, means, covs) overrides from the *_init params (reference

@@ -76,16 +76,27 @@ class ADMM(BaseEstimator):
 
     def fit(self, x: Array, y: Array):
         """Solve consensus least-squares + prox over row-partitions of (x, y)."""
+        self._fit_finalize(self._fit_async(x, y))
+        return self
+
+    # async trial protocol (SURVEY §4.5): the whole consensus loop is one
+    # shard_map program; the handle is its device output tuple
+    def _fit_async(self, x: Array, y: Array):
         if y.shape[1] != 1:
             raise ValueError(f"ADMM supports a single target column; y is {y.shape}")
         if x.shape[0] != y.shape[0]:
             raise ValueError(f"x and y row counts differ: {x.shape[0]} != {y.shape[0]}")
         prox = self.z_prox if self.z_prox is not None else identity_prox
-        z, n_iter, converged, hist = _admm_fit(
+        return _admm_fit(
             x._data, y._data, x.shape, (y.shape[0], y.shape[1]),
             float(self.rho), jnp.float32(self.prox_kappa),
             float(self.abstol), float(self.reltol),
             self.max_iter, prox, _mesh.get_mesh())
+
+    def _fit_finalize(self, state):
+        if state is None:
+            return
+        z, n_iter, converged, hist = state
         self.z_ = np.asarray(jax.device_get(z)).ravel()
         self.n_iter_ = int(n_iter)
         self.converged_ = bool(converged)
@@ -94,7 +105,6 @@ class ADMM(BaseEstimator):
         verbose_logger("admm", self.verbose).info(
             "converged=%s n_iter=%d primal_residual=%.3g", self.converged_,
             self.n_iter_, self.history_[-1] if len(self.history_) else np.nan)
-        return self
 
 
 @partial(jax.jit, static_argnames=("x_shape", "y_shape", "max_iter", "prox", "mesh"))

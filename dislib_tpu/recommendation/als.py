@@ -174,6 +174,38 @@ class ALS(BaseEstimator):
         self.history_ = np.asarray(history, dtype=np.float64)
         return self
 
+    # async trial protocol (SURVEY §4.5): the no-test, no-checkpoint fit is
+    # one jitted while_loop; the handle is its device output tuple.  Sparse
+    # inputs read their triplets (input prep, not fit results) at dispatch.
+    def _fit_async(self, x, y=None):
+        if self.max_iter < 1:
+            raise ValueError("max_iter must be >= 1")
+        from dislib_tpu.data.sparse import SparseArray
+        seed = self.random_state if self.random_state is not None else 0
+        if isinstance(x, SparseArray):
+            rows_d, cols_d, vals = _triplets(x)
+            out = _als_fit_sparse(rows_d, cols_d, vals, rows_d, cols_d, vals,
+                                  x.shape[0], x.shape[1], int(self.n_f),
+                                  float(self.lambda_), float(self.tol),
+                                  self.max_iter, int(seed))
+        else:
+            out = _als_fit(x._data, x._data, x.shape, int(self.n_f),
+                           float(self.lambda_), float(self.tol),
+                           self.max_iter, int(seed))
+        return (out, x.shape)
+
+    def _fit_finalize(self, state):
+        if state is None:
+            return
+        (u, v, rmse, n_iter, conv, hist), (m, n) = state
+        self.users_ = np.asarray(jax.device_get(u))[:m]
+        self.items_ = np.asarray(jax.device_get(v))[:n]
+        self.rmse_ = float(rmse)
+        self.n_iter_ = int(n_iter)
+        self.converged_ = bool(conv)
+        self.history_ = np.asarray(
+            jax.device_get(hist), dtype=np.float64)[: self.n_iter_]
+
     def predict_user(self, user_id: int) -> np.ndarray:
         """Predicted ratings for every item for one user (reference parity)."""
         self._check_fitted()

@@ -8,11 +8,13 @@ soft-threshold prox; the whole iteration loop runs on device (see admm.py).
 
 from __future__ import annotations
 
+import jax.numpy as jnp
 import numpy as np
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.optimization.admm import ADMM, soft_threshold
+from dislib_tpu.regression.linear import _r2_score
 
 
 class Lasso(BaseEstimator):
@@ -31,18 +33,41 @@ class Lasso(BaseEstimator):
         self.atol = atol
         self.rtol = rtol
 
-    def fit(self, x: Array, y: Array):
+    def _admm(self):
         from dislib_tpu.parallel import mesh as _mesh
         # global objective carries λ once; each of the p agents contributes ρ
         p = _mesh.mesh_shape()[0]
         kappa = float(self.lmbd) / (float(self.rho) * p)
-        admm = ADMM(z_prox=soft_threshold, prox_kappa=kappa, rho=self.rho,
+        return ADMM(z_prox=soft_threshold, prox_kappa=kappa, rho=self.rho,
                     max_iter=self.max_iter, abstol=self.atol, reltol=self.rtol)
-        admm.fit(x, y)
+
+    def fit(self, x: Array, y: Array):
+        self._fit_finalize(self._fit_async(x, y))
+        return self
+
+    # async trial protocol (SURVEY §4.5): delegate to ADMM's device handle
+    def _fit_async(self, x, y=None):
+        if y is None:
+            raise ValueError("Lasso requires y")
+        admm = self._admm()
+        return (admm, admm._fit_async(x, y))
+
+    def _fit_finalize(self, state):
+        if state is None:
+            return
+        admm, admm_state = state
+        admm._fit_finalize(admm_state)
         self.coef_ = admm.z_
         self.n_iter_ = admm.n_iter_
         self.converged_ = admm.converged_
-        return self
+
+    def _score_async(self, state, x, y=None):
+        if state is None:
+            return super()._score_async(state, x, y)
+        z = state[1][0]                       # device consensus vector
+        coef = z.reshape(-1, 1)
+        return _r2_score(x._data, y._data, x.shape, y.shape, coef,
+                         jnp.zeros((1,), coef.dtype))
 
     def predict(self, x: Array) -> Array:
         self._check_fitted()
@@ -51,12 +76,11 @@ class Lasso(BaseEstimator):
         return matmul(x, w)
 
     def score(self, x: Array, y: Array) -> float:
-        """R² (sklearn convention)."""
-        pred = self.predict(x).collect()
-        yv = y.collect()
-        u = ((yv - pred) ** 2).sum()
-        v = ((yv - yv.mean(0)) ** 2).sum()
-        return float(1.0 - u / v)
+        """R² (sklearn convention); computed on device."""
+        self._check_fitted()
+        coef = jnp.asarray(np.asarray(self.coef_, np.float32)).reshape(-1, 1)
+        return float(_r2_score(x._data, y._data, x.shape, y.shape, coef,
+                               jnp.zeros((1,), coef.dtype)))
 
     def _check_fitted(self):
         if not hasattr(self, "coef_"):

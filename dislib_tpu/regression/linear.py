@@ -36,12 +36,7 @@ class LinearRegression(BaseEstimator):
         self.arity = arity  # reference parity; ignored
 
     def fit(self, x: Array, y: Array):
-        if x.shape[0] != y.shape[0]:
-            raise ValueError("x and y row counts differ")
-        coef, intercept = _linreg_fit(x._data, y._data, x.shape, y.shape,
-                                      self.fit_intercept)
-        self.coef_ = np.asarray(jax.device_get(coef))
-        self.intercept_ = np.asarray(jax.device_get(intercept))
+        self._fit_finalize(self._fit_async(x, y))
         return self
 
     def predict(self, x: Array) -> Array:
@@ -51,13 +46,35 @@ class LinearRegression(BaseEstimator):
         return Array._from_logical_padded(out, (x.shape[0], self.coef_.shape[1]))
 
     def score(self, x: Array, y: Array) -> float:
-        """R² score (sklearn convention)."""
+        """R² score (sklearn convention); computed on device."""
         self._check_fitted()
-        pred = self.predict(x).collect()
-        yv = y.collect()
-        u = ((yv - pred) ** 2).sum()
-        v = ((yv - yv.mean(0)) ** 2).sum()
-        return float(1.0 - u / v)
+        return float(_r2_score(x._data, y._data, x.shape, y.shape,
+                               jnp.asarray(self.coef_),
+                               jnp.asarray(self.intercept_)))
+
+    # async trial protocol (SURVEY §4.5): the fit is one jitted program; the
+    # handle is the (coef, intercept) device pair, read back only after
+    # GridSearchCV has dispatched every trial
+    def _fit_async(self, x, y=None):
+        if y is None:
+            raise ValueError("LinearRegression requires y")
+        if x.shape[0] != y.shape[0]:
+            raise ValueError("x and y row counts differ")
+        return _linreg_fit(x._data, y._data, x.shape, y.shape,
+                           self.fit_intercept)
+
+    def _fit_finalize(self, state):
+        if state is None:
+            return
+        coef, intercept = state
+        self.coef_ = np.asarray(jax.device_get(coef))
+        self.intercept_ = np.asarray(jax.device_get(intercept))
+
+    def _score_async(self, state, x, y=None):
+        if state is None:
+            return super()._score_async(state, x, y)
+        coef, intercept = state
+        return _r2_score(x._data, y._data, x.shape, y.shape, coef, intercept)
 
     def _check_fitted(self):
         if not hasattr(self, "coef_"):
@@ -85,6 +102,24 @@ def _linreg_fit(xp, yp, x_shape, y_shape, fit_intercept):
     if fit_intercept:
         return sol[:-1], sol[-1]
     return sol, jnp.zeros((t,), xv.dtype)
+
+
+@partial(jax.jit, static_argnames=("x_shape", "y_shape"))
+@precise
+def _r2_score(xp, yp, x_shape, y_shape, coef, intercept):
+    """R² of a linear predictor, summed over all targets (the host-side
+    sklearn formula moved on-device so scoring never leaves the mesh)."""
+    m, n = x_shape
+    t = y_shape[1]
+    xv = xp[:, :n]
+    yv = yp[:, :t]
+    w = (lax.broadcasted_iota(jnp.int32, (xv.shape[0], 1), 0) < m) \
+        .astype(xv.dtype)
+    pred = (xv @ coef + intercept[None, :]) * w
+    resid = jnp.sum(((yv - pred) * w) ** 2)
+    ymean = jnp.sum(yv * w, axis=0) / m
+    total = jnp.sum(((yv - ymean[None, :]) * w) ** 2)
+    return 1.0 - resid / jnp.maximum(total, 1e-12)
 
 
 @partial(jax.jit, static_argnames=("shape",))

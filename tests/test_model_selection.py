@@ -142,6 +142,113 @@ class TestAsyncDispatch:
         assert events == ["fit"] * 3 + ["score"] * 3 + ["fit"] * 3 + ["score"] * 3
 
 
+class TestAsyncAdoption:
+    """Round-3 widening of the §4.5 contract: GMM / LinearRegression /
+    Lasso / ALS dispatch async, and the silent fallback is logged."""
+
+    def test_gmm_trials_dispatch_before_any_host_read(self, rng, monkeypatch):
+        import jax
+        from dislib_tpu.cluster import GaussianMixture
+        events = []
+        real_get = jax.device_get
+        orig_fit = GaussianMixture._fit_async
+
+        def spy_get(v):
+            events.append("host_read")
+            return real_get(v)
+
+        def spy_fit(self, x, y=None):
+            events.append("fit")
+            state = orig_fit(self, x, y)
+            assert state is not None, "GMM must be truly async, not fallback"
+            return state
+
+        monkeypatch.setattr(jax, "device_get", spy_get)
+        monkeypatch.setattr(GaussianMixture, "_fit_async", spy_fit)
+        x = ds.array(rng.rand(80, 3).astype(np.float32))
+        GridSearchCV(GaussianMixture(max_iter=5, random_state=0),
+                     {"n_components": [2, 3]}, cv=2, refit=False).fit(x)
+        # 2 candidates × 2 folds dispatch; no device_get may interleave —
+        # the whole fit (incl. the KMeans init) stays on device
+        assert events == ["fit", "fit"] * 2
+
+    def test_gmm_async_matches_serial(self, rng, monkeypatch):
+        from dislib_tpu.base import BaseEstimator
+        from dislib_tpu.cluster import GaussianMixture
+        x = ds.array(rng.rand(90, 3).astype(np.float32))
+        grid = {"n_components": [2, 3]}
+        fast = GridSearchCV(GaussianMixture(max_iter=10, random_state=0),
+                            grid, cv=2, refit=False)
+        fast.fit(x)
+        monkeypatch.setattr(GaussianMixture, "_fit_async",
+                            BaseEstimator._fit_async)
+        monkeypatch.setattr(GaussianMixture, "_score_async",
+                            BaseEstimator._score_async)
+        slow = GridSearchCV(GaussianMixture(max_iter=10, random_state=0),
+                            grid, cv=2, refit=False)
+        slow.fit(x)
+        np.testing.assert_allclose(fast.cv_results_["mean_test_score"],
+                                   slow.cv_results_["mean_test_score"],
+                                   rtol=1e-4)
+
+    def test_linreg_async_matches_serial(self, rng):
+        from dislib_tpu.regression import LinearRegression
+        x = rng.rand(80, 3).astype(np.float32)
+        y = (x @ np.array([1.0, -2.0, 0.5]) + 0.3).astype(np.float32)[:, None]
+        grid = {"fit_intercept": [True, False]}
+        fast = GridSearchCV(LinearRegression(),
+                            grid, cv=KFold(n_splits=2), refit=False)
+        fast.fit(ds.array(x), ds.array(y))
+        # serial oracle: plain fit + score per (candidate, fold)
+        want = []
+        for fi in grid["fit_intercept"]:
+            scores = []
+            for xt, yt, xv, yv in KFold(n_splits=2).split(ds.array(x),
+                                                          ds.array(y)):
+                est = LinearRegression(fit_intercept=fi).fit(xt, yt)
+                scores.append(est.score(xv, yv))
+            want.append(np.mean(scores))
+        np.testing.assert_allclose(fast.cv_results_["mean_test_score"],
+                                   want, rtol=1e-4)
+        assert fast.best_params_ == {"fit_intercept": True}
+
+    def test_lasso_async_score_matches_sync(self, rng):
+        from dislib_tpu.regression import Lasso
+        x = rng.rand(60, 4).astype(np.float32)
+        y = (x @ np.array([2.0, 0.0, -1.0, 0.0]) + 0.1
+             * rng.randn(60)).astype(np.float32)[:, None]
+        xa, ya = ds.array(x), ds.array(y)
+        est = Lasso(lmbd=0.1, max_iter=50)
+        state = est._fit_async(xa, ya)
+        dev_score = float(est._score_async(state, xa, ya))
+        est._fit_finalize(state)
+        assert np.isclose(dev_score, est.score(xa, ya), rtol=1e-4)
+
+    def test_als_async_matches_sync(self, rng):
+        from dislib_tpu.recommendation import ALS
+        r = rng.rand(24, 12).astype(np.float32)
+        r[rng.rand(24, 12) > 0.4] = 0.0
+        xa = ds.array(r)
+        sync = ALS(n_f=3, max_iter=8, random_state=0).fit(xa)
+        a = ALS(n_f=3, max_iter=8, random_state=0)
+        a._fit_finalize(a._fit_async(xa))
+        np.testing.assert_allclose(a.users_, sync.users_, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(a.items_, sync.items_, rtol=1e-4, atol=1e-5)
+        assert a.n_iter_ == sync.n_iter_
+
+    def test_fallback_notice_logged_once(self, rng, caplog):
+        import logging
+        import dislib_tpu.base as base_mod
+        base_mod._ASYNC_FALLBACK_NOTICED.discard("KNeighborsClassifier")
+        x, y = _blobs(rng, n=60)
+        with caplog.at_level(logging.INFO, logger="dslib.search"):
+            GridSearchCV(KNeighborsClassifier(), {"n_neighbors": [1, 3]},
+                         cv=2, refit=False).fit(ds.array(x), ds.array(y))
+        notices = [r for r in caplog.records
+                   if "does not implement _fit_async" in r.message]
+        assert len(notices) == 1
+
+
 class TestScorerStrings:
     def test_accuracy_scorer(self, rng):
         x = np.vstack([rng.randn(30, 2) - 3, rng.randn(30, 2) + 3]).astype(np.float32)
