@@ -19,32 +19,25 @@ Measurement rules (BASELINE.md):
 
 import json
 import os
-import threading
+import subprocess
+import sys
 import time
 import traceback
 
 import numpy as np
 
-# per-config watchdog: a wedged device tunnel (observed round 2: axon claim
-# hanging indefinitely inside a C call) must not hang the round forever.
-# SIGALRM can't fire while the main thread is blocked in C, so the watchdog
-# is a daemon THREAD that emits the error JSON itself and hard-exits —
-# partial evidence beats a silent hang (a wedged backend would hang every
-# remaining config anyway).
-_CONFIG_TIMEOUT_S = 900
-
-
-def _watchdog(name):
-    def on_timeout():
-        _emit({"metric": name, "value": None, "unit": None,
-               "vs_baseline": None,
-               "error": f"watchdog: exceeded {_CONFIG_TIMEOUT_S}s "
-                        "(wedged device backend?)"})
-        os._exit(2)
-    t = threading.Timer(_CONFIG_TIMEOUT_S, on_timeout)
-    t.daemon = True
-    t.start()
-    return t
+# Watchdog architecture (round-3 rework of the round-2 thread watchdog):
+# a wedged device tunnel blocks the Python main thread inside a C call, so
+# no in-process mechanism can skip past it.  Each config therefore runs in
+# its OWN subprocess; the parent (which never imports jax) enforces
+# timeouts, forwards the child's JSON lines, and keeps going after a
+# timeout — one slow config no longer zeroes the rest of the round's
+# evidence.  Two consecutive timeouts mean the backend itself is wedged
+# (every later config would hang too) and abort with rc 2.  A cheap
+# 60-second `jax.devices()` probe child runs first so a dead tunnel costs
+# one minute, not fifteen.
+_CONFIG_TIMEOUT_S = int(os.environ.get("DSLIB_BENCH_CONFIG_S", "900"))
+_PROBE_TIMEOUT_S = int(os.environ.get("DSLIB_BENCH_PROBE_S", "60"))
 
 
 def _median_time(fn, repeats=5):
@@ -69,15 +62,12 @@ def _emit(payload):
 
 
 def _guard(name, fn):
-    t = _watchdog(name)
     try:
         _emit(fn())
     except Exception as e:  # noqa: BLE001 — resilience is the whole point
         _emit({"metric": name, "value": None, "unit": None, "vs_baseline": None,
                "error": f"{type(e).__name__}: {e}",
                "trace": traceback.format_exc(limit=3)})
-    finally:
-        t.cancel()
 
 
 # ---------------------------------------------------------------------------
@@ -319,58 +309,129 @@ def bench_gmm(m, n, k, iters=5):
             "vs_baseline": round(cpu_wall / t, 2)}
 
 
-def main():
-    # backend bring-up under the watchdog too: if the device tunnel is
-    # wedged, record that fact as JSON instead of hanging silently
-    t = _watchdog("backend_init")
+def _configs():
+    """Ordered (name, thunk) list.  BENCH_SMOKE=1: every config at ~1/100
+    scale — validates the whole harness (gates, proxies, JSON, watchdog
+    orchestration) on CPU without the chip.  Full mode: BASELINE.md
+    configs 1-5, then the two north stars (KMeans ★ LAST so a driver that
+    parses the final stdout line records the headline)."""
+    if os.environ.get("BENCH_SMOKE"):
+        return [
+            ("kmeans_smoke", lambda: bench_kmeans(1000, 20, 4, 5, "smoke")),
+            ("matmul_smoke", lambda: bench_matmul(512, "smoke")),
+            ("matmul_smoke_bf16",
+             lambda: bench_matmul(512, "smoke", bf16=True)),
+            ("kmeans_smoke_fastdist",
+             lambda: bench_kmeans(1000, 20, 4, 5, "smoke_fastdist")),
+            ("tsqr_smoke", lambda: bench_tsqr(2048, 64)),
+            ("randomsvd_smoke", lambda: bench_randomsvd(1024, 128, nsv=16)),
+            ("gmm_smoke", lambda: bench_gmm(2000, 8, 3, 2)),
+            ("kmeans_smoke_star",
+             lambda: bench_kmeans(4000, 20, 4, 5, "smoke_star")),
+        ]
+    return [
+        ("kmeans_10000x100_k8_iter_per_sec",
+         lambda: bench_kmeans(10_000, 100, 8, 50, "10000x100_k8")),
+        ("matmul_4096_f32_gflops_per_chip",
+         lambda: bench_matmul(4096, "4096")),
+        ("tsqr_65536x256_wall_s", lambda: bench_tsqr(65536, 256)),
+        ("randomsvd_32768x1024_nsv64_wall_s",
+         lambda: bench_randomsvd(32768, 1024)),
+        ("gmm_1000000x50_k16_5it_wall_s",
+         lambda: bench_gmm(1_000_000, 50, 16, 5)),
+        ("matmul_16384_f32_gflops_per_chip",
+         lambda: bench_matmul(16384, "16384", proxy_dim=8192)),
+        # informational variants — headline ★ stays the full-precision path
+        ("matmul_16384_bf16_gflops_per_chip",
+         lambda: bench_matmul(16384, "16384", proxy_dim=8192, bf16=True)),
+        ("kmeans_1Mx100_k10_fastdist_iter_per_sec",
+         lambda: bench_kmeans(1_000_000, 100, 10, 10, "1Mx100_k10_fastdist")),
+        ("kmeans_1Mx100_k10_iter_per_sec",
+         lambda: bench_kmeans(1_000_000, 100, 10, 10, "1Mx100_k10")),
+    ]
+
+
+def _run_one(name):
+    """Child entry: bring up the backend and run exactly one config."""
+    # test hook: comma-separated config names that should hang (exercises
+    # the parent's skip-and-continue and two-timeouts-abort paths)
+    if name in os.environ.get("DSLIB_BENCH_FAKE_HANG", "").split(","):
+        time.sleep(10_000)
     try:
         import dislib_tpu as ds
         ds.init()
     except Exception as e:  # noqa: BLE001
         _emit({"metric": "backend_init", "value": None, "unit": None,
                "vs_baseline": None, "error": f"{type(e).__name__}: {e}"})
-        return
-    finally:
-        t.cancel()
+        sys.exit(2)
+    fn = dict(_configs())[name]
+    _guard(name, fn)
 
-    # BENCH_SMOKE=1: every config at ~1/100 scale — validates the whole
-    # harness (gates, proxies, JSON, watchdog) on CPU without the chip
-    import os
-    if os.environ.get("BENCH_SMOKE"):
-        _guard("kmeans_smoke", lambda: bench_kmeans(1000, 20, 4, 5, "smoke"))
-        _guard("matmul_smoke", lambda: bench_matmul(512, "smoke"))
-        _guard("matmul_smoke_bf16",
-               lambda: bench_matmul(512, "smoke", bf16=True))
-        _guard("kmeans_smoke_fastdist",
-               lambda: bench_kmeans(1000, 20, 4, 5, "smoke_fastdist"))
-        _guard("tsqr_smoke", lambda: bench_tsqr(2048, 64))
-        _guard("randomsvd_smoke", lambda: bench_randomsvd(1024, 128, nsv=16))
-        _guard("gmm_smoke", lambda: bench_gmm(2000, 8, 3, 2))
-        _guard("kmeans_smoke_star", lambda: bench_kmeans(4000, 20, 4, 5,
-                                                         "smoke_star"))
-        return
 
-    # BASELINE.md configs 1-5, then the two north stars (KMeans ★ LAST)
-    _guard("kmeans_10000x100_k8_iter_per_sec",
-           lambda: bench_kmeans(10_000, 100, 8, 50, "10000x100_k8"))
-    _guard("matmul_4096_f32_gflops_per_chip",
-           lambda: bench_matmul(4096, "4096"))
-    _guard("tsqr_65536x256_wall_s", lambda: bench_tsqr(65536, 256))
-    _guard("randomsvd_32768x1024_nsv64_wall_s",
-           lambda: bench_randomsvd(32768, 1024))
-    _guard("gmm_1000000x50_k16_5it_wall_s",
-           lambda: bench_gmm(1_000_000, 50, 16, 5))
-    _guard("matmul_16384_f32_gflops_per_chip",
-           lambda: bench_matmul(16384, "16384", proxy_dim=8192))
-    # informational variants — headline ★ stays the full-precision path
-    _guard("matmul_16384_bf16_gflops_per_chip",
-           lambda: bench_matmul(16384, "16384", proxy_dim=8192, bf16=True))
-    _guard("kmeans_1Mx100_k10_fastdist_iter_per_sec",
-           lambda: bench_kmeans(1_000_000, 100, 10, 10,
-                                "1Mx100_k10_fastdist"))
-    _guard("kmeans_1Mx100_k10_iter_per_sec",
-           lambda: bench_kmeans(1_000_000, 100, 10, 10, "1Mx100_k10"))
+def main():
+    # fast probe: a dead tunnel is detected in _PROBE_TIMEOUT_S, not per-
+    # config watchdog time.  The parent process never imports jax, so it
+    # can always report and exit cleanly.
+    try:
+        subprocess.run([sys.executable, "-c",
+                        "import jax; jax.devices()"],
+                       timeout=_PROBE_TIMEOUT_S, check=True,
+                       stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                       text=True)
+    except subprocess.TimeoutExpired:
+        _emit({"metric": "backend_init", "value": None, "unit": None,
+               "vs_baseline": None,
+               "error": f"device probe hung past {_PROBE_TIMEOUT_S}s "
+                        "(wedged tunnel?)"})
+        sys.exit(2)
+    except subprocess.CalledProcessError as e:
+        _emit({"metric": "backend_init", "value": None, "unit": None,
+               "vs_baseline": None,
+               "error": f"device probe failed (rc={e.returncode})",
+               "stderr_tail": (e.stderr or "")[-400:]})
+        sys.exit(2)
+
+    consecutive_timeouts = 0
+    for name, _ in _configs():
+        try:
+            res = subprocess.run([sys.executable, __file__, "--one", name],
+                                 timeout=_CONFIG_TIMEOUT_S,
+                                 capture_output=True, text=True)
+        except subprocess.TimeoutExpired as e:
+            # forward whatever the child printed before wedging
+            if e.stdout:
+                print(e.stdout.decode() if isinstance(e.stdout, bytes)
+                      else e.stdout, end="", flush=True)
+            _emit({"metric": name, "value": None, "unit": None,
+                   "vs_baseline": None,
+                   "error": f"watchdog: exceeded {_CONFIG_TIMEOUT_S}s "
+                            "(skipped, continuing)"})
+            consecutive_timeouts += 1
+            if consecutive_timeouts >= 2:
+                _emit({"metric": "abort", "value": None, "unit": None,
+                       "vs_baseline": None,
+                       "error": "two consecutive config timeouts — backend "
+                                "wedged, aborting"})
+                sys.exit(2)
+            continue
+        consecutive_timeouts = 0
+        print(res.stdout, end="", flush=True)
+        if '"metric": "backend_init"' in res.stdout:
+            # the child's backend bring-up failed fast: every later config
+            # would fail identically — record once and abort with evidence
+            _emit({"metric": "abort", "value": None, "unit": None,
+                   "vs_baseline": None,
+                   "error": "child backend_init failed — aborting"})
+            sys.exit(2)
+        if res.returncode != 0 and not res.stdout.strip():
+            _emit({"metric": name, "value": None, "unit": None,
+                   "vs_baseline": None,
+                   "error": f"config subprocess rc={res.returncode}",
+                   "stderr_tail": res.stderr[-400:]})
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 3 and sys.argv[1] == "--one":
+        _run_one(sys.argv[2])
+    else:
+        main()

@@ -88,7 +88,7 @@ class GaussianMixture(BaseEstimator):
             km = KMeans(n_clusters=k, max_iter=10, tol=1e-4,
                         random_state=self.random_state)
             centers = _kmeans_fit(x._data, x.shape, km._init_centers(x),
-                                  10, 1e-4)[0]
+                                  10, 1e-4, fast=km._fast())[0]
             labels = _kmeans_predict(x._data, x.shape, centers)[:, 0]
             resp = jax.nn.one_hot(labels, k, dtype=jnp.float32)
         elif self.init_params == "random":

@@ -1,0 +1,46 @@
+"""Bench orchestrator tests (BASELINE.md measurement rules; round-2 VERDICT
+weak #7): the parent must survive a wedged config (skip-and-continue), abort
+after two consecutive timeouts, and fail fast when the backend probe dies.
+
+These spawn the real ``bench.py`` parent with the fake-hang test hook; no
+config body runs, so they are cheap."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(env_extra, timeout=120):
+    env = {**os.environ, "BENCH_SMOKE": "1", "JAX_PLATFORMS": "cpu",
+           "PALLAS_AXON_POOL_IPS": "", **env_extra}
+    return subprocess.run([sys.executable, BENCH], env=env, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+def _lines(out):
+    return [json.loads(ln) for ln in out.strip().splitlines() if ln.strip()]
+
+
+class TestBenchOrchestrator:
+    def test_skip_and_continue_then_abort_on_second_timeout(self):
+        res = _run({"DSLIB_BENCH_FAKE_HANG": "kmeans_smoke,matmul_smoke",
+                    "DSLIB_BENCH_CONFIG_S": "5"})
+        assert res.returncode == 2
+        lines = _lines(res.stdout)
+        errs = [l for l in lines if l.get("error")]
+        # first hang: skipped-and-continuing; second: abort
+        assert any("skipped, continuing" in l["error"] for l in errs)
+        assert lines[-1]["metric"] == "abort"
+        assert "two consecutive" in lines[-1]["error"]
+
+    def test_probe_failure_is_fast_and_recorded(self):
+        res = _run({"JAX_PLATFORMS": "bogus_platform",
+                    "DSLIB_BENCH_PROBE_S": "30"})
+        assert res.returncode == 2
+        lines = _lines(res.stdout)
+        assert lines[0]["metric"] == "backend_init"
+        assert "probe failed" in lines[0]["error"]
