@@ -469,8 +469,15 @@ def _normalize_index(idx, dim):
 # public constructors  (parity: dislib.data.array constructors, SURVEY §3.1)
 # ---------------------------------------------------------------------------
 
-def array(x, block_size=None) -> Array:
-    """Build a ds-array from host data (ndarray, nested lists, or scipy sparse)."""
+def array(x, block_size=None, dtype=None) -> Array:
+    """Build a ds-array from host data (ndarray, nested lists, or scipy sparse).
+
+    ``dtype=None`` keeps the TPU-native float32 default but WARNS once when
+    that silently narrows float64 input (the reference's blocks are NumPy
+    float64 — a port should not change precision silently).  Pass an
+    explicit ``dtype=`` to silence the warning; ``dtype=np.float64`` is
+    honoured when JAX x64 mode is enabled (CPU rig) and raises a clear
+    error otherwise."""
     import scipy.sparse as sp
     sparse = sp.issparse(x)
     if sparse:
@@ -480,12 +487,36 @@ def array(x, block_size=None) -> Array:
         x = x.reshape(1, -1)
     if x.ndim != 2:
         raise ValueError("ds-arrays are 2-dimensional")
-    if x.dtype == np.float64:
-        x = x.astype(np.float32)
+    x = _coerce_dtype(x, dtype)
     if block_size is None:
         block_size = _default_block_size(x.shape, None)
     block_size = _check_block_size(x.shape, block_size)
     return Array._from_logical(jnp.asarray(x), reg_shape=block_size, sparse=sparse)
+
+
+def _require_dtype_support(dtype):
+    """Reject dtypes the backend would silently narrow (f64 without x64)."""
+    if np.dtype(dtype) == np.float64 and not jax.config.jax_enable_x64:
+        raise ValueError(
+            "dtype=float64 requires JAX x64 mode (JAX_ENABLE_X64=1 or "
+            "jax.config.update('jax_enable_x64', True)); the TPU-native "
+            "default is float32")
+
+
+def _coerce_dtype(x: np.ndarray, dtype):
+    """Apply the library dtype policy (see :func:`array`)."""
+    if dtype is not None:
+        _require_dtype_support(dtype)
+        return x.astype(np.dtype(dtype), copy=False)
+    if x.dtype == np.float64:
+        import warnings
+        warnings.warn(
+            "ds.array received float64 data and is narrowing it to float32 "
+            "(the TPU-native default). Pass dtype=np.float32 to silence, or "
+            "dtype=np.float64 with JAX x64 mode to keep full precision.",
+            UserWarning, stacklevel=3)
+        return x.astype(np.float32)
+    return x
 
 
 def _check_block_size(shape, block_size):
@@ -581,11 +612,19 @@ def apply_along_axis(func, axis, x: Array, *args, **kwargs) -> Array:
 
     ``func`` is first attempted as a JAX-traceable function (vmapped on
     device, so the map runs sharded); if tracing fails it falls back to
-    ``np.apply_along_axis`` on host."""
+    ``np.apply_along_axis`` on host — a device→host→device round trip that
+    is orders of magnitude slower, so the fallback WARNS with the original
+    trace error."""
     logical = x._data[: x._shape[0], : x._shape[1]]
     try:
         out = jnp.apply_along_axis(func, axis, logical, *args, **kwargs)
-    except Exception:
+    except Exception as e:  # noqa: BLE001 — any trace failure falls back
+        import warnings
+        warnings.warn(
+            f"apply_along_axis: {getattr(func, '__name__', func)!r} is not "
+            f"JAX-traceable ({type(e).__name__}: {e}); falling back to host "
+            "NumPy (device->host->device round trip, far slower)",
+            UserWarning, stacklevel=2)
         out = np.apply_along_axis(func, axis, np.asarray(jax.device_get(logical)),
                                   *args, **kwargs)
         out = jnp.asarray(out)

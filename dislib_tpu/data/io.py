@@ -5,12 +5,15 @@ reader tasks over a shared filesystem so loading is itself parallel —
 `load_txt_file`, `load_svmlight_file` (sparse-capable), `load_npy_file`,
 `load_mdcrd_file` (AMBER mdcrd MD trajectories), `save_txt`.
 
-TPU-native shape: in a multi-host job each host parses only the byte-range /
-row-range that lands in its local shards and the global array is assembled
-with `jax.make_array_from_process_local_data`; single-host (this build's test
-rig) parses locally and `device_put`s with the canonical sharding.  Parsing
-itself is host-side C-speed (numpy loadtxt / buffer ops), matching the
-reference where parsing was also CPU-side inside tasks.
+TPU-native shape (SURVEY §4.1 mapping): in a multi-host job each host scans
+the file for line offsets (cheap byte pass, no float parse), parses ONLY the
+row slab its addressable shards cover, and the global array is assembled
+shard-by-shard with `jax.make_array_from_single_device_arrays` — no
+collective at ingest and no host ever materialises the full logical array.
+Single-host (this build's test rig) parses locally and `device_put`s with
+the canonical sharding.  Parsing itself is host-side C-speed (numpy loadtxt
+/ native fastio), matching the reference where parsing was also CPU-side
+inside tasks.
 """
 
 from __future__ import annotations
@@ -20,32 +23,9 @@ import os
 
 import numpy as np
 
-from dislib_tpu.data.array import Array as _Array, array as _ds_array
-
-
-def _read_line_range(path, idx, count):
-    """Bytes of the idx-th of `count` byte-range slices of a text file,
-    adjusted to whole lines: a line belongs to the slice its FIRST byte
-    falls in (the classic shared-FS split — the reference's per-block
-    reader tasks partition files the same way, SURVEY §3.1 I/O row)."""
-    size = os.path.getsize(path)
-    lo = size * idx // count
-    hi = size * (idx + 1) // count
-    with open(path, "rb") as f:
-        if lo > 0:
-            f.seek(lo - 1)
-            f.readline()              # skip the line straddling the boundary
-            lo = f.tell()
-        if hi < size:
-            f.seek(hi - 1)
-            f.readline()              # extend to cover the straddling line
-            hi = f.tell()
-        else:
-            hi = size
-        if lo >= hi:
-            return b""
-        f.seek(lo)
-        return f.read(hi - lo)
+from dislib_tpu.data.array import (Array as _Array, array as _ds_array,
+                                   _padded_shape)
+from dislib_tpu.parallel import mesh as _mesh
 
 
 def _native_parse(parser_name, path):
@@ -80,53 +60,196 @@ def _parse_txt_buf(buf, delimiter, dtype):
                       ndmin=2)
 
 
-def _parse_txt_range(path, idx, count, delimiter, dtype):
-    """Parse one byte-range slice of a delimited text file (per-host work)."""
-    return _parse_txt_buf(_read_line_range(path, idx, count), delimiter,
-                          dtype)
+def _scan_line_offsets(path):
+    """Byte offset of every line start (one chunked pass, no float parse).
+    Assumes one sample per line (the loaders' contract); a trailing newline
+    does not produce a phantom row."""
+    chunks = [np.zeros(1, np.int64)]
+    pos = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(1 << 24)
+            if not buf:
+                break
+            nls = np.flatnonzero(np.frombuffer(buf, np.uint8) == 10) \
+                .astype(np.int64) + pos + 1
+            chunks.append(nls)
+            pos += len(buf)
+    starts = np.concatenate(chunks)
+    if len(starts) > 1 and starts[-1] >= pos:
+        starts = starts[:-1]
+    return starts, pos
+
+
+def _read_rows(path, starts, fsize, rlo, rhi):
+    """Raw bytes of rows [rlo, rhi) given the line-offset table."""
+    if rlo >= rhi:
+        return b""
+    b0 = int(starts[rlo])
+    b1 = int(starts[rhi]) if rhi < len(starts) else fsize
+    with open(path, "rb") as f:
+        f.seek(b0)
+        return f.read(b1 - b0)
+
+
+def _parse_rows(path, starts, fsize, rlo, rhi, delimiter, dtype, n):
+    """Parse rows [rlo, rhi) of a delimited text file (per-host slab work)."""
+    if rlo >= rhi:
+        return np.zeros((0, n), dtype)
+    return _parse_txt_buf(_read_rows(path, starts, fsize, rlo, rhi),
+                          delimiter, dtype)
+
+
+def _process_row_slab(m, n):
+    """Padded-row range [lo, hi) this process's addressable shards cover
+    under the canonical data sharding for a logical (m, n) array."""
+    import jax
+    pshape = _padded_shape((m, n), _mesh.pad_quantum())
+    imap = _mesh.data_sharding().devices_indices_map(pshape)
+    mine = [idx for d, idx in imap.items()
+            if d.process_index == jax.process_index()]
+    lo = min(s[0].indices(pshape[0])[0] for s in mine)
+    hi = max(s[0].indices(pshape[0])[1] for s in mine)
+    return lo, hi
+
+
+def _from_local_rows(local, lo, shape, block_size, dtype):
+    """Assemble a global ds-array from this process's parsed row slab
+    ``local`` (rows [lo, lo+len(local)) of the logical array) — one
+    device_put per addressable shard, zero collectives, no host ever holds
+    more than its slab."""
+    import jax
+    m, n = shape
+    pshape = _padded_shape((m, n), _mesh.pad_quantum())
+    sh = _mesh.data_sharding()
+    arrs = []
+    for d, idx in sh.devices_indices_map(pshape).items():
+        if d.process_index != jax.process_index():
+            continue
+        r0, r1, _ = idx[0].indices(pshape[0])
+        c0, c1, _ = idx[1].indices(pshape[1])
+        blk = np.zeros((r1 - r0, c1 - c0), dtype)
+        rr0, rr1 = max(r0, lo), min(r1, lo + local.shape[0])
+        cc1 = min(c1, n)
+        if rr0 < rr1 and c0 < cc1:
+            blk[rr0 - r0: rr1 - r0, : cc1 - c0] = \
+                local[rr0 - lo: rr1 - lo, c0:cc1]
+        arrs.append(jax.device_put(blk, d))
+    garr = jax.make_array_from_single_device_arrays(pshape, sh, arrs)
+    return _Array(garr, (m, n), reg_shape=block_size)
 
 
 def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32):
     """Load a delimited text file into a ds-array (reference: load_txt_file).
 
-    Multi-process jobs (``jax.process_count() > 1``) parse per-host byte
-    ranges (`_parse_txt_range`) so ingest scales with hosts; the global
-    array is assembled from the per-host row counts.  Single-process (this
-    build's test rig) parses locally — same code path as one range."""
+    Multi-process jobs (``jax.process_count() > 1``): each host scans line
+    offsets (byte pass), parses only the rows its shards cover, and places
+    them shard-locally — ingest parallelism AND ingest memory both scale
+    with hosts (SURVEY §4.1).  Single-process parses locally."""
     import jax
-    pcount = jax.process_count()
-    if pcount <= 1:
+    if jax.process_count() <= 1:
         with open(path, "rb") as f:
             data = _parse_txt_buf(f.read(), delimiter, dtype)
         if data.size == 0:
             data = np.loadtxt(path, delimiter=delimiter, dtype=dtype, ndmin=2)
-        return _ds_array(data, block_size=block_size)
-    from jax.experimental import multihost_utils
-    local = _parse_txt_range(path, jax.process_index(), pcount, delimiter,
-                             dtype)
-    dims = np.asarray(multihost_utils.process_allgather(
-        np.asarray([local.shape[0], local.shape[1]], np.int64)))
-    dims = dims.reshape(pcount, 2)
-    counts, nf = dims[:, 0], int(dims[:, 1].max())
-    # pad ragged per-host slices to a common shape for the allgather, then
-    # reassemble in host order; each host ends with the full logical array
-    # (device placement is still the canonical mesh sharding in _ds_array —
-    # the per-host win is the parse, which is the expensive part)
-    nmax = int(counts.max())
-    pad = np.zeros((nmax, nf), dtype=dtype)
-    pad[: local.shape[0], : local.shape[1]] = local
-    gathered = np.asarray(multihost_utils.process_allgather(pad, tiled=False))
-    data = np.concatenate([gathered[i, : int(c)]
-                           for i, c in enumerate(counts) if c], axis=0)
-    return _ds_array(data, block_size=block_size)
+        return _ds_array(data, block_size=block_size, dtype=dtype)
+    from dislib_tpu.data.array import _require_dtype_support
+    _require_dtype_support(dtype)
+    starts, fsize = _scan_line_offsets(path)
+    m = len(starts)
+    with open(path, "rb") as f:
+        n = _parse_txt_buf(f.readline(), delimiter, dtype).shape[1]
+    lo, hi = _process_row_slab(m, n)
+    rlo, rhi = min(lo, m), min(hi, m)
+    local = _parse_rows(path, starts, fsize, rlo, rhi, delimiter, dtype, n)
+    if local.shape[0] != rhi - rlo:
+        # np.loadtxt skips blank/comment lines the offset table counted —
+        # silently zero-filling the shortfall would fabricate rows
+        raise ValueError(
+            "multi-process text ingest requires one sample per line "
+            "(blank/comment lines found) — load single-process instead")
+    return _from_local_rows(local, rlo, (m, n), block_size, dtype)
 
 
-def load_npy_file(path, block_size=None):
-    """Load a .npy file into a ds-array (reference: load_npy_file)."""
-    data = np.load(path, allow_pickle=False)
-    if data.ndim != 2:
+def load_npy_file(path, block_size=None, dtype=None):
+    """Load a .npy file into a ds-array (reference: load_npy_file).
+
+    Multi-process jobs memory-map the file and materialise only this
+    host's row slab (same shard-local contract as `load_txt_file`)."""
+    import jax
+    from dislib_tpu.data.array import _coerce_dtype
+    mm = np.load(path, allow_pickle=False, mmap_mode="r")
+    if mm.ndim != 2:
         raise ValueError("load_npy_file expects a 2-D array")
-    return _ds_array(data, block_size=block_size)
+    if jax.process_count() <= 1:
+        return _ds_array(np.asarray(mm), block_size=block_size, dtype=dtype)
+    m, n = mm.shape
+    lo, hi = _process_row_slab(m, n)
+    rlo, rhi = min(lo, m), min(hi, m)
+    local = _coerce_dtype(np.asarray(mm[rlo:rhi]), dtype)
+    return _from_local_rows(local, rlo, (m, n), block_size, local.dtype)
+
+
+def _parse_svmlight_text(lines):
+    """Pure-Python svmlight parse of an iterable of text lines →
+    (rows: list of {feat: val}, labels, max_feat).  Duplicate feature
+    indices sum (CSR semantics, = sklearn's loader)."""
+    rows, labels = [], []
+    max_feat = 0
+    for line in lines:
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        labels.append(float(parts[0]))
+        feats = {}
+        for tok in parts[1:]:
+            if tok.startswith("#"):
+                break
+            k, v = tok.split(":")
+            feats[int(k)] = feats.get(int(k), 0.0) + float(v)
+        if feats:
+            max_feat = max(max_feat, max(feats))
+        rows.append(feats)
+    return rows, labels, max_feat
+
+
+def _svmlight_dense(rows, m_feats):
+    dense = np.zeros((len(rows), m_feats), dtype=np.float32)
+    for i, feats in enumerate(rows):
+        for k, v in feats.items():
+            dense[i, k - 1] = v  # svmlight is 1-indexed
+    return dense
+
+
+def _load_svmlight_sharded(path, block_size, n_features):
+    """Multi-process dense svmlight: parse only this host's row slab
+    (requires one sample per line — no blank/comment lines — so the line
+    offset table indexes rows exactly).  When ``n_features`` is None one
+    tiny scalar allgather establishes the global feature count."""
+    import jax
+    from jax.experimental import multihost_utils
+    starts, fsize = _scan_line_offsets(path)
+    m = len(starts)
+    lo, hi = _process_row_slab(m, n_features or 1)
+    rlo, rhi = min(lo, m), min(hi, m)
+    buf = _read_rows(path, starts, fsize, rlo, rhi)
+    rows, labels, max_feat = _parse_svmlight_text(
+        buf.decode().splitlines())
+    if len(rows) != rhi - rlo:
+        raise ValueError(
+            "multi-process svmlight ingest requires one sample per line "
+            "(blank/comment lines found) — load single-process instead")
+    if n_features is None:
+        n_features = int(np.max(multihost_utils.process_allgather(
+            np.asarray([max_feat], np.int64))))
+    dense = _svmlight_dense(rows, n_features)
+    x = _from_local_rows(dense, rlo, (m, n_features), block_size, np.float32)
+    yloc = np.asarray(labels, np.float32).reshape(-1, 1)
+    y = _from_local_rows(yloc, rlo, (m, 1),
+                         (block_size[0], 1) if block_size else None,
+                         np.float32)
+    return x, y
 
 
 def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True):
@@ -135,7 +258,16 @@ def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True
     Hand-rolled parser (no sklearn dependency in the library path); native
     C++ single-pass CSR parser (`dislib_tpu.native.parse_svmlight`) when
     available, pure-Python fallback otherwise.  Duplicate feature indices
-    sum (CSR semantics, = sklearn's loader) on both paths."""
+    sum (CSR semantics, = sklearn's loader) on both paths.
+
+    Multi-process jobs with ``store_sparse=False`` ingest shard-locally
+    (each host parses only its row slab, like `load_txt_file`); the sparse
+    path parses the whole file per process — the BCOO backing is
+    process-replicated by design (`SparseArray` docstring), so there is no
+    shard-local placement to exploit."""
+    import jax
+    if jax.process_count() > 1 and not store_sparse:
+        return _load_svmlight_sharded(path, block_size, n_features)
     parsed = _native_parse("parse_svmlight", path)
     if parsed is not None:
         labels_a, indptr, indices, data, nfeat = parsed
@@ -152,30 +284,10 @@ def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True
         y = _ds_array(labels_a.reshape(-1, 1),
                       block_size=(block_size[0], 1) if block_size else None)
         return x, y
-    rows, labels = [], []
-    max_feat = 0
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#"):
-                continue
-            parts = line.split()
-            labels.append(float(parts[0]))
-            feats = {}
-            for tok in parts[1:]:
-                if tok.startswith("#"):
-                    break
-                k, v = tok.split(":")
-                feats[int(k)] = feats.get(int(k), 0.0) + float(v)
-            if feats:
-                max_feat = max(max_feat, max(feats))
-            rows.append(feats)
-    n = len(rows)
+        rows, labels, max_feat = _parse_svmlight_text(f)
     m = n_features if n_features is not None else max_feat
-    dense = np.zeros((n, m), dtype=np.float32)
-    for i, feats in enumerate(rows):
-        for k, v in feats.items():
-            dense[i, k - 1] = v  # svmlight is 1-indexed
+    dense = _svmlight_dense(rows, m)
     if store_sparse:
         import scipy.sparse as sp
         from dislib_tpu.data.sparse import SparseArray

@@ -60,9 +60,27 @@ class SparseArray:
         """Lazy padded dense backing — the reference's per-block
         ``.toarray()`` escape hatch, so every non-sparse-aware estimator
         transparently accepts a SparseArray (at densification memory cost).
-        Sparse-aware paths (KMeans) dispatch on the type before touching
-        this."""
+        Sparse-aware paths (KMeans, NearestNeighbors) dispatch on the type
+        before touching this.  Guarded: densification past the
+        ``DSLIB_SPARSE_DENSIFY_BUDGET`` byte budget (default 4 GiB) raises
+        instead of silently OOMing a chip — raise the env var to opt out."""
         if self._dense_cache is None:
+            import os
+            from dislib_tpu.data.array import _padded_shape
+            # the dense backing is PADDED to the mesh quantum — budget on
+            # the real allocation, not the logical shape
+            pm, pn = _padded_shape(self._shape, _mesh.pad_quantum())
+            need = 4 * pm * pn                                  # f32 bytes
+            budget = int(os.environ.get("DSLIB_SPARSE_DENSIFY_BUDGET",
+                                        4 << 30))
+            if need > budget:
+                raise MemoryError(
+                    f"densifying this {self._shape} SparseArray needs "
+                    f"~{need / 2**30:.1f} GiB (> budget "
+                    f"{budget / 2**30:.1f} GiB). This estimator has no "
+                    "sparse-native path; use a sparse-aware one (KMeans, "
+                    "NearestNeighbors, ALS, scalers) or raise "
+                    "DSLIB_SPARSE_DENSIFY_BUDGET to densify anyway.")
             self._dense_cache = self.to_dense()._data
         return self._dense_cache
 
@@ -255,6 +273,37 @@ class SparseArray:
         out = tuple(jax.device_put(jnp.asarray(a), sh)
                     for a in (data, lrows, cols, rowsq))
         self._sharded_cache = (p, out)
+        return out
+
+
+    def chunked_rows(self, chunk):
+        """(data, local_rows, cols) rectangular per-row-chunk triplet
+        buffers, leading axis = ceil(m/chunk) chunks; padding entries are
+        (v=0, row=0, col=0) so a scatter-add of a chunk contributes nothing
+        for them.  Lets consumers stream a bounded dense window
+        (chunk × n) of the matrix on device without ever densifying the
+        whole thing (the kNN sparse path).  Cached per chunk size."""
+        cached = getattr(self, "_chunked_cache", None)
+        if cached is not None and cached[0] == chunk:
+            return cached[1]
+        m = self._shape[0]
+        n_chunks = max(1, -(-m // chunk))
+        idx = np.asarray(jax.device_get(self._bcoo.indices))
+        val = np.asarray(jax.device_get(self._bcoo.data))
+        which = idx[:, 0] // chunk
+        counts = np.bincount(which, minlength=n_chunks)
+        nnz_max = max(1, int(counts.max()))
+        data = np.zeros((n_chunks, nnz_max), np.float32)
+        lrows = np.zeros((n_chunks, nnz_max), np.int32)
+        cols = np.zeros((n_chunks, nnz_max), np.int32)
+        for s in range(n_chunks):
+            sel = which == s
+            c = int(counts[s])
+            data[s, :c] = val[sel]
+            lrows[s, :c] = idx[sel, 0] - s * chunk
+            cols[s, :c] = idx[sel, 1]
+        out = tuple(jnp.asarray(a) for a in (data, lrows, cols))
+        self._chunked_cache = (chunk, out)
         return out
 
 

@@ -96,16 +96,32 @@ def _crop_or_keep(padded, logical_shape):
 # ---------------------------------------------------------------------------
 
 def kron(a: Array, b: Array, block_size=None) -> Array:
-    """Kronecker product (reference: dislib.math.kron)."""
-    av = a._data[: a.shape[0], : a.shape[1]]
-    bv = b._data[: b.shape[0], : b.shape[1]]
-    out = _kron_kernel(av, bv)
-    return Array._from_logical(out, reg_shape=block_size)
+    """Kronecker product (reference: dislib.math.kron — one scaled-copy task
+    per (block of a) × (block of b)).
+
+    Computed directly into the sharded output via the index lattice
+    ``out[r, c] = a[r//mb, c//nb] · b[r%mb, c%nb]`` — row/column gathers of
+    the (small) operands, never the 4-D broadcast intermediate ``jnp.kron``
+    builds, so per-device peak memory is O(output shard + operands)."""
+    from dislib_tpu.data.array import _padded_shape
+    (ma, na), (mb, nb) = a.shape, b.shape
+    shape = (ma * mb, na * nb)
+    pshape = _padded_shape(shape, _mesh.pad_quantum())
+    out = _kron_kernel(a._data, b._data, (a.shape, b.shape), pshape)
+    return Array(out, shape, reg_shape=block_size)
 
 
-@jax.jit
-def _kron_kernel(a, b):
-    out = jnp.kron(a, b)
+@partial(jax.jit, static_argnames=("shapes", "pshape"))
+def _kron_kernel(ap, bp, shapes, pshape):
+    (ma, na), (mb, nb) = shapes
+    av, bv = ap[:ma, :na], bp[:mb, :nb]
+    ri = lax.iota(jnp.int32, pshape[0])
+    ci = lax.iota(jnp.int32, pshape[1])
+    # clip keeps the pad-region gathers in bounds; the mask re-zeroes them
+    a_exp = av[jnp.clip(ri // mb, 0, ma - 1)][:, jnp.clip(ci // nb, 0, na - 1)]
+    b_til = bv[ri % mb][:, ci % nb]
+    valid = (ri < ma * mb)[:, None] & (ci < na * nb)[None, :]
+    out = jnp.where(valid, a_exp * b_til, 0.0)
     return lax.with_sharding_constraint(out, _mesh.data_sharding())
 
 
@@ -218,11 +234,12 @@ def _round_robin_pairs(n):
         rounds.append(pr)
         idx = [idx[0]] + [idx[-1]] + idx[1:-1]
     width = max(len(r) for r in rounds)
-    # pad rounds to equal width with a self-pair on a dummy (rotation no-op via
-    # aij==0 path is unsafe; instead repeat the first pair — rotating an
-    # already-rotated pair twice per round is avoided by only padding with a
-    # pair duplicated *within the same round*? Safer: pad with pair (0,1) only
-    # for odd n where a dummy existed; those rounds have width-1 entries.
+    # Pad short rounds by repeating their last pair.  Safe because
+    # rotate_round gathers all pair columns from the PRE-round matrix and
+    # scatters with .set semantics: both copies of a duplicated pair compute
+    # the identical rotation from identical inputs and write identical
+    # values, so the duplicate write is idempotent (it does NOT rotate
+    # twice).
     padded = []
     for r in rounds:
         while len(r) < width:

@@ -17,9 +17,14 @@ TPU-native redesign — a distributed blocked factorisation, not a gather:
   panel offset is a traced `dynamic_slice` index inside a `lax.fori_loop`,
   and the accumulated-Q buffer is full width with not-yet-computed columns
   held at zero so shapes never change.
-- mode='full' (square Q) and the short-wide case delegate to XLA's native
-  Householder QR over the global array — a replicated fallback, appropriate
-  at the sizes where an m×m Q is representable at all.
+- mode='full' (square Q) at blocked sizes runs DISTRIBUTED too: economic
+  blocked QR gives Q₁ (m, n); the orthonormal complement Q₂ (m, m−n) comes
+  from a random Gaussian block projected against Q₁ twice ("twice is
+  enough") and then blocked-QR-factored — Q = [Q₁ | Q₂] stays row-sharded
+  throughout, and the random completion is deterministic (fixed seed).
+  Small/short-wide inputs delegate to XLA's native Householder QR over the
+  global array — a replicated fallback, appropriate at sizes where that is
+  cheaper than two panel sweeps.
 
 Modes follow the reference: 'full' (Q m×m, R m×n), 'economic' (Q m×n, R n×n),
 'r' (R only).
@@ -61,13 +66,15 @@ def qr(a: Array, mode: str = "full", overwrite_a: bool = False):
     mesh = _mesh.get_mesh()
     p = mesh.shape[_mesh.ROWS]
     mp = a._data.shape[0]
-    if mode in ("economic", "r") and m >= n and n > _PANEL \
-            and mp // p >= _PANEL and mp % p == 0:
+    blocked_ok = m >= n and n > _PANEL and mp // p >= _PANEL and mp % p == 0
+    if mode in ("economic", "r") and blocked_ok:
         q_pad, r = _qr_blocked(a._data, (m, n), mesh, p, _PANEL)
         if mode == "r":
             return Array._from_logical(r[:n, :n])
         return (Array._from_logical_padded(q_pad, (m, n), a._reg_shape),
                 Array._from_logical(r[:n, :n]))
+    if mode == "full" and blocked_ok and m - n > _PANEL:
+        return _qr_full_distributed(a, m, n, mesh, p)
     av = a._data[:m, :n].astype(jnp.float32)
     if mode == "full":
         q, r = _qr_kernel(av, "complete", (m, n))
@@ -76,6 +83,39 @@ def qr(a: Array, mode: str = "full", overwrite_a: bool = False):
     if mode == "r":
         return Array._from_logical(r)
     return Array._from_logical(q), Array._from_logical(r)
+
+
+def _qr_full_distributed(a: Array, m, n, mesh, p):
+    """mode='full' without gathering: Q₁ from the economic panel loop, then
+    an orthonormal complement Q₂ from a deterministic random block projected
+    against Q₁ (twice) and blocked-QR-factored.  Everything row-sharded; the
+    only replicated object is the (n, n) R.  Rank-deficient A carries the
+    same conditioning caveat as the economic path (Gram–Schmidt panels)."""
+    q1, r = _qr_blocked(a._data, (m, n), mesh, p, _PANEL)
+    k = m - n
+    g = _qr_complement_seed(q1, (m, n), k, mesh)
+    q2, _ = _qr_blocked(g, (m, k), mesh, p, _PANEL)
+    q_full = jnp.concatenate([q1[:, :n], q2[:, :k]], axis=1)[:m]
+    r_full = jnp.zeros((m, n), jnp.float32).at[:n, :n].set(r[:n, :n])
+    return (Array._from_logical(q_full, a._reg_shape),
+            Array._from_logical(r_full))
+
+
+@partial(jax.jit, static_argnames=("shape", "k", "mesh"))
+@precise
+def _qr_complement_seed(q1, shape, k, mesh):
+    """Row-sharded (mp, k) Gaussian block orthogonal to q1's columns up to
+    roundoff: two projection passes I − Q₁Q₁ᵀ ("twice is enough").  q1's
+    padded columns (≥ n) are zero, so they drop out of the projections."""
+    mp = q1.shape[0]
+    m, _ = shape
+    g = jax.random.normal(jax.random.PRNGKey(0), (mp, k), jnp.float32)
+    row = lax.broadcasted_iota(jnp.int32, (mp, 1), 0)
+    g = jnp.where(row < m, g, 0.0)
+    g = lax.with_sharding_constraint(g, _mesh.row_sharding(mesh))
+    for _ in range(2):
+        g = g - q1 @ (q1.T @ g)
+    return g
 
 
 @partial(jax.jit, static_argnames=("shape", "mesh", "p", "panel"))

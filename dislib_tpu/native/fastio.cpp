@@ -23,7 +23,8 @@
 namespace {
 
 // Line-aligned [lo, hi) byte range for slice idx of count: a line belongs to
-// the slice its first byte falls in (mirrors io.py::_read_line_range).
+// the slice its first byte falls in (the in-buffer thread split; io.py's multi-host slab split
+// uses an exact line-offset table instead).
 void line_range(const char* buf, int64_t len, int idx, int count,
                 int64_t* lo_out, int64_t* hi_out) {
     int64_t lo = len * (int64_t)idx / count;

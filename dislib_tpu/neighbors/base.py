@@ -10,6 +10,13 @@ sets stream in fitted-row chunks with a running top-k merge — top_k over
 O(mq·(k + chunk)), never O(mq·mf); this is the reference's own pairwise
 merge tree, collapsed to a `lax.scan`.  Padded fit rows are masked to +inf
 so they can never be neighbors.
+
+Sparse inputs (SURVEY §8 hard part 2) are NATIVE — no densification of the
+whole matrix ever happens: a sparse fit set streams as row-chunk triplet
+buffers scatter-added into a bounded (chunk, n) dense window on device
+(`SparseArray.chunked_rows`), a sparse query contributes its cross-term as
+one spmm per chunk, and ‖·‖² terms come from segment-sums over the
+nonzeros — the same economics as the sparse KMeans path.
 """
 
 from __future__ import annotations
@@ -60,6 +67,14 @@ class NearestNeighbors(BaseEstimator):
         f = self._fit_data
         if not 1 <= k <= f.shape[0]:
             raise ValueError(f"n_neighbors {k} not in [1, {f.shape[0]}]")
+        from dislib_tpu.data.sparse import SparseArray
+        if isinstance(f, SparseArray) or isinstance(x, SparseArray):
+            d, idx = _kneighbors_sparse(x, f, k)
+            d_arr = Array._from_logical_padded(
+                _repad(d, (x.shape[0], k)), (x.shape[0], k))
+            i_arr = Array._from_logical_padded(
+                _repad(idx, (x.shape[0], k)), (x.shape[0], k))
+            return (d_arr, i_arr) if return_distance else i_arr
         mesh = _mesh.get_mesh()
         # getattr: models loaded from pre-`ring` snapshots lack the attr.
         # The trailing rows>1 guard stays even for forced ring=True: unlike
@@ -123,6 +138,76 @@ def _kneighbors(qp, fp, q_shape, f_shape, k, chunk=None):
     dist_k = jnp.where(valid_q, dist_k, 0.0)
     idx = jnp.where(valid_q, idx, 0)
     return dist_k, idx
+
+
+def _kneighbors_sparse(x, f, k):
+    """kNN with a sparse fit set and/or sparse queries — streams the fit
+    rows as bounded dense windows, never densifies a whole matrix."""
+    from dislib_tpu.data.sparse import SparseArray
+    n = f.shape[1]
+    chunk = min(_CHUNK, max(1, f.shape[0]))
+    if isinstance(f, SparseArray):
+        fdat, flr, fcol = f.chunked_rows(chunk)
+        f_args = (fdat, flr, fcol, None)
+    else:
+        f_args = (None, None, None, f._data[: f.shape[0], : f.shape[1]])
+    if isinstance(x, SparseArray):
+        q_bcoo, q_dense = x._bcoo, None
+        q_rowsq = x.row_norms_sq()
+    else:
+        q_dense = x._data[: x.shape[0], : x.shape[1]]
+        q_bcoo = None
+        q_rowsq = jnp.sum(q_dense * q_dense, axis=1)
+    return _kneighbors_sparse_kernel(
+        q_bcoo, q_dense, q_rowsq, *f_args, n=n, mq=x.shape[0],
+        mf=f.shape[0], k=k, chunk=chunk)
+
+
+@partial(jax.jit, static_argnames=("n", "mq", "mf", "k", "chunk"))
+@precise
+def _kneighbors_sparse_kernel(q_bcoo, q_dense, q_rowsq, fdat, flr, fcol,
+                              f_dense, n, mq, mf, k, chunk):
+    """Running top-k over fit-row chunks (same merge as the dense chunked
+    path).  Each chunk's dense window materialises by scatter-add from its
+    triplet buffer (sparse fit) or a dynamic slice (dense fit); the
+    cross-term is one GEMM (dense queries) or one spmm (sparse queries)."""
+    n_chunks = fdat.shape[0] if fdat is not None else -(-mf // chunk)
+
+    def window(i):
+        if fdat is not None:
+            d_e, lr, cc = fdat[i], flr[i], fcol[i]
+            dense = jnp.zeros((chunk, n), q_rowsq.dtype).at[lr, cc].add(d_e)
+            rowsq = jax.ops.segment_sum(d_e * d_e, lr, num_segments=chunk)
+        else:
+            fpad = jnp.pad(f_dense,
+                           ((0, n_chunks * chunk - f_dense.shape[0]), (0, 0)))
+            dense = lax.dynamic_slice(fpad, (i * chunk, 0), (chunk, n))
+            rowsq = jnp.sum(dense * dense, axis=1)
+        return dense, rowsq
+
+    def body(carry, i):
+        best_neg, best_idx = carry
+        dense, f_rowsq = window(i)
+        if q_bcoo is not None:
+            from dislib_tpu.data.sparse import _spmm
+            cross = _spmm(q_bcoo, dense.T)                   # (mq, chunk)
+        else:
+            cross = q_dense @ dense.T
+        dist = jnp.maximum(q_rowsq[:, None] - 2.0 * cross + f_rowsq[None, :],
+                           0.0)
+        col = i * chunk + lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+        dist = jnp.where(col >= mf, jnp.inf, dist)
+        cand_neg = jnp.concatenate([best_neg, -dist], axis=1)
+        cand_idx = jnp.concatenate(
+            [best_idx, jnp.broadcast_to(col, (dist.shape[0], chunk))], axis=1)
+        neg, sel = lax.top_k(cand_neg, k)
+        return (neg, jnp.take_along_axis(cand_idx, sel, axis=1)), None
+
+    init = (jnp.full((mq, k), -jnp.inf, q_rowsq.dtype),
+            jnp.zeros((mq, k), jnp.int32))
+    (best_neg, best_idx), _ = lax.scan(body, init,
+                                       jnp.arange(n_chunks, dtype=jnp.int32))
+    return jnp.sqrt(jnp.maximum(-best_neg, 0.0)), best_idx
 
 
 def _kneighbors_chunked(qv, fv, mf, k, chunk):

@@ -30,8 +30,32 @@ def _bootstrap(rank, nprocs, port, csv_path):
     import numpy as np
     import dislib_tpu as ds
     ds.init((jax.device_count(), 1))        # rows axis spans the "DCN"
-    # per-host parallel ingest: each process parses only its byte range
+    # per-host SHARD-LOCAL ingest: each process parses only its row slab
+    # and must neither run a collective nor materialise the full array
+    # (SURVEY §4.1; round-2 VERDICT missing #3).  Instrumented: any
+    # process_allgather during the load fails the job.
+    from jax.experimental import multihost_utils as _mh
+    calls = {"n": 0}
+    real_ag = _mh.process_allgather
+
+    def counting_ag(*a, **k):
+        calls["n"] += 1
+        return real_ag(*a, **k)
+
+    _mh.process_allgather = counting_ag
     x = ds.load_txt_file(csv_path, block_size=(16, 5))
+    _mh.process_allgather = real_ag
+    assert calls["n"] == 0, "ingest ran a collective — not shard-local"
+    # addressable shards cover exactly this rank's contiguous row slab
+    M = x._data.shape[0]
+    imap = x._data.sharding.devices_indices_map(x._data.shape)
+    spans = sorted(idx[0].indices(M)[:2]
+                   for d, idx in imap.items()
+                   if d.process_index == jax.process_index())
+    slab = M // nprocs
+    assert spans[0][0] == rank * slab, (spans, rank, slab)
+    assert max(s[1] for s in spans) == (rank + 1) * slab, (spans, rank, slab)
+    assert not x._data.is_fully_addressable
     return ds, x, np.asarray(x.collect())
 
 

@@ -186,6 +186,17 @@ class TestApplyAlongAxis:
         got = ds.apply_along_axis(jnp.mean, 1, a).collect()
         np.testing.assert_allclose(got, x.mean(1, keepdims=True), rtol=1e-5)
 
+    def test_host_fallback_warns(self, rng):
+        import pytest
+        a, x = _mk(rng, (6, 4))
+
+        def untraceable(row):
+            return float(np.asarray(row).sum())  # forces concrete values
+
+        with pytest.warns(UserWarning, match="not JAX-traceable"):
+            got = ds.apply_along_axis(untraceable, 1, a).collect()
+        np.testing.assert_allclose(got.ravel(), x.sum(1), rtol=1e-5)
+
 
 class TestMeshes:
     def test_2d_mesh(self, rng):

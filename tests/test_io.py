@@ -77,29 +77,83 @@ class TestMdcrd:
         np.testing.assert_allclose(a.collect().ravel(), coords, atol=1e-3)
 
 
-class TestByteRangeIngest:
-    """Per-host parallel ingest (SURVEY §3.1 I/O, VERDICT r1 missing #7):
-    the byte-range splitter must partition a text file exactly — every line
-    in exactly one slice, concatenation order-preserving — for any host
-    count, including slices smaller than one line."""
+class TestRowSlabIngest:
+    """Per-host shard-local ingest (SURVEY §4.1, VERDICT r2 missing #3):
+    the line-offset table must index rows exactly — any partition of
+    [0, m) into row slabs reconstructs the file, order-preserving."""
 
     @pytest.mark.parametrize("pcount", [1, 2, 3, 7, 16])
-    def test_ranges_partition_exactly(self, rng, tmp_path, pcount):
-        from dislib_tpu.data.io import _parse_txt_range
+    def test_row_slabs_partition_exactly(self, rng, tmp_path, pcount):
+        from dislib_tpu.data.io import _parse_rows, _scan_line_offsets
         x = rng.rand(53, 4).astype(np.float32)
         path = tmp_path / "rows.csv"
         np.savetxt(path, x, delimiter=",")
-        parts = [_parse_txt_range(str(path), i, pcount, ",", np.float32)
+        starts, fsize = _scan_line_offsets(str(path))
+        m = len(starts)
+        assert m == 53
+        bounds = [m * i // pcount for i in range(pcount + 1)]
+        parts = [_parse_rows(str(path), starts, fsize, bounds[i],
+                             bounds[i + 1], ",", np.float32, 4)
                  for i in range(pcount)]
         got = np.concatenate([p for p in parts if p.size], axis=0)
         np.testing.assert_allclose(got, x, rtol=1e-5)
 
-    def test_more_ranges_than_lines(self, rng, tmp_path):
-        from dislib_tpu.data.io import _parse_txt_range
+    def test_no_trailing_newline(self, rng, tmp_path):
+        from dislib_tpu.data.io import _parse_rows, _scan_line_offsets
+        path = tmp_path / "nonl.csv"
+        with open(path, "w") as f:
+            f.write("1.0,2.0\n3.0,4.0")          # no trailing newline
+        starts, fsize = _scan_line_offsets(str(path))
+        assert len(starts) == 2
+        got = _parse_rows(str(path), starts, fsize, 0, 2, ",", np.float32, 2)
+        np.testing.assert_allclose(got, [[1, 2], [3, 4]])
+
+    def test_empty_slab(self, rng, tmp_path):
+        from dislib_tpu.data.io import _parse_rows, _scan_line_offsets
         x = rng.rand(3, 2).astype(np.float32)
         path = tmp_path / "tiny.csv"
         np.savetxt(path, x, delimiter=",")
-        parts = [_parse_txt_range(str(path), i, 11, ",", np.float32)
-                 for i in range(11)]
-        got = np.concatenate([p for p in parts if p.size], axis=0)
-        np.testing.assert_allclose(got, x, rtol=1e-5)
+        starts, fsize = _scan_line_offsets(str(path))
+        got = _parse_rows(str(path), starts, fsize, 3, 3, ",", np.float32, 2)
+        assert got.shape == (0, 2)
+
+
+class TestDtypePolicy:
+    """VERDICT r2 #7: explicit dtype= through constructors/loaders; silent
+    f64→f32 narrowing warns once."""
+
+    def test_f64_narrowing_warns(self, rng):
+        with pytest.warns(UserWarning, match="narrowing it to float32"):
+            a = ds.array(rng.rand(4, 3))          # rng.rand is float64
+        assert a.dtype == np.float32
+
+    def test_explicit_f32_silences(self, rng):
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            a = ds.array(rng.rand(4, 3), dtype=np.float32)
+        assert a.dtype == np.float32
+
+    def test_f64_without_x64_raises(self, rng):
+        with pytest.raises(ValueError, match="x64"):
+            ds.array(rng.rand(4, 3), dtype=np.float64)
+
+    def test_f64_with_x64_roundtrips(self, rng):
+        import jax
+        with jax.enable_x64(True):
+            a = ds.array(rng.rand(4, 3), dtype=np.float64)
+            got = a.collect()
+        assert got.dtype == np.float64
+
+    def test_loader_dtype_param(self, rng, tmp_path):
+        import warnings
+        x = rng.rand(6, 3)
+        path = os.path.join(tmp_path, "x.npy")
+        np.save(path, x)                           # float64 on disk
+        with pytest.warns(UserWarning, match="narrowing"):
+            a = ds.load_npy_file(path)
+        assert a.dtype == np.float32
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            b = ds.load_npy_file(path, dtype=np.float32)
+        assert b.dtype == np.float32

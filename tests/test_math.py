@@ -43,6 +43,35 @@ class TestKron:
         np.testing.assert_allclose(ds.kron(ds.array(a), ds.array(b)).collect(),
                                    np.kron(a, b), rtol=1e-5)
 
+    def test_kron_irregular(self, rng):
+        a, b = rng.rand(7, 3), rng.rand(2, 9)
+        np.testing.assert_allclose(ds.kron(ds.array(a), ds.array(b)).collect(),
+                                   np.kron(a, b), rtol=1e-5)
+
+    def test_kron_large_product_stays_sharded(self, rng):
+        """VERDICT r2 #8: an 8192x8192 product (256 MB f32) — far past a
+        single virtual device's plausible share — computes with each device
+        holding only its output shard plus the (small) operands."""
+        a = ds.array(rng.rand(512, 512).astype(np.float32))
+        b = ds.array(rng.rand(16, 16).astype(np.float32))
+        c = ds.kron(a, b)
+        assert c.shape == (8192, 8192)
+        total = 8192 * 8192 * 4
+        ndev = len({s.device for s in c._data.addressable_shards})
+        for s in c._data.addressable_shards:
+            assert s.data.nbytes <= total // ndev
+        # spot-check values without materialising np.kron on host
+        ah, bh = a.collect(), b.collect()
+        got = np.asarray(c._data[1000:1002, 2000:2004])
+        want = np.stack([
+            [ah[r // 16, cc // 16] * bh[r % 16, cc % 16]
+             for cc in range(2000, 2004)] for r in range(1000, 1002)])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        # global invariant: sum(kron(a,b)) == sum(a)·sum(b)
+        np.testing.assert_allclose(
+            float(c.sum(axis=None).collect()[0, 0]),
+            float(ah.sum()) * float(bh.sum()), rtol=1e-3)
+
 
 class TestQR:
     @pytest.mark.parametrize("shape", [(16, 16), (20, 8), (9, 9)])
@@ -89,6 +118,23 @@ class TestBlockedQR:
         np.testing.assert_allclose(qc @ rc, x, atol=1e-3)
         np.testing.assert_allclose(qc.T @ qc, np.eye(shape[1]), atol=1e-3)
         np.testing.assert_allclose(np.tril(rc, -1), 0, atol=1e-4)
+
+    @pytest.mark.parametrize("shape", [(256, 64), (320, 40)])
+    def test_full_mode_distributed(self, rng, shape, monkeypatch):
+        """VERDICT r2 #5: mode='full' runs the panel loop + random-completion
+        complement at blocked sizes — Q (m, m) orthonormal, QR == A."""
+        import importlib
+        qr_mod = importlib.import_module("dislib_tpu.math.qr")
+        monkeypatch.setattr(qr_mod, "_PANEL", 32)
+        m, n = shape
+        x = rng.rand(m, n).astype(np.float32)
+        q, r = ds.qr(ds.array(x), mode="full")
+        qc, rc = q.collect(), r.collect()
+        assert qc.shape == (m, m) and rc.shape == (m, n)
+        np.testing.assert_allclose(qc @ rc, x, atol=1e-3)
+        np.testing.assert_allclose(qc.T @ qc, np.eye(m), atol=1e-3)
+        np.testing.assert_allclose(np.tril(rc[:n, :n], -1), 0, atol=1e-4)
+        assert np.allclose(rc[n:], 0)
 
     def test_r_mode_matches_numpy(self, rng, monkeypatch):
         import importlib

@@ -219,3 +219,75 @@ class TestSparseScaler:
             StandardScaler(with_mean=True).fit(xs)
         with pytest.raises(TypeError):
             MinMaxScaler().fit(xs)
+
+
+class TestSparseKNN:
+    """VERDICT r2 #6: sparse-native NearestNeighbors — cross-terms via spmm /
+    bounded dense windows, no whole-matrix densification — plus the densify
+    budget guard on the `_data` escape hatch."""
+
+    def _data(self, m=150, n=12, seed=3):
+        rng = np.random.RandomState(seed)
+        dense = rng.rand(m, n).astype(np.float32)
+        dense[dense < 0.7] = 0.0
+        return dense
+
+    def test_sparse_fit_sparse_query_matches_dense(self, monkeypatch):
+        import scipy.sparse as sp
+        import dislib_tpu as ds
+        from dislib_tpu.data.sparse import SparseArray
+        from dislib_tpu.neighbors import NearestNeighbors
+        import dislib_tpu.neighbors.base as nb
+        monkeypatch.setattr(nb, "_CHUNK", 32)    # force multi-chunk streaming
+        dense = self._data()
+        xs = SparseArray.from_scipy(sp.csr_matrix(dense))
+        # guard armed: ANY full densification would raise
+        monkeypatch.setenv("DSLIB_SPARSE_DENSIFY_BUDGET", "1")
+        d_sp, i_sp = NearestNeighbors(n_neighbors=4).fit(xs).kneighbors(xs)
+        monkeypatch.delenv("DSLIB_SPARSE_DENSIFY_BUDGET")
+        xd = ds.array(dense)
+        d_d, i_d = NearestNeighbors(n_neighbors=4).fit(xd).kneighbors(xd)
+        # atol 2e-3: the dense oracle's own GEMM cancellation noise is
+        # ~5e-4 on self-distances (the sparse path is exactly 0 there)
+        np.testing.assert_allclose(np.asarray(d_sp.collect()),
+                                   np.asarray(d_d.collect()),
+                                   rtol=1e-3, atol=2e-3)
+        np.testing.assert_array_equal(np.asarray(i_sp.collect()),
+                                      np.asarray(i_d.collect()))
+
+    def test_mixed_sparse_dense(self, monkeypatch):
+        import scipy.sparse as sp
+        import dislib_tpu as ds
+        from dislib_tpu.data.sparse import SparseArray
+        from dislib_tpu.neighbors import NearestNeighbors
+        dense = self._data(m=80)
+        q = self._data(m=20, seed=5)
+        xs = SparseArray.from_scipy(sp.csr_matrix(dense))
+        xd, qd = ds.array(dense), ds.array(q)
+        d_ref, i_ref = NearestNeighbors(n_neighbors=3).fit(xd).kneighbors(qd)
+        # sparse fit, dense query
+        d1, i1 = NearestNeighbors(n_neighbors=3).fit(xs).kneighbors(qd)
+        np.testing.assert_array_equal(np.asarray(i1.collect()),
+                                      np.asarray(i_ref.collect()))
+        # dense fit, sparse query
+        qs = SparseArray.from_scipy(sp.csr_matrix(q))
+        d2, i2 = NearestNeighbors(n_neighbors=3).fit(xd).kneighbors(qs)
+        np.testing.assert_array_equal(np.asarray(i2.collect()),
+                                      np.asarray(i_ref.collect()))
+        np.testing.assert_allclose(np.asarray(d1.collect()),
+                                   np.asarray(d_ref.collect()),
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(d2.collect()),
+                                   np.asarray(d_ref.collect()),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_densify_guard_trips_and_opts_out(self, monkeypatch):
+        import scipy.sparse as sp
+        from dislib_tpu.data.sparse import SparseArray
+        xs = SparseArray.from_scipy(sp.csr_matrix(self._data()))
+        monkeypatch.setenv("DSLIB_SPARSE_DENSIFY_BUDGET", "1")
+        with pytest.raises(MemoryError, match="DSLIB_SPARSE_DENSIFY_BUDGET"):
+            xs._data
+        # raising the budget opts out
+        monkeypatch.setenv("DSLIB_SPARSE_DENSIFY_BUDGET", str(1 << 30))
+        assert xs._data.shape[0] >= 150
