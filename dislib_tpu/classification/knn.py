@@ -46,6 +46,21 @@ class KNeighborsClassifier(BaseEstimator):
         self._codes = jnp.asarray(codes)
         return self
 
+    def _predict_codes(self, x: Array):
+        """Winning class codes per query row, (mq_pad-or-mq, 1) int32.
+        Sparse fit/query routes through the sparse-native neighbor search
+        (no whole-matrix densification), then votes on its (dist, idx)."""
+        from dislib_tpu.data.sparse import SparseArray
+        f = self._fit_x
+        if isinstance(f, SparseArray) or isinstance(x, SparseArray):
+            from dislib_tpu.neighbors.base import _kneighbors_sparse
+            dist_k, idx = _kneighbors_sparse(x, f, self.n_neighbors)
+            return _knn_vote(dist_k, idx, self._codes, len(self.classes_),
+                             self.weights == "distance")
+        return _knn_predict(x._data, f._data, x.shape, f.shape, self._codes,
+                            len(self.classes_), self.n_neighbors,
+                            self.weights == "distance", _nb._CHUNK)
+
     def predict(self, x: Array) -> Array:
         self._check_fitted()
         if self.weights not in ("uniform", "distance"):
@@ -55,10 +70,7 @@ class KNeighborsClassifier(BaseEstimator):
                              f"{self._fit_x.shape[0]}")
         # the device kernel votes in int32 code space; class values are
         # mapped on host so integer labels never round-trip through float32
-        codes = _knn_predict(x._data, self._fit_x._data, x.shape,
-                             self._fit_x.shape, self._codes,
-                             len(self.classes_), self.n_neighbors,
-                             self.weights == "distance", _nb._CHUNK)
+        codes = self._predict_codes(x)
         labels = self.classes_[np.asarray(jax.device_get(codes)).ravel()
                                [: x.shape[0]]]
         dt = np.int32 if np.issubdtype(labels.dtype, np.integer) else np.float32
@@ -71,9 +83,89 @@ class KNeighborsClassifier(BaseEstimator):
         pred = self.predict(x).collect().ravel()
         return float((pred == y.collect().ravel()).mean())
 
+    # async trial protocol (SURVEY §4.5): the fit is host-side input prep
+    # (class codes); the heavy work is the predict/score program, which
+    # _score_async returns as a device scalar so GridSearchCV pipelines all
+    # trials' kNN GEMMs before reading any accuracy back
+    def _fit_async(self, x, y=None):
+        if y is None:
+            raise ValueError("KNeighborsClassifier requires y")
+        self.fit(x, y)
+        return (x,)
+
+    def _score_async(self, state, x, y=None):
+        if state is None or y is None:
+            return super()._score_async(state, x, y)
+        if self.weights not in ("uniform", "distance"):
+            raise ValueError(f"bad weights {self.weights!r}")
+        if self.n_neighbors > self._fit_x.shape[0]:
+            raise ValueError(f"n_neighbors {self.n_neighbors} > fitted "
+                             f"samples {self._fit_x.shape[0]}")
+        from dislib_tpu.data.sparse import SparseArray
+        classes_dev = jnp.asarray(np.asarray(self.classes_, np.float32))
+        if isinstance(self._fit_x, SparseArray) or isinstance(x, SparseArray):
+            pred = self._predict_codes(x)
+            return _score_codes(pred, y._data, classes_dev, x.shape[0])
+        return _knn_score(x._data, self._fit_x._data, y._data, x.shape,
+                          self._fit_x.shape, self._codes, classes_dev,
+                          self.n_neighbors, self.weights == "distance",
+                          _nb._CHUNK)
+
     def _check_fitted(self):
         if not hasattr(self, "_fit_x"):
             raise RuntimeError("KNeighborsClassifier is not fitted")
+
+
+def _vote(dist_k, idx, codes, n_classes, use_dist):
+    """Winner class code per row from (dist, idx) neighbor lists."""
+    neigh_codes = codes[idx]                                  # (rows, k)
+    onehot = jax.nn.one_hot(neigh_codes, n_classes, dtype=jnp.float32)
+    if use_dist:
+        wts = 1.0 / jnp.maximum(dist_k, 1e-10)
+        votes = jnp.sum(onehot * wts[:, :, None], axis=1)
+    else:
+        votes = jnp.sum(onehot, axis=1)
+    return jnp.argmax(votes, axis=1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("n_classes", "use_dist"))
+@precise
+def _knn_vote(dist_k, idx, codes, n_classes, use_dist):
+    return _vote(dist_k, idx, codes, n_classes, use_dist)[:, None]
+
+
+def _codes_of(yv, classes_dev):
+    """Map label values into class-code space; a round-trip equality check
+    marks labels unseen at fit time (they can never count as correct)."""
+    n_classes = classes_dev.shape[0]
+    yc = jnp.clip(jnp.searchsorted(classes_dev, yv), 0, n_classes - 1) \
+        .astype(jnp.int32)
+    return yc, classes_dev[yc] == yv
+
+
+@partial(jax.jit, static_argnames=("mq",))
+def _score_codes(pred, yp, classes_dev, mq):
+    """Device accuracy from predicted class codes (sparse-path scoring)."""
+    yv = yp[: pred.shape[0], 0].astype(jnp.float32)
+    yc, seen = _codes_of(yv, classes_dev)
+    valid = lax.broadcasted_iota(jnp.int32, (pred.shape[0],), 0) < mq
+    hits = jnp.sum((pred[:, 0] == yc) & seen & valid)
+    return hits.astype(jnp.float32) / mq
+
+
+@partial(jax.jit, static_argnames=("q_shape", "f_shape", "k", "use_dist",
+                                   "chunk"))
+@precise
+def _knn_score(qp, fp, yp, q_shape, f_shape, codes, classes_dev, k, use_dist,
+               chunk):
+    """Device accuracy: predicted class codes vs y mapped into code space.
+    Unseen validation labels (not in classes_) can never count as correct —
+    the round-trip check classes_[y_code] == y guards the searchsorted
+    collision."""
+    n_classes = classes_dev.shape[0]
+    pred = _knn_predict(qp, fp, q_shape, f_shape, codes, n_classes, k,
+                        use_dist, chunk)
+    return _score_codes(pred, yp, classes_dev, q_shape[0])
 
 
 @partial(jax.jit, static_argnames=("q_shape", "f_shape", "n_classes", "k",
@@ -82,14 +174,7 @@ class KNeighborsClassifier(BaseEstimator):
 def _knn_predict(qp, fp, q_shape, f_shape, codes, n_classes, k, use_dist,
                  chunk):
     dist_k, idx = _kneighbors(qp, fp, q_shape, f_shape, k, chunk=chunk)
-    neigh_codes = codes[idx]                                  # (mq_pad, k)
-    onehot = jax.nn.one_hot(neigh_codes, n_classes, dtype=jnp.float32)
-    if use_dist:
-        wts = 1.0 / jnp.maximum(dist_k, 1e-10)
-        votes = jnp.sum(onehot * wts[:, :, None], axis=1)
-    else:
-        votes = jnp.sum(onehot, axis=1)
-    winner = jnp.argmax(votes, axis=1).astype(jnp.int32)
+    winner = _vote(dist_k, idx, codes, n_classes, use_dist)
     mq = q_shape[0]
     valid = lax.broadcasted_iota(jnp.int32, (winner.shape[0],), 0) < mq
     return jnp.where(valid, winner, 0)[:, None]

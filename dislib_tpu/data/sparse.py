@@ -79,8 +79,9 @@ class SparseArray:
                     f"~{need / 2**30:.1f} GiB (> budget "
                     f"{budget / 2**30:.1f} GiB). This estimator has no "
                     "sparse-native path; use a sparse-aware one (KMeans, "
-                    "NearestNeighbors, ALS, scalers) or raise "
-                    "DSLIB_SPARSE_DENSIFY_BUDGET to densify anyway.")
+                    "NearestNeighbors, KNeighborsClassifier, ALS, scalers) "
+                    "or raise DSLIB_SPARSE_DENSIFY_BUDGET to densify "
+                    "anyway.")
             self._dense_cache = self.to_dense()._data
         return self._dense_cache
 
@@ -276,34 +277,58 @@ class SparseArray:
         return out
 
 
-    def chunked_rows(self, chunk):
-        """(data, local_rows, cols) rectangular per-row-chunk triplet
-        buffers, leading axis = ceil(m/chunk) chunks; padding entries are
-        (v=0, row=0, col=0) so a scatter-add of a chunk contributes nothing
-        for them.  Lets consumers stream a bounded dense window
-        (chunk × n) of the matrix on device without ever densifying the
-        whole thing (the kNN sparse path).  Cached per chunk size."""
-        cached = getattr(self, "_chunked_cache", None)
+    def row_steps(self, chunk):
+        """Equal-shape per-step triplet buffers for streaming a bounded
+        dense window of the matrix (the kNN sparse path): rows are packed
+        greedily into steps bounded BOTH by ``chunk`` rows and by an nnz
+        budget (4× the average chunk's nonzeros, and never below the
+        densest single row), so skewed sparsity cannot inflate the
+        rectangles to O(n_steps · max_chunk_nnz) — total padding is at most
+        ~one budget per step.  Returns (data (s, budget), local_rows,
+        cols, row_off (s,), rows_in (s,)); padding entries are (v=0,
+        row=0, col=0) and scatter-add to nothing.  Cached per chunk."""
+        cached = getattr(self, "_row_steps_cache", None)
         if cached is not None and cached[0] == chunk:
             return cached[1]
         m = self._shape[0]
-        n_chunks = max(1, -(-m // chunk))
         idx = np.asarray(jax.device_get(self._bcoo.indices))
         val = np.asarray(jax.device_get(self._bcoo.data))
-        which = idx[:, 0] // chunk
-        counts = np.bincount(which, minlength=n_chunks)
-        nnz_max = max(1, int(counts.max()))
-        data = np.zeros((n_chunks, nnz_max), np.float32)
-        lrows = np.zeros((n_chunks, nnz_max), np.int32)
-        cols = np.zeros((n_chunks, nnz_max), np.int32)
-        for s in range(n_chunks):
-            sel = which == s
-            c = int(counts[s])
-            data[s, :c] = val[sel]
-            lrows[s, :c] = idx[sel, 0] - s * chunk
-            cols[s, :c] = idx[sel, 1]
-        out = tuple(jnp.asarray(a) for a in (data, lrows, cols))
-        self._chunked_cache = (chunk, out)
+        order = np.argsort(idx[:, 0], kind="stable")
+        rows_sorted = idx[order, 0]
+        row_nnz = np.bincount(rows_sorted, minlength=m)
+        row_start = np.concatenate([[0], np.cumsum(row_nnz)])
+        avg_chunk_nnz = max(1, int(np.ceil(len(val) * chunk / max(m, 1))))
+        budget = max(64, 4 * avg_chunk_nnz, int(row_nnz.max(initial=1)))
+        steps = []                       # (row_off, rows_in, nnz_lo, nnz_hi)
+        r = 0
+        while r < m:
+            r_end = r
+            while (r_end < m and r_end - r < chunk
+                   and (r_end == r
+                        or row_start[r_end + 1] - row_start[r] <= budget)):
+                r_end += 1
+            steps.append((r, r_end - r, int(row_start[r]),
+                          int(row_start[r_end])))
+            r = r_end
+        if not steps:
+            steps = [(0, 0, 0, 0)]
+        s = len(steps)
+        data = np.zeros((s, budget), np.float32)
+        lrows = np.zeros((s, budget), np.int32)
+        cols = np.zeros((s, budget), np.int32)
+        row_off = np.zeros(s, np.int32)
+        rows_in = np.zeros(s, np.int32)
+        for i, (ro, rc, nlo, nhi) in enumerate(steps):
+            c = nhi - nlo
+            sel = order[nlo:nhi]
+            data[i, :c] = val[sel]
+            lrows[i, :c] = idx[sel, 0] - ro
+            cols[i, :c] = idx[sel, 1]
+            row_off[i] = ro
+            rows_in[i] = rc
+        out = tuple(jnp.asarray(a)
+                    for a in (data, lrows, cols, row_off, rows_in))
+        self._row_steps_cache = (chunk, out)
         return out
 
 

@@ -69,6 +69,13 @@ class NearestNeighbors(BaseEstimator):
             raise ValueError(f"n_neighbors {k} not in [1, {f.shape[0]}]")
         from dislib_tpu.data.sparse import SparseArray
         if isinstance(f, SparseArray) or isinstance(x, SparseArray):
+            if getattr(self, "ring", None):
+                import warnings
+                warnings.warn(
+                    "NearestNeighbors(ring=True) is not supported for "
+                    "sparse inputs; using the single-program sparse path "
+                    "(fit-set triplets replicated per device)",
+                    UserWarning, stacklevel=2)
             d, idx = _kneighbors_sparse(x, f, k)
             d_arr = Array._from_logical_padded(
                 _repad(d, (x.shape[0], k)), (x.shape[0], k))
@@ -147,10 +154,15 @@ def _kneighbors_sparse(x, f, k):
     n = f.shape[1]
     chunk = min(_CHUNK, max(1, f.shape[0]))
     if isinstance(f, SparseArray):
-        fdat, flr, fcol = f.chunked_rows(chunk)
-        f_args = (fdat, flr, fcol, None)
+        f_args = (*f.row_steps(chunk), None)
     else:
-        f_args = (None, None, None, f._data[: f.shape[0], : f.shape[1]])
+        # dense fit as full-row steps: the same kernel shape, windows cut
+        # by dynamic_slice instead of scatter
+        n_chunks = -(-f.shape[0] // chunk)
+        row_off = jnp.arange(n_chunks, dtype=jnp.int32) * chunk
+        rows_in = jnp.minimum(chunk, f.shape[0] - row_off).astype(jnp.int32)
+        f_args = (None, None, None, row_off, rows_in,
+                  f._data[: f.shape[0], : f.shape[1]])
     if isinstance(x, SparseArray):
         q_bcoo, q_dense = x._bcoo, None
         q_rowsq = x.row_norms_sq()
@@ -166,28 +178,32 @@ def _kneighbors_sparse(x, f, k):
 @partial(jax.jit, static_argnames=("n", "mq", "mf", "k", "chunk"))
 @precise
 def _kneighbors_sparse_kernel(q_bcoo, q_dense, q_rowsq, fdat, flr, fcol,
-                              f_dense, n, mq, mf, k, chunk):
-    """Running top-k over fit-row chunks (same merge as the dense chunked
-    path).  Each chunk's dense window materialises by scatter-add from its
-    triplet buffer (sparse fit) or a dynamic slice (dense fit); the
-    cross-term is one GEMM (dense queries) or one spmm (sparse queries)."""
-    n_chunks = fdat.shape[0] if fdat is not None else -(-mf // chunk)
+                              row_off, rows_in, f_dense, n, mq, mf, k,
+                              chunk):
+    """Running top-k over fit-row steps (same merge as the dense chunked
+    path).  Each step covers rows [row_off, row_off+rows_in) — its dense
+    window materialises by scatter-add from the step's triplet buffer
+    (sparse fit) or a dynamic slice (dense fit); the cross-term is one
+    GEMM (dense queries) or one spmm (sparse queries).  Window rows beyond
+    rows_in belong to OTHER steps and are masked to +inf."""
+    n_steps = row_off.shape[0]
 
-    def window(i):
+    def window(i, ro):
         if fdat is not None:
             d_e, lr, cc = fdat[i], flr[i], fcol[i]
             dense = jnp.zeros((chunk, n), q_rowsq.dtype).at[lr, cc].add(d_e)
             rowsq = jax.ops.segment_sum(d_e * d_e, lr, num_segments=chunk)
         else:
             fpad = jnp.pad(f_dense,
-                           ((0, n_chunks * chunk - f_dense.shape[0]), (0, 0)))
-            dense = lax.dynamic_slice(fpad, (i * chunk, 0), (chunk, n))
+                           ((0, n_steps * chunk - f_dense.shape[0]), (0, 0)))
+            dense = lax.dynamic_slice(fpad, (ro, 0), (chunk, n))
             rowsq = jnp.sum(dense * dense, axis=1)
         return dense, rowsq
 
-    def body(carry, i):
+    def body(carry, xs):
         best_neg, best_idx = carry
-        dense, f_rowsq = window(i)
+        i, ro, rc = xs
+        dense, f_rowsq = window(i, ro)
         if q_bcoo is not None:
             from dislib_tpu.data.sparse import _spmm
             cross = _spmm(q_bcoo, dense.T)                   # (mq, chunk)
@@ -195,8 +211,9 @@ def _kneighbors_sparse_kernel(q_bcoo, q_dense, q_rowsq, fdat, flr, fcol,
             cross = q_dense @ dense.T
         dist = jnp.maximum(q_rowsq[:, None] - 2.0 * cross + f_rowsq[None, :],
                            0.0)
-        col = i * chunk + lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
-        dist = jnp.where(col >= mf, jnp.inf, dist)
+        col = ro + lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+        in_step = lax.broadcasted_iota(jnp.int32, (1, chunk), 1) < rc
+        dist = jnp.where(in_step & (col < mf), dist, jnp.inf)
         cand_neg = jnp.concatenate([best_neg, -dist], axis=1)
         cand_idx = jnp.concatenate(
             [best_idx, jnp.broadcast_to(col, (dist.shape[0], chunk))], axis=1)
@@ -205,8 +222,9 @@ def _kneighbors_sparse_kernel(q_bcoo, q_dense, q_rowsq, fdat, flr, fcol,
 
     init = (jnp.full((mq, k), -jnp.inf, q_rowsq.dtype),
             jnp.zeros((mq, k), jnp.int32))
-    (best_neg, best_idx), _ = lax.scan(body, init,
-                                       jnp.arange(n_chunks, dtype=jnp.int32))
+    (best_neg, best_idx), _ = lax.scan(
+        body, init,
+        (jnp.arange(n_steps, dtype=jnp.int32), row_off, rows_in))
     return jnp.sqrt(jnp.maximum(-best_neg, 0.0)), best_idx
 
 

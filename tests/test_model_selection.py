@@ -236,14 +236,43 @@ class TestAsyncAdoption:
         np.testing.assert_allclose(a.items_, sync.items_, rtol=1e-4, atol=1e-5)
         assert a.n_iter_ == sync.n_iter_
 
+    def test_knn_async_score_matches_sync(self, rng):
+        x, y = _blobs(rng, n=90, k=3)
+        perm = rng.permutation(len(x))
+        xa, ya = ds.array(x[perm]), ds.array(y[perm])
+        est = KNeighborsClassifier(n_neighbors=3)
+        state = est._fit_async(xa, ya)
+        dev = float(est._score_async(state, xa, ya))
+        assert np.isclose(dev, est.score(xa, ya), rtol=1e-6)
+
+    def test_knn_async_unseen_labels_never_correct(self, rng):
+        x, y = _blobs(rng, n=60, k=2)
+        est = KNeighborsClassifier(n_neighbors=1)
+        state = est._fit_async(ds.array(x), ds.array(y))
+        y_unseen = ds.array(np.full_like(y, 99.0))
+        assert float(est._score_async(state, ds.array(x), y_unseen)) == 0.0
+
     def test_fallback_notice_logged_once(self, rng, caplog):
         import logging
+        from dislib_tpu.base import BaseEstimator
         import dislib_tpu.base as base_mod
-        base_mod._ASYNC_FALLBACK_NOTICED.discard("KNeighborsClassifier")
-        x, y = _blobs(rng, n=60)
+
+        class _NoAsync(BaseEstimator):
+            def __init__(self, a=1):
+                self.a = a
+
+            def fit(self, x, y=None):
+                self.done_ = True
+                return self
+
+            def score(self, x, y=None):
+                return float(self.a)
+
+        base_mod._ASYNC_FALLBACK_NOTICED.discard("_NoAsync")
+        x, _ = _blobs(rng, n=60)
         with caplog.at_level(logging.INFO, logger="dslib.search"):
-            GridSearchCV(KNeighborsClassifier(), {"n_neighbors": [1, 3]},
-                         cv=2, refit=False).fit(ds.array(x), ds.array(y))
+            GridSearchCV(_NoAsync(), {"a": [1, 2]},
+                         cv=2, refit=False).fit(ds.array(x))
         notices = [r for r in caplog.records
                    if "does not implement _fit_async" in r.message]
         assert len(notices) == 1

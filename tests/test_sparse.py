@@ -291,3 +291,49 @@ class TestSparseKNN:
         # raising the budget opts out
         monkeypatch.setenv("DSLIB_SPARSE_DENSIFY_BUDGET", str(1 << 30))
         assert xs._data.shape[0] >= 150
+
+    def test_sparse_knn_classifier_no_densify(self, monkeypatch):
+        import scipy.sparse as sp
+        import dislib_tpu as ds
+        from dislib_tpu.data.sparse import SparseArray
+        from dislib_tpu.classification import KNeighborsClassifier
+        rng = np.random.RandomState(0)
+        dense = np.vstack([rng.rand(40, 8), rng.rand(40, 8) + 2.0]) \
+            .astype(np.float32)
+        dense[dense < 0.5] = 0.0
+        y = np.r_[np.zeros(40), np.ones(40)].astype(np.float32)[:, None]
+        xs = SparseArray.from_scipy(sp.csr_matrix(dense))
+        monkeypatch.setenv("DSLIB_SPARSE_DENSIFY_BUDGET", "1")
+        est = KNeighborsClassifier(n_neighbors=3).fit(xs, ds.array(y))
+        pred = est.predict(xs).collect().ravel()
+        acc_async = float(est._score_async((xs,), xs, ds.array(y)))
+        monkeypatch.delenv("DSLIB_SPARSE_DENSIFY_BUDGET")
+        xd = ds.array(dense)
+        ref = KNeighborsClassifier(n_neighbors=3).fit(xd, ds.array(y))
+        np.testing.assert_array_equal(pred, ref.predict(xd).collect().ravel())
+        assert np.isclose(acc_async, ref.score(xd, ds.array(y)), rtol=1e-6)
+
+    def test_row_steps_bounded_under_skew(self):
+        import scipy.sparse as sp
+        from dislib_tpu.data.sparse import SparseArray
+        # one pathologically dense row block amid near-empty rows
+        rng = np.random.RandomState(1)
+        m, n = 5000, 64
+        rows = np.r_[np.full(20000, 7), rng.randint(0, m, 500)]
+        cols = rng.randint(0, n, rows.shape[0])
+        mat = sp.csr_matrix((np.ones(rows.shape[0], np.float32),
+                             (rows, cols)), shape=(m, n))
+        xs = SparseArray.from_scipy(mat)
+        data, lrows, colb, row_off, rows_in = xs.row_steps(1024)
+        total_alloc = data.size
+        nnz = xs.nnz
+        # rectangles stay within a small factor of the actual triplets
+        assert total_alloc <= 6 * nnz + 10 * data.shape[1]
+        # steps partition all m rows exactly once
+        spans = sorted(zip(np.asarray(row_off), np.asarray(rows_in)))
+        assert spans[0][0] == 0
+        covered = 0
+        for ro, rc in spans:
+            assert ro == covered
+            covered += int(rc)
+        assert covered == m
