@@ -102,7 +102,12 @@ class KNeighborsClassifier(BaseEstimator):
             raise ValueError(f"n_neighbors {self.n_neighbors} > fitted "
                              f"samples {self._fit_x.shape[0]}")
         from dislib_tpu.data.sparse import SparseArray
-        classes_dev = jnp.asarray(np.asarray(self.classes_, np.float32))
+        # compare in y's backing dtype (not a forced float32): classes_ come
+        # from the same storage pipeline as y, so they are distinct in that
+        # dtype and code mapping is collision-free (f64 labels under x64
+        # mode included)
+        classes_dev = jnp.asarray(np.asarray(self.classes_),
+                                  dtype=y._data.dtype)
         if isinstance(self._fit_x, SparseArray) or isinstance(x, SparseArray):
             pred = self._predict_codes(x)
             return _score_codes(pred, y._data, classes_dev, x.shape[0])
@@ -145,8 +150,8 @@ def _codes_of(yv, classes_dev):
 
 @partial(jax.jit, static_argnames=("mq",))
 def _score_codes(pred, yp, classes_dev, mq):
-    """Device accuracy from predicted class codes (sparse-path scoring)."""
-    yv = yp[: pred.shape[0], 0].astype(jnp.float32)
+    """Device accuracy from predicted class codes."""
+    yv = yp[: pred.shape[0], 0].astype(classes_dev.dtype)
     yc, seen = _codes_of(yv, classes_dev)
     valid = lax.broadcasted_iota(jnp.int32, (pred.shape[0],), 0) < mq
     hits = jnp.sum((pred[:, 0] == yc) & seen & valid)

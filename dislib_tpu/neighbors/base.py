@@ -12,11 +12,12 @@ merge tree, collapsed to a `lax.scan`.  Padded fit rows are masked to +inf
 so they can never be neighbors.
 
 Sparse inputs (SURVEY §8 hard part 2) are NATIVE — no densification of the
-whole matrix ever happens: a sparse fit set streams as row-chunk triplet
-buffers scatter-added into a bounded (chunk, n) dense window on device
-(`SparseArray.chunked_rows`), a sparse query contributes its cross-term as
-one spmm per chunk, and ‖·‖² terms come from segment-sums over the
-nonzeros — the same economics as the sparse KMeans path.
+whole matrix ever happens: a sparse fit set streams as skew-bounded
+row-step triplet buffers (`SparseArray.row_steps`: steps capped by both a
+row count and an nnz budget) scatter-added into a bounded (chunk, n) dense
+window on device, a sparse query contributes its cross-term as one spmm
+per step, and ‖·‖² terms come from segment-sums over the nonzeros — the
+same economics as the sparse KMeans path.
 """
 
 from __future__ import annotations

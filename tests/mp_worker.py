@@ -44,6 +44,12 @@ def _bootstrap(rank, nprocs, port, csv_path):
 
     _mh.process_allgather = counting_ag
     x = ds.load_txt_file(csv_path, block_size=(16, 5))
+    if os.path.exists(csv_path + ".npy"):
+        xn = ds.load_npy_file(csv_path + ".npy")
+        xsv, _ = ds.load_svmlight_file(csv_path + ".svm", n_features=5,
+                                       store_sparse=False)
+    else:
+        xn = xsv = None
     _mh.process_allgather = real_ag
     assert calls["n"] == 0, "ingest ran a collective — not shard-local"
     # addressable shards cover exactly this rank's contiguous row slab
@@ -56,7 +62,13 @@ def _bootstrap(rank, nprocs, port, csv_path):
     assert spans[0][0] == rank * slab, (spans, rank, slab)
     assert max(s[1] for s in spans) == (rank + 1) * slab, (spans, rank, slab)
     assert not x._data.is_fully_addressable
-    return ds, x, np.asarray(x.collect())
+    xs_host = np.asarray(x.collect())
+    if xn is not None:
+        np.testing.assert_allclose(np.asarray(xn.collect()), xs_host,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(xsv.collect()), xs_host,
+                                   atol=2e-6)
+    return ds, x, xs_host
 
 
 def crashfit_main():

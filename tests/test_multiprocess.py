@@ -36,6 +36,15 @@ def test_two_process_kmeans_matches_single(tmp_path):
     data = rng.rand(96, 5).astype(np.float32)
     csv = str(tmp_path / "data.csv")
     np.savetxt(csv, data, delimiter=",", fmt="%.6f")
+    # same matrix for the npy / dense-svmlight shard-local loaders (the
+    # worker loads all three collective-free and cross-checks them)
+    parsed0 = np.loadtxt(csv, delimiter=",", dtype=np.float32, ndmin=2)
+    np.save(csv + ".npy", parsed0)
+    with open(csv + ".svm", "w") as f:
+        for i, row in enumerate(parsed0):
+            feats = " ".join(f"{j + 1}:{v:.6f}"
+                             for j, v in enumerate(row) if v != 0)
+            f.write(f"{i % 2} {feats}\n")
     out = str(tmp_path / "result.json")
     port = _free_port()
 

@@ -2,11 +2,16 @@
 
 Usage:
     python bench.py | tee /tmp/bench.jsonl
-    python tools/fill_baseline.py /tmp/bench.jsonl [hardware-label]
+    python tools/fill_baseline.py /tmp/bench.jsonl [hardware-label] [peak-tflops]
 
 Replaces the benchmark-matrix table wholesale with the measured rows
-(value + vs_baseline against the NumPy single-node proxy, labeled as BASELINE.md's
-measurement rules require), keeping the prose around it untouched.
+(value + vs_baseline against the NumPy single-node proxy, labeled as
+BASELINE.md's measurement rules require), keeping the prose around it
+untouched.  Matmul rows additionally get an MFU column: GFLOPS / (peak
+TFLOP/s × 1000), against the per-chip peak for the matmul's input dtype
+— pass the right peak for the hardware actually used (default 197, TPU
+v5e bf16; the f32 row's MFU is then vs the bf16 peak and understates a
+true-f32 ceiling, which the column header states).
 """
 
 import json
@@ -16,21 +21,25 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# bench metric prefix → (BASELINE.md row name, config text)
+# bench metric prefix → (BASELINE.md row name, config text, is_matmul)
 ROWS = [
-    ("kmeans_10000x100_k8", "KMeans", "k=8, 10000×100 ds-array"),
-    ("matmul_4096", "Blocked matmul", "4096×4096 @ 4096×4096"),
-    ("tsqr_65536x256", "tsQR", "65536×256 tall-skinny"),
-    ("randomsvd_32768x1024", "RandomizedSVD", "32768×1024, nsv=64"),
-    ("gmm_1000000x50", "GaussianMixture EM", "1M×50, k=16, 5 iter"),
-    ("matmul_16384", "Matmul north star ★", "16384×16384"),
-    ("kmeans_1Mx100_k10", "KMeans north star ★", "1M×100, k=10"),
+    ("kmeans_10000x100_k8", "KMeans", "k=8, 10000×100 ds-array", False),
+    ("matmul_4096", "Blocked matmul (f32)", "4096×4096 @ 4096×4096", True),
+    ("tsqr_65536x256", "tsQR", "65536×256 tall-skinny", False),
+    ("randomsvd_32768x1024", "RandomizedSVD", "32768×1024, nsv=64", False),
+    ("gmm_1000000x50", "GaussianMixture EM", "1M×50, k=16, 5 iter", False),
+    ("matmul_16384_f32", "Matmul north star ★ (f32)", "16384×16384", True),
+    ("matmul_16384_bf16", "Matmul north star ★ (bf16)", "16384×16384", True),
+    ("kmeans_1Mx100_k10_fastdist", "KMeans ★ (bf16 assignment)",
+     "1M×100, k=10", False),
+    ("kmeans_1Mx100_k10_iter", "KMeans north star ★", "1M×100, k=10", False),
 ]
 
 
 def main():
     jsonl = sys.argv[1]
     hw = sys.argv[2] if len(sys.argv) > 2 else "TPU v5e (1 chip, axon)"
+    peak_tflops = float(sys.argv[3]) if len(sys.argv) > 3 else 197.0
     results = {}
     with open(jsonl) as f:
         for line in f:
@@ -40,20 +49,25 @@ def main():
             rec = json.loads(line)
             results[rec["metric"].split(" ")[0]] = rec
 
-    out_rows = ["| Workload | Config | Measured | Unit | vs NumPy proxy | Hardware |",
-                "|---|---|---|---|---|---|"]
-    for prefix, name, cfg in ROWS:
+    out_rows = [f"| Workload | Config | Measured | Unit | vs NumPy proxy | "
+                f"MFU (vs {peak_tflops:.0f} TF/s peak) | Hardware |",
+                "|---|---|---|---|---|---|---|"]
+    for prefix, name, cfg, is_matmul in ROWS:
         rec = next((r for k, r in results.items() if k.startswith(prefix)),
                    None)
         if rec is None:
-            out_rows.append(f"| {name} | {cfg} | (not run) | — | — | {hw} |")
+            out_rows.append(f"| {name} | {cfg} | (not run) | — | — | — "
+                            f"| {hw} |")
         elif rec.get("error"):
             out_rows.append(f"| {name} | {cfg} | ERROR: "
-                            f"{rec['error'][:60]} | — | — | {hw} |")
+                            f"{rec['error'][:60]} | — | — | — | {hw} |")
         else:
+            mfu = "—"
+            if is_matmul:
+                mfu = f"{100.0 * rec['value'] / (peak_tflops * 1000):.1f}%"
             out_rows.append(
                 f"| {name} | {cfg} | {rec['value']} | {rec['unit']} | "
-                f"{rec['vs_baseline']}× | {hw} |")
+                f"{rec['vs_baseline']}× | {mfu} | {hw} |")
 
     path = os.path.join(ROOT, "BASELINE.md")
     text = open(path).read()
