@@ -203,7 +203,9 @@ class _BaseTreeEnsemble(BaseEstimator):
             return max(1, n // 3)
         return max(1, int(tf))
 
-    def _fit_forest(self, x: Array, stats_host, n_trees, bootstrap):
+    def _grow_forest(self, x: Array, stats_host, n_trees, bootstrap):
+        """Dispatch the whole forest growth as device programs — no host
+        read (the async-fit half; `_adopt_forest` materialises attrs)."""
         m, n = x.shape
         depth = self._effective_depth(m)
         seed = self.random_state if self.random_state is not None else \
@@ -237,24 +239,54 @@ class _BaseTreeEnsemble(BaseEstimator):
             tbins.append(tbin)
 
         leaves = _leaf_stats(node, w, stats, 2 ** depth)
-        self._edges = edges
-        # pad the ragged per-level (T, 2^lvl) arrays to (T, depth, 2^(depth-1))
-        # once here, so predict calls are a single gather-walk jit.  Done in
-        # NumPy on host: the arrays are tiny and this avoids ~2·depth one-off
-        # eagerly-dispatched pad/stack programs per fit.
+        # pad the ragged per-level (T, 2^lvl) arrays to (T, depth,
+        # 2^(depth-1)) so predict calls are a single gather-walk jit; done
+        # with device ops (tiny arrays) so growth stays read-free
         wide = 2 ** (depth - 1)
 
         def _pack(levels):
-            host = [np.asarray(jax.device_get(a)) for a in levels]
-            return np.stack([np.pad(a, ((0, 0), (0, wide - a.shape[1])))
-                             for a in host], axis=1)
+            return jnp.stack([jnp.pad(a, ((0, 0), (0, wide - a.shape[1])))
+                              for a in levels], axis=1)
 
-        self._feats = _pack(feats)
-        self._tbins = _pack(tbins)
-        self._depth = depth
-        self._leaves = leaves                          # (T, 2^depth, S)
-        self.n_features_ = n
+        return {"edges": edges, "feats": _pack(feats),
+                "tbins": _pack(tbins), "depth": depth, "leaves": leaves,
+                "n_features": n}
+
+    def _adopt_forest(self, grown):
+        """Materialise fitted attributes from a `_grow_forest` handle."""
+        self._edges = grown["edges"]
+        self._feats = np.asarray(jax.device_get(grown["feats"]))
+        self._tbins = np.asarray(jax.device_get(grown["tbins"]))
+        self._depth = grown["depth"]
+        self._leaves = grown["leaves"]                 # (T, 2^depth, S)
+        self.n_features_ = grown["n_features"]
         return self
+
+    def _fit_forest(self, x: Array, stats_host, n_trees, bootstrap):
+        return self._adopt_forest(
+            self._grow_forest(x, stats_host, n_trees, bootstrap))
+
+    def fit(self, x: Array, y: Array):
+        """Shared fit: encode targets (mixin), grow per `_fit_spec`
+        (concrete class), adopt."""
+        stats = self._encode_stats(x, y)
+        n_trees, bootstrap = self._fit_spec()
+        return self._fit_forest(x, stats, n_trees, bootstrap)
+
+    # async trial protocol (SURVEY §4.5): growth is read-free device
+    # dispatch; the handle is the grown-forest dict.  Label/target encoding
+    # reads the INPUT y (prep, not fit results) at dispatch time.
+    def _fit_async(self, x, y=None):
+        if y is None:
+            raise ValueError(f"{type(self).__name__} requires y")
+        stats = self._encode_stats(x, y)
+        n_trees, bootstrap = self._fit_spec()
+        return self._grow_forest(x, stats, n_trees, bootstrap)
+
+    def _fit_finalize(self, state):
+        if state is None:
+            return
+        self._adopt_forest(state)
 
     def _apply(self, x: Array):
         return _forest_apply(x._data, x.shape, jnp.asarray(self._edges),

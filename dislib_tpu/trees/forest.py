@@ -6,12 +6,34 @@ label handling and voting."""
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from dislib_tpu.data.array import Array, _repad
-from dislib_tpu.trees.decision_tree import _BaseTreeEnsemble
+from dislib_tpu.trees.decision_tree import _BaseTreeEnsemble, _forest_apply
+
+
+def _cls_enc(counts, hard):
+    """Winning class code per query from per-tree leaf counts (T, m, K) —
+    the single vote implementation shared by predict and the async score
+    kernel (they must never diverge)."""
+    if hard:
+        votes = jnp.argmax(counts, axis=2)                  # (T, m)
+        tally = jax.nn.one_hot(votes, counts.shape[2]).sum(axis=0)
+        return jnp.argmax(tally, axis=1)
+    probs = counts / jnp.maximum(
+        jnp.sum(counts, axis=2, keepdims=True), 1e-12)
+    return jnp.argmax(jnp.mean(probs, axis=0), axis=1)
+
+
+def _reg_mean(stats):
+    """Forest-mean prediction from per-tree leaf [w, wy, wy²] stats."""
+    return jnp.mean(stats[:, :, 1] / jnp.maximum(stats[:, :, 0], 1e-12),
+                    axis=0)
 
 
 class _ClassifierMixin:
@@ -43,15 +65,7 @@ class _ClassifierMixin:
         self._check_fitted()
         leaf = self._apply(x)
         counts = jnp.take_along_axis(self._leaves, leaf[:, :, None], axis=1)
-        if getattr(self, "hard_vote", False):
-            votes = jnp.argmax(counts, axis=2)              # (T, mq_pad)
-            k = len(self.classes_)
-            tally = jax.nn.one_hot(votes, k).sum(axis=0)
-            enc = jnp.argmax(tally, axis=1)
-        else:
-            probs = counts / jnp.maximum(
-                jnp.sum(counts, axis=2, keepdims=True), 1e-12)
-            enc = jnp.argmax(jnp.mean(probs, axis=0), axis=1)
+        enc = _cls_enc(counts, getattr(self, "hard_vote", False))
         labels = self.classes_[np.asarray(jax.device_get(enc))[: x.shape[0]]]
         # integer class values stay integral (int32 is exact to 2^31;
         # float32 corrupts labels past 2^24 — VERDICT r1 weak #8)
@@ -64,6 +78,18 @@ class _ClassifierMixin:
         pred = self.predict(x).collect().ravel()
         truth = np.asarray(y.collect()).ravel()
         return float(np.mean(pred == truth))
+
+    _encode_stats = _encode_labels
+
+    def _score_async(self, state, x, y=None):
+        if state is None or y is None:
+            return super()._score_async(state, x, y)
+        classes_dev = jnp.asarray(np.asarray(self.classes_),
+                                  dtype=y._data.dtype)
+        return _cls_score_kernel(
+            x._data, x.shape, jnp.asarray(state["edges"]), state["feats"],
+            state["tbins"], state["depth"], state["leaves"], classes_dev,
+            bool(getattr(self, "hard_vote", False)), y._data, x.shape[0])
 
 
 class _RegressorMixin:
@@ -82,8 +108,7 @@ class _RegressorMixin:
         self._check_fitted()
         leaf = self._apply(x)                               # (T, mq_pad)
         stats = jnp.take_along_axis(self._leaves, leaf[:, :, None], axis=1)
-        mean = stats[:, :, 1] / jnp.maximum(stats[:, :, 0], 1e-12)
-        pred = jnp.mean(mean, axis=0)[:, None]              # (mq_pad, 1)
+        pred = _reg_mean(stats)[:, None]                    # (mq_pad, 1)
         return Array._from_logical_padded(
             _repad(pred[: x.shape[0]], (x.shape[0], 1)), (x.shape[0], 1))
 
@@ -94,6 +119,16 @@ class _RegressorMixin:
         ss_res = float(np.sum((truth - pred) ** 2))
         ss_tot = float(np.sum((truth - truth.mean()) ** 2))
         return 1.0 - ss_res / max(ss_tot, 1e-12)
+
+    _encode_stats = _encode_targets
+
+    def _score_async(self, state, x, y=None):
+        if state is None or y is None:
+            return super()._score_async(state, x, y)
+        return _reg_score_kernel(
+            x._data, x.shape, jnp.asarray(state["edges"]), state["feats"],
+            state["tbins"], state["depth"], state["leaves"], y._data,
+            x.shape[0])
 
 
 class RandomForestClassifier(_ClassifierMixin, _BaseTreeEnsemble):
@@ -121,9 +156,8 @@ class RandomForestClassifier(_ClassifierMixin, _BaseTreeEnsemble):
         self.hard_vote = hard_vote
         self.random_state = random_state
 
-    def fit(self, x: Array, y: Array):
-        stats = self._encode_labels(x, y)
-        return self._fit_forest(x, stats, self.n_estimators, bootstrap=True)
+    def _fit_spec(self):
+        return self.n_estimators, True
 
 
 class RandomForestRegressor(_RegressorMixin, _BaseTreeEnsemble):
@@ -141,9 +175,8 @@ class RandomForestRegressor(_RegressorMixin, _BaseTreeEnsemble):
         self.sklearn_max = sklearn_max
         self.random_state = random_state
 
-    def fit(self, x: Array, y: Array):
-        stats = self._encode_targets(x, y)
-        return self._fit_forest(x, stats, self.n_estimators, bootstrap=True)
+    def _fit_spec(self):
+        return self.n_estimators, True
 
 
 class DecisionTreeClassifier(_ClassifierMixin, _BaseTreeEnsemble):
@@ -154,9 +187,8 @@ class DecisionTreeClassifier(_ClassifierMixin, _BaseTreeEnsemble):
         self.try_features = try_features
         self.random_state = random_state
 
-    def fit(self, x: Array, y: Array):
-        stats = self._encode_labels(x, y)
-        return self._fit_forest(x, stats, 1, bootstrap=False)
+    def _fit_spec(self):
+        return 1, False
 
 
 class DecisionTreeRegressor(_RegressorMixin, _BaseTreeEnsemble):
@@ -167,6 +199,37 @@ class DecisionTreeRegressor(_RegressorMixin, _BaseTreeEnsemble):
         self.try_features = try_features
         self.random_state = random_state
 
-    def fit(self, x: Array, y: Array):
-        stats = self._encode_targets(x, y)
-        return self._fit_forest(x, stats, 1, bootstrap=False)
+    def _fit_spec(self):
+        return 1, False
+
+
+# ---------------------------------------------------------------------------
+# device scoring kernels for the async trial protocol (SURVEY §4.5)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("shape", "depth", "hard", "mq"))
+def _cls_score_kernel(xp, shape, edges, feats, tbins, depth, leaves,
+                      classes_dev, hard, yp, mq):
+    """Device accuracy of a grown classification forest: apply + the shared
+    `_cls_enc` vote, scored by knn's `_score_codes` (labels compared in
+    y's backing dtype — collision-free)."""
+    from dislib_tpu.classification.knn import _score_codes
+    leaf = _forest_apply(xp, shape, edges, feats, tbins, depth)
+    counts = jnp.take_along_axis(leaves, leaf[:, :, None], axis=1)
+    enc = _cls_enc(counts, hard).astype(jnp.int32)
+    return _score_codes(enc[:, None], yp, classes_dev, mq)
+
+
+@partial(jax.jit, static_argnames=("shape", "depth", "mq"))
+def _reg_score_kernel(xp, shape, edges, feats, tbins, depth, leaves, yp, mq):
+    """Device R² of a grown regression forest."""
+    leaf = _forest_apply(xp, shape, edges, feats, tbins, depth)
+    stats = jnp.take_along_axis(leaves, leaf[:, :, None], axis=1)
+    pred = _reg_mean(stats)                                 # (mq_pad,)
+    yv = yp[: pred.shape[0], 0]
+    w = (lax.broadcasted_iota(jnp.int32, (pred.shape[0],), 0) < mq) \
+        .astype(yv.dtype)
+    resid = jnp.sum(((yv - pred) * w) ** 2)
+    ymean = jnp.sum(yv * w) / mq
+    total = jnp.sum(((yv - ymean) * w) ** 2)
+    return 1.0 - resid / jnp.maximum(total, 1e-12)

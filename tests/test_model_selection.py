@@ -252,6 +252,41 @@ class TestAsyncAdoption:
         y_unseen = ds.array(np.full_like(y, 99.0))
         assert float(est._score_async(state, ds.array(x), y_unseen)) == 0.0
 
+    def test_forest_async_matches_sync(self, rng):
+        from dislib_tpu.trees import (RandomForestClassifier,
+                                      RandomForestRegressor)
+        x, y = _blobs(rng, n=90, k=3)
+        perm = rng.permutation(len(x))
+        xa, ya = ds.array(x[perm]), ds.array(y[perm])
+        est = RandomForestClassifier(n_estimators=4, random_state=0)
+        state = est._fit_async(xa, ya)
+        dev = float(est._score_async(state, xa, ya))
+        est._fit_finalize(state)
+        assert np.isclose(dev, est.score(xa, ya), rtol=1e-6)
+        # same-seed sync fit lands on identical trees
+        sync = RandomForestClassifier(n_estimators=4, random_state=0) \
+            .fit(xa, ya)
+        np.testing.assert_array_equal(est._feats, sync._feats)
+
+        xr = rng.rand(80, 3).astype(np.float32)
+        yr = (xr @ np.array([1.0, -2.0, 0.5])).astype(np.float32)[:, None]
+        reg = RandomForestRegressor(n_estimators=4, random_state=0)
+        st = reg._fit_async(ds.array(xr), ds.array(yr))
+        dev_r2 = float(reg._score_async(st, ds.array(xr), ds.array(yr)))
+        reg._fit_finalize(st)
+        assert np.isclose(dev_r2, reg.score(ds.array(xr), ds.array(yr)),
+                          rtol=1e-4, atol=1e-5)
+
+    def test_forest_grid_search_async_dispatch(self, rng):
+        from dislib_tpu.trees import RandomForestClassifier
+        x, y = _blobs(rng, n=90, k=3)
+        perm = rng.permutation(len(x))
+        gs = GridSearchCV(RandomForestClassifier(random_state=0),
+                          {"n_estimators": [2, 4]}, cv=2, refit=False)
+        gs.fit(ds.array(x[perm]), ds.array(y[perm]))
+        assert len(gs.cv_results_["params"]) == 2
+        assert gs.best_score_ > 0.8
+
     def test_fallback_notice_logged_once(self, rng, caplog):
         import logging
         from dislib_tpu.base import BaseEstimator
