@@ -3,10 +3,12 @@ sklearn-mirroring GridSearchCV / RandomizedSearchCV that submit ALL candidate
 fits before waiting on any, so search-level parallelism multiplies
 estimator-internal parallelism; SURVEY.md §3.4, §4.5).
 
-TPU-native concurrency contract: within each fold, every candidate's fit is
-dispatched through the estimator's `_fit_async` protocol (device handles,
-no host reads) BEFORE any score is read — JAX async dispatch then pipelines
-the trials' device programs back-to-back.  Estimators without an async path
+TPU-native concurrency contract: every candidate's fit is dispatched
+through the estimator's `_fit_async` protocol (device handles, no host
+reads) BEFORE any score is read, and folds are pipelined two-deep — fold
+f's host reads happen only after fold f+1's programs are dispatched — so
+JAX async dispatch pipelines the trials' device programs back-to-back
+across the whole search while memory stays bounded at two folds.  Estimators without an async path
 fall back to synchronous fit inside the dispatch loop (their device work
 still overlaps; only their own convergence-scalar reads serialise).
 Scoring accepts the estimator's `score`, a callable, or a scorer string
@@ -103,13 +105,16 @@ class GridSearchCV(BaseEstimator):
         n_folds = cv.get_n_splits()
         scorer = _resolve_scorer(self.scoring)
 
-        # fold-major loop: only ONE fold's train/validation copies are device-
-        # resident at a time (fold f is released before f+1 materializes),
-        # bounding memory to one fold regardless of cv or candidate count.
-        # Within a fold: dispatch ALL fits, then ALL scores, and only then
-        # read any value back (SURVEY §4.5 "no artificial serialization").
+        # fold-pipelined loop: at most TWO folds' train/validation copies
+        # are device-resident at a time, bounding memory regardless of cv
+        # or candidate count, while fold f's host reads happen only AFTER
+        # fold f+1's fits and scores are dispatched — the reference's
+        # submit-all-before-wait contract holds across folds as well as
+        # across candidates (SURVEY §4.5 "no artificial serialization").
         all_scores = np.zeros((len(candidates), n_folds))
-        for fi, (xt, yt, xv, yv) in enumerate(cv.split(x, y)):
+
+        def _dispatch_fold(fold):
+            xt, yt, xv, yv = fold
             pend = []
             for ci, params in enumerate(candidates):
                 est = clone(self.estimator).set_params(**params)
@@ -123,8 +128,19 @@ class GridSearchCV(BaseEstimator):
                 else:
                     est._fit_finalize(state)
                     vals.append((ci, scorer(est, xv, yv)))
-            for ci, v in vals:            # single host sync point per fold
-                all_scores[ci, fi] = float(v)
+            return vals
+
+        prev = None                       # (fold_index, pending device scores)
+        for fi, fold in enumerate(cv.split(x, y)):
+            vals = _dispatch_fold(fold)
+            if prev is not None:
+                pfi, pvals = prev
+                for ci, v in pvals:       # host sync for fold f-1 only now
+                    all_scores[ci, pfi] = float(v)
+            prev = (fi, vals)
+        pfi, pvals = prev
+        for ci, v in pvals:
+            all_scores[ci, pfi] = float(v)
 
         mean = all_scores.mean(axis=1)
         std = all_scores.std(axis=1)

@@ -262,20 +262,17 @@ class _BaseTreeEnsemble(BaseEstimator):
         self.n_features_ = grown["n_features"]
         return self
 
-    def _fit_forest(self, x: Array, stats_host, n_trees, bootstrap):
-        return self._adopt_forest(
-            self._grow_forest(x, stats_host, n_trees, bootstrap))
-
     def fit(self, x: Array, y: Array):
-        """Shared fit: encode targets (mixin), grow per `_fit_spec`
-        (concrete class), adopt."""
-        stats = self._encode_stats(x, y)
-        n_trees, bootstrap = self._fit_spec()
-        return self._fit_forest(x, stats, n_trees, bootstrap)
+        """Shared fit = the async protocol run to completion (one recipe —
+        sync and async fits cannot diverge)."""
+        self._fit_finalize(self._fit_async(x, y))
+        return self
 
     # async trial protocol (SURVEY §4.5): growth is read-free device
     # dispatch; the handle is the grown-forest dict.  Label/target encoding
-    # reads the INPUT y (prep, not fit results) at dispatch time.
+    # reads the INPUT y (prep, not fit results) at dispatch time, cached
+    # per (y, padding) so a search encodes each fold once, not once per
+    # candidate.
     def _fit_async(self, x, y=None):
         if y is None:
             raise ValueError(f"{type(self).__name__} requires y")

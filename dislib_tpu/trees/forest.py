@@ -40,13 +40,21 @@ class _ClassifierMixin:
     _criterion = "gini"
 
     def _encode_labels(self, x: Array, y: Array):
+        # cached on the y Array per (kind, padding): a grid search encodes
+        # each fold once, not once per candidate (the encode is a full
+        # y.collect() — a DCN allgather on multi-host)
+        mp = x._data.shape[0]
+        cached = getattr(y, "_tree_enc_cache", None)
+        if cached is not None and cached[0] == ("cls", mp):
+            self.classes_ = cached[1]
+            return cached[2]
         y_host = np.asarray(y.collect()).ravel()
         self.classes_ = np.unique(y_host)
         enc = np.searchsorted(self.classes_, y_host)
         k = len(self.classes_)
-        mp = x._data.shape[0]
         onehot = np.zeros((mp, k), np.float32)
         onehot[np.arange(len(enc)), enc] = 1.0
+        y._tree_enc_cache = (("cls", mp), self.classes_, onehot)
         return onehot
 
     def predict_proba(self, x: Array) -> Array:
@@ -96,12 +104,16 @@ class _RegressorMixin:
     _criterion = "mse"
 
     def _encode_targets(self, x: Array, y: Array):
-        y_host = np.asarray(y.collect()).ravel().astype(np.float32)
         mp = x._data.shape[0]
+        cached = getattr(y, "_tree_enc_cache", None)
+        if cached is not None and cached[0] == ("reg", mp):
+            return cached[1]
+        y_host = np.asarray(y.collect()).ravel().astype(np.float32)
         stats = np.zeros((mp, 3), np.float32)               # [w, wy, wy²] basis
         stats[: len(y_host), 0] = 1.0
         stats[: len(y_host), 1] = y_host
         stats[: len(y_host), 2] = y_host * y_host
+        y._tree_enc_cache = (("reg", mp), stats)
         return stats
 
     def predict(self, x: Array) -> Array:

@@ -252,6 +252,37 @@ class TestAsyncAdoption:
         y_unseen = ds.array(np.full_like(y, 99.0))
         assert float(est._score_async(state, ds.array(x), y_unseen)) == 0.0
 
+    def test_folds_pipeline_two_deep(self, rng, monkeypatch):
+        """Fold f's host reads happen only after fold f+1's dispatch —
+        the submit-before-wait contract across folds, memory-bounded."""
+        events = []
+        orig_fit, orig_score = KMeans._fit_async, KMeans._score_async
+
+        class _ReadLogged:
+            def __init__(self, v):
+                self.v = v
+
+            def __float__(self):
+                events.append("read")
+                return float(self.v)
+
+        def spy_fit(self, x, y=None):
+            events.append("fit")
+            return orig_fit(self, x, y)
+
+        def spy_score(self, state, x, y=None):
+            return _ReadLogged(orig_score(self, state, x, y))
+
+        monkeypatch.setattr(KMeans, "_fit_async", spy_fit)
+        monkeypatch.setattr(KMeans, "_score_async", spy_score)
+        x = ds.array(rng.rand(90, 3).astype(np.float32))
+        GridSearchCV(KMeans(random_state=0, max_iter=3),
+                     {"n_clusters": [2, 3]}, cv=3, refit=False).fit(x)
+        # 3 folds × 2 candidates: fold0 fits, fold1 fits, fold0 reads,
+        # fold2 fits, fold1 reads, fold2 reads
+        assert events == (["fit"] * 2 + ["fit"] * 2 + ["read"] * 2
+                          + ["fit"] * 2 + ["read"] * 2 + ["read"] * 2)
+
     def test_forest_async_matches_sync(self, rng):
         from dislib_tpu.trees import (RandomForestClassifier,
                                       RandomForestRegressor)
