@@ -10,6 +10,23 @@ run on-device in ``lax.while_loop``.
 Public API parity contract: SURVEY.md §8 "API parity contract".
 """
 
+import os as _os
+
+# XLA:CPU aborts the process when a collective participant waits >40 s
+# (rendezvous terminate timeout).  On constrained hosts — this build's CI
+# rig runs 8 virtual devices on ONE core — a long compile or any co-tenant
+# load can legitimately stall a participant that long, turning a slow
+# moment into a hard crash.  Raise the abort threshold well past plausible
+# stalls (the warn log stays early).  Must be in XLA_FLAGS before the
+# backend initialises, hence at import; inert for TPU execution.
+for _flag, _default in (
+        ("xla_cpu_collective_call_terminate_timeout_seconds", 600),
+        ("xla_cpu_collective_call_warn_stuck_timeout_seconds", 60)):
+    if _flag not in _os.environ.get("XLA_FLAGS", ""):
+        _os.environ["XLA_FLAGS"] = (
+            _os.environ.get("XLA_FLAGS", "")
+            + f" --{_flag}={_default}").strip()
+
 from dislib_tpu.parallel.mesh import init, get_mesh, set_mesh
 from dislib_tpu.data.array import (
     Array, array, random_array, zeros, full, ones, identity, eye,

@@ -26,6 +26,36 @@ from dislib_tpu.base import BaseEstimator, clone
 from dislib_tpu.model_selection.split import KFold
 
 
+#: Concurrency policy, None = auto by backend.  On TPU, dispatched programs
+#: execute strictly in order per core, so the search keeps everything in
+#: flight (fold pipelining ON, no throttle).  XLA:CPU instead runs multiple
+#: multi-device programs concurrently on one shared thread pool; enough
+#: in-flight collective programs starve an all-reduce rendezvous into its
+#: 40 s termination timeout and ABORT the process (reproduced with the
+#: forest search fanning out ~50 collective programs on the 8-virtual-device
+#: rig; `jax_cpu_enable_async_dispatch=False` does not prevent it on
+#: jax 0.9).  The cpu auto policy therefore blocks each trial's dispatched
+#: state before dispatching the next — the rig is for correctness, and its
+#: "devices" share one machine, so nothing real is lost.  True/False force
+#: pipelining; the throttle is the negation of the same switch.
+_PIPELINE_FOLDS = None
+
+
+def _pipeline_folds():
+    if _PIPELINE_FOLDS is not None:
+        return _PIPELINE_FOLDS
+    import jax
+    return jax.default_backend() != "cpu"
+
+
+def _block_tree(state):
+    """Block on every blockable leaf of an async-state handle (cpu throttle)."""
+    import jax
+    for leaf in jax.tree_util.tree_leaves(state):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
 def _score(est, xv, yv):
     if hasattr(est, "score"):
         return est.score(xv, yv) if yv is not None else est.score(xv)
@@ -113,6 +143,8 @@ class GridSearchCV(BaseEstimator):
         # across candidates (SURVEY §4.5 "no artificial serialization").
         all_scores = np.zeros((len(candidates), n_folds))
 
+        throttle = not _pipeline_folds()   # cpu rig: bound in-flight programs
+
         def _dispatch_fold(fold):
             xt, yt, xv, yv = fold
             pend = []
@@ -120,16 +152,22 @@ class GridSearchCV(BaseEstimator):
                 est = clone(self.estimator).set_params(**params)
                 state = est._fit_async(xt, yt) if yt is not None \
                     else est._fit_async(xt)
+                if throttle:
+                    _block_tree(state)
                 pend.append((ci, est, state))
             vals = []
             for ci, est, state in pend:
                 if scorer is None:
-                    vals.append((ci, est._score_async(state, xv, yv)))
+                    v = est._score_async(state, xv, yv)
+                    if throttle and hasattr(v, "block_until_ready"):
+                        v.block_until_ready()
+                    vals.append((ci, v))
                 else:
                     est._fit_finalize(state)
                     vals.append((ci, scorer(est, xv, yv)))
             return vals
 
+        pipelined = _pipeline_folds()
         prev = None                       # (fold_index, pending device scores)
         for fi, fold in enumerate(cv.split(x, y)):
             vals = _dispatch_fold(fold)
@@ -137,10 +175,15 @@ class GridSearchCV(BaseEstimator):
                 pfi, pvals = prev
                 for ci, v in pvals:       # host sync for fold f-1 only now
                     all_scores[ci, pfi] = float(v)
-            prev = (fi, vals)
-        pfi, pvals = prev
-        for ci, v in pvals:
-            all_scores[ci, pfi] = float(v)
+            if pipelined:
+                prev = (fi, vals)
+            else:                         # cpu backend: read before fold f+1
+                for ci, v in vals:
+                    all_scores[ci, fi] = float(v)
+        if prev is not None:
+            pfi, pvals = prev
+            for ci, v in pvals:
+                all_scores[ci, pfi] = float(v)
 
         mean = all_scores.mean(axis=1)
         std = all_scores.std(axis=1)

@@ -181,6 +181,15 @@ def _forest_apply(qp, q_shape, edges, feats, tbins, depth):
 # host-side tree builder shared by the estimators
 # ---------------------------------------------------------------------------
 
+def _pack_levels(levels, depth):
+    """Traced pad+stack of the ragged per-level (T, 2^lvl) arrays — call
+    INSIDE a jitted kernel only, where it fuses into the one program (see
+    _grow_forest on why an eager pack is a deadlock hazard)."""
+    wide = 2 ** (depth - 1)
+    return jnp.stack([jnp.pad(a, ((0, 0), (0, wide - a.shape[1])))
+                      for a in levels], axis=1)
+
+
 class _BaseTreeEnsemble(BaseEstimator):
     """Shared fit/apply machinery; subclasses set `_criterion` and predictions."""
 
@@ -239,24 +248,30 @@ class _BaseTreeEnsemble(BaseEstimator):
             tbins.append(tbin)
 
         leaves = _leaf_stats(node, w, stats, 2 ** depth)
-        # pad the ragged per-level (T, 2^lvl) arrays to (T, depth,
-        # 2^(depth-1)) so predict calls are a single gather-walk jit; done
-        # with device ops (tiny arrays) so growth stays read-free
-        wide = 2 ** (depth - 1)
-
-        def _pack(levels):
-            return jnp.stack([jnp.pad(a, ((0, 0), (0, wide - a.shape[1])))
-                              for a in levels], axis=1)
-
-        return {"edges": edges, "feats": _pack(feats),
-                "tbins": _pack(tbins), "depth": depth, "leaves": leaves,
-                "n_features": n}
+        # feats/tbins stay as the ragged per-level device arrays: packing
+        # here would dispatch eager multi-device pad/stack programs while
+        # the level producers are still in flight — on a thread-starved
+        # XLA:CPU pool their parked rendezvous participants can starve the
+        # producers into a true deadlock (observed round 3).  The pack
+        # happens on host at adoption, or traced INSIDE the score kernels.
+        return {"edges": edges, "feats": tuple(feats), "tbins": tuple(tbins),
+                "depth": depth, "leaves": leaves, "n_features": n}
 
     def _adopt_forest(self, grown):
-        """Materialise fitted attributes from a `_grow_forest` handle."""
+        """Materialise fitted attributes from a `_grow_forest` handle.
+        The ragged per-level (T, 2^lvl) arrays pad+stack to (T, depth,
+        2^(depth-1)) in host NumPy — tiny arrays, and no extra device
+        programs — so predict calls are a single gather-walk jit."""
+        wide = 2 ** (grown["depth"] - 1)
+
+        def _pack(levels):
+            host = [np.asarray(jax.device_get(a)) for a in levels]
+            return np.stack([np.pad(a, ((0, 0), (0, wide - a.shape[1])))
+                             for a in host], axis=1)
+
         self._edges = grown["edges"]
-        self._feats = np.asarray(jax.device_get(grown["feats"]))
-        self._tbins = np.asarray(jax.device_get(grown["tbins"]))
+        self._feats = _pack(grown["feats"])
+        self._tbins = _pack(grown["tbins"])
         self._depth = grown["depth"]
         self._leaves = grown["leaves"]                 # (T, 2^depth, S)
         self.n_features_ = grown["n_features"]

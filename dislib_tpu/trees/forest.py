@@ -14,7 +14,8 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.data.array import Array, _repad
-from dislib_tpu.trees.decision_tree import _BaseTreeEnsemble, _forest_apply
+from dislib_tpu.trees.decision_tree import (_BaseTreeEnsemble,
+                                            _forest_apply, _pack_levels)
 
 
 def _cls_enc(counts, hard):
@@ -226,7 +227,8 @@ def _cls_score_kernel(xp, shape, edges, feats, tbins, depth, leaves,
     `_cls_enc` vote, scored by knn's `_score_codes` (labels compared in
     y's backing dtype — collision-free)."""
     from dislib_tpu.classification.knn import _score_codes
-    leaf = _forest_apply(xp, shape, edges, feats, tbins, depth)
+    leaf = _forest_apply(xp, shape, edges, _pack_levels(feats, depth),
+                         _pack_levels(tbins, depth), depth)
     counts = jnp.take_along_axis(leaves, leaf[:, :, None], axis=1)
     enc = _cls_enc(counts, hard).astype(jnp.int32)
     return _score_codes(enc[:, None], yp, classes_dev, mq)
@@ -235,7 +237,8 @@ def _cls_score_kernel(xp, shape, edges, feats, tbins, depth, leaves,
 @partial(jax.jit, static_argnames=("shape", "depth", "mq"))
 def _reg_score_kernel(xp, shape, edges, feats, tbins, depth, leaves, yp, mq):
     """Device R² of a grown regression forest."""
-    leaf = _forest_apply(xp, shape, edges, feats, tbins, depth)
+    leaf = _forest_apply(xp, shape, edges, _pack_levels(feats, depth),
+                         _pack_levels(tbins, depth), depth)
     stats = jnp.take_along_axis(leaves, leaf[:, :, None], axis=1)
     pred = _reg_mean(stats)                                 # (mq_pad,)
     yv = yp[: pred.shape[0], 0]

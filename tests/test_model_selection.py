@@ -254,7 +254,10 @@ class TestAsyncAdoption:
 
     def test_folds_pipeline_two_deep(self, rng, monkeypatch):
         """Fold f's host reads happen only after fold f+1's dispatch —
-        the submit-before-wait contract across folds, memory-bounded."""
+        the submit-before-wait contract across folds, memory-bounded.
+        Forced ON (auto disables it on the cpu backend rig)."""
+        import dislib_tpu.model_selection.search as search_mod
+        monkeypatch.setattr(search_mod, "_PIPELINE_FOLDS", True)
         events = []
         orig_fit, orig_score = KMeans._fit_async, KMeans._score_async
 
