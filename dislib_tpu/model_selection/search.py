@@ -8,7 +8,11 @@ through the estimator's `_fit_async` protocol (device handles, no host
 reads) BEFORE any score is read, and folds are pipelined two-deep — fold
 f's host reads happen only after fold f+1's programs are dispatched — so
 JAX async dispatch pipelines the trials' device programs back-to-back
-across the whole search while memory stays bounded at two folds.  Estimators without an async path
+across the whole search while memory stays bounded at two folds.
+Backend caveat: the pipelining above is the TPU behavior; on the cpu
+backend the auto policy (`_PIPELINE_FOLDS`, below) instead BLOCKS each
+trial's dispatched state before the next dispatch — see the policy
+comment for the XLA:CPU rendezvous-starvation rationale.  Estimators without an async path
 fall back to synchronous fit inside the dispatch loop (their device work
 still overlaps; only their own convergence-scalar reads serialise).
 Scoring accepts the estimator's `score`, a callable, or a scorer string
