@@ -100,3 +100,41 @@ def test_sparse_roundtrip_and_ops(seed, m, n):
     np.testing.assert_allclose(got, np.zeros_like(dense), atol=1e-6)
     np.testing.assert_allclose(np.asarray(xs.T.collect().toarray()), dense.T,
                                rtol=1e-6)
+
+
+@given(st.integers(0, 2**16), st.integers(1, 400), st.integers(1, 64),
+       st.floats(0.02, 0.9))
+@_settings
+def test_row_steps_invariants(seed, m, chunk, density):
+    """row_steps (kNN sparse streaming) invariants for arbitrary sparsity
+    patterns: steps partition [0, m) in order, every step respects the row
+    cap, every nonzero lands exactly once with correct local coordinates,
+    and the rectangle memory stays within the documented budget bound."""
+    import scipy.sparse as sp
+    from dislib_tpu.data.sparse import SparseArray
+    rng = np.random.RandomState(seed)
+    dense = (rng.rand(m, 8) < density).astype(np.float32) * rng.rand(m, 8)
+    xs = SparseArray.from_scipy(sp.csr_matrix(dense))
+    data, lrows, cols, row_off, rows_in = (np.asarray(a) for a in
+                                           xs.row_steps(chunk))
+    # partition: contiguous, ordered, covers all m rows exactly once
+    covered = 0
+    for ro, rc in zip(row_off, rows_in):
+        assert ro == covered
+        assert 0 <= rc <= chunk
+        covered += int(rc)
+    assert covered == m
+    # reconstruction: scatter every step back and compare
+    rebuilt = np.zeros_like(dense)
+    for s in range(data.shape[0]):
+        np.add.at(rebuilt, (row_off[s] + lrows[s], cols[s]), data[s])
+        assert (lrows[s] < max(1, rows_in[s])).all()
+    np.testing.assert_allclose(rebuilt, dense, rtol=1e-6)
+    # memory bound: the per-step nnz budget itself obeys the documented
+    # formula (4x the average chunk's nonzeros, floored at 64 and at the
+    # densest single row) — a regression to budget = O(densest chunk)
+    # would fail this
+    row_nnz = (dense != 0).sum(axis=1)
+    want = max(64, 4 * int(np.ceil(xs.nnz * chunk / max(m, 1))),
+               int(row_nnz.max(initial=1)))
+    assert data.shape[1] <= want
