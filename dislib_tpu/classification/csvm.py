@@ -86,7 +86,13 @@ class CascadeSVM(BaseEstimator):
             return 1.0 / n_features
         return float(self.gamma)
 
-    def fit(self, x: Array, y: Array):
+    def fit(self, x: Array, y: Array, checkpoint=None):
+        """Fit the cascade.  With ``checkpoint=FitCheckpoint(path, every=k)``
+        the global-iteration state (SV indices/alphas, objective, counter)
+        snapshots every k iterations; a re-run resumes from the snapshot and
+        lands on the uninterrupted run's model (each global iteration
+        depends only on the fed-back SV set and previous objective — SURVEY
+        §6 checkpoint/resume)."""
         if self.kernel not in ("rbf", "linear"):
             raise ValueError(f"unsupported kernel {self.kernel!r}")
         if self.max_iter < 1:
@@ -114,7 +120,31 @@ class CascadeSVM(BaseEstimator):
         last_w = None
         self.converged_ = False
         it = 0
-        for it in range(1, self.max_iter + 1):
+        # fingerprint of everything the fed-back SV state depends on — a
+        # same-row-count snapshot from different data/hyperparameters must
+        # not silently resume
+        fp = np.asarray([m, n, float(gamma), float(self.c),
+                         float(self.cascade_arity),
+                         float(("rbf", "linear").index(self.kernel))],
+                        np.float64)
+        if checkpoint is not None:
+            snap = checkpoint.load()
+            if snap is not None:
+                if "fp" not in snap or not np.array_equal(snap["fp"], fp):
+                    raise ValueError(
+                        "checkpoint does not match this data/estimator "
+                        "(samples, features, kernel, gamma, C or "
+                        "cascade_arity differ) — stale or foreign snapshot")
+                sv_idx = np.asarray(snap["sv_idx"], np.int64)
+                self._sv_alpha = np.asarray(snap["sv_alpha"], np.float32)
+                last_w = float(snap["last_w"])
+                it = int(snap["n_iter"])
+                self.converged_ = bool(snap["converged"])
+        start_it = it
+        for it in range(start_it + 1, self.max_iter + 1):
+            if self.converged_:
+                it = start_it
+                break
             if sv_idx is not None and len(sv_idx):
                 # feed global SVs back into every level-0 partition
                 # (dedupe: a partition may already own some of them)
@@ -151,12 +181,23 @@ class CascadeSVM(BaseEstimator):
             from dislib_tpu.utils.dlog import verbose_logger
             verbose_logger("csvm", self.verbose).info(
                 "iter %d: W=%.6f, SVs=%d", it, w, len(sv_idx))
+            def _snap():
+                checkpoint.save({"sv_idx": np.asarray(sv_idx, np.int64),
+                                 "sv_alpha": self._sv_alpha,
+                                 "last_w": w, "n_iter": it, "fp": fp,
+                                 "converged": self.converged_})
+
             if self.check_convergence and last_w is not None:
                 if abs(w - last_w) <= self.tol * max(abs(w), 1e-12):
                     self.converged_ = True
                     last_w = w
+                    if checkpoint is not None:
+                        _snap()
                     break
             last_w = w
+            if checkpoint is not None and \
+                    (it - start_it) % checkpoint.every == 0:
+                _snap()
 
         self.iterations_n = self.n_iter_ = it
         self._sv_idx = sv_idx

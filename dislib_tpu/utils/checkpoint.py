@@ -5,7 +5,7 @@ The reference's fault tolerance is runtime-level (COMPSs resubmits failed
 tasks; `dislib/utils/saving.py` snapshots only *fitted* models).  On TPU a
 chip failure kills the whole SPMD job, so mid-fit checkpointing of the
 iteration state is first-class: iterative estimators (`KMeans`,
-`GaussianMixture`, `ALS`) accept ``checkpoint=FitCheckpoint(path, every=k)``
+`GaussianMixture`, `ALS`, `CascadeSVM`) accept ``checkpoint=FitCheckpoint(path, every=k)``
 and then run their device loop in k-iteration chunks, snapshotting the
 host-readable iteration state (centers / responsibilities stats / factors +
 iteration counter) after each chunk.  A re-run with the same checkpoint

@@ -110,3 +110,67 @@ class TestProfiling:
         import os
         found = [f for _, _, fs in os.walk(d) for f in fs]
         assert found, "profiler wrote no trace files"
+
+
+class TestCSVMCheckpoint:
+    """Round-3 widening: CascadeSVM global-iteration snapshot/resume."""
+
+    def _data(self, rng, n=120):
+        x = np.vstack([rng.randn(n // 2, 4) - 2,
+                       rng.randn(n // 2, 4) + 2]).astype(np.float32)
+        y = np.r_[np.zeros(n // 2), np.ones(n // 2)].astype(np.float32)
+        sh = rng.permutation(n)
+        return x[sh], y[sh].reshape(-1, 1)
+
+    def test_csvm_resume_equals_full(self, rng, tmp_path):
+        from dislib_tpu.classification import CascadeSVM
+        xh, yh = self._data(rng)
+        x, y = ds.array(xh), ds.array(yh)
+        kw = dict(cascade_arity=2, c=1.0, kernel="rbf", gamma=0.3,
+                  check_convergence=False)
+        full = CascadeSVM(max_iter=4, **kw).fit(x, y)
+
+        path = str(tmp_path / "csvm.npz")
+        CascadeSVM(max_iter=2, **kw).fit(
+            x, y, checkpoint=FitCheckpoint(path, every=1))
+        res = CascadeSVM(max_iter=4, **kw).fit(
+            x, y, checkpoint=FitCheckpoint(path, every=1))
+        assert res.n_iter_ == full.n_iter_
+        np.testing.assert_array_equal(res._sv_idx, full._sv_idx)
+        np.testing.assert_allclose(res._sv_alpha, full._sv_alpha, rtol=1e-5)
+        np.testing.assert_allclose(res.decision_function(x).collect(),
+                                   full.decision_function(x).collect(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_csvm_resume_of_converged_fit(self, rng, tmp_path):
+        from dislib_tpu.classification import CascadeSVM
+        xh, yh = self._data(rng, n=80)
+        x, y = ds.array(xh), ds.array(yh)
+        path = str(tmp_path / "csvm2.npz")
+        kw = dict(cascade_arity=2, kernel="linear", check_convergence=True,
+                  tol=1e-2)
+        first = CascadeSVM(max_iter=8, **kw).fit(
+            x, y, checkpoint=FitCheckpoint(path, every=1))
+        assert first.converged_
+        again = CascadeSVM(max_iter=8, **kw).fit(
+            x, y, checkpoint=FitCheckpoint(path, every=1))
+        assert again.converged_
+        np.testing.assert_array_equal(again._sv_idx, first._sv_idx)
+
+    def test_csvm_stale_checkpoint_raises(self, rng, tmp_path):
+        from dislib_tpu.classification import CascadeSVM
+        xh, yh = self._data(rng, n=80)
+        path = str(tmp_path / "csvm3.npz")
+        CascadeSVM(max_iter=1, check_convergence=False).fit(
+            ds.array(xh), ds.array(yh),
+            checkpoint=FitCheckpoint(path, every=1))
+        xs, ys = self._data(rng, n=40)
+        with pytest.raises(ValueError, match="stale or foreign"):
+            CascadeSVM(max_iter=2, check_convergence=False).fit(
+                ds.array(xs), ds.array(ys),
+                checkpoint=FitCheckpoint(path, every=1))
+        # same data shape but different hyperparameters must refuse too
+        with pytest.raises(ValueError, match="stale or foreign"):
+            CascadeSVM(max_iter=2, c=100.0, check_convergence=False).fit(
+                ds.array(xh), ds.array(yh),
+                checkpoint=FitCheckpoint(path, every=1))
