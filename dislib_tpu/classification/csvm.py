@@ -120,26 +120,40 @@ class CascadeSVM(BaseEstimator):
         last_w = None
         self.converged_ = False
         it = 0
-        # fingerprint of everything the fed-back SV state depends on — a
-        # same-row-count snapshot from different data/hyperparameters must
-        # not silently resume
-        fp = np.asarray([m, n, float(gamma), float(self.c),
-                         float(self.cascade_arity),
-                         float(("rbf", "linear").index(self.kernel))],
-                        np.float64)
+        fp = None
         if checkpoint is not None:
+            # fingerprint of everything the fed-back SV state depends on —
+            # shape, hyperparameters, level-0 partitioning AND data digests
+            # (sum of x, sum and index-weighted sum of y) so a same-shape
+            # snapshot from different data or block size must not silently
+            # resume.  The x digest is one device scalar (pad rows are
+            # zero, so the padded sum equals the logical sum); computed
+            # only for checkpointed fits.
+            fp = np.asarray([m, n, float(gamma), float(self.c),
+                             float(self.cascade_arity),
+                             float(("rbf", "linear").index(self.kernel)),
+                             float(part),
+                             float(jax.device_get(jnp.sum(xv))),
+                             float(y_pm.sum()),
+                             float(y_pm @ np.arange(m, dtype=np.float64))],
+                            np.float64)
             snap = checkpoint.load()
             if snap is not None:
                 if "fp" not in snap or not np.array_equal(snap["fp"], fp):
                     raise ValueError(
                         "checkpoint does not match this data/estimator "
-                        "(samples, features, kernel, gamma, C or "
-                        "cascade_arity differ) — stale or foreign snapshot")
+                        "(shape, data content, block size, kernel, gamma, "
+                        "C or cascade_arity differ) — stale or foreign "
+                        "snapshot")
                 sv_idx = np.asarray(snap["sv_idx"], np.int64)
                 self._sv_alpha = np.asarray(snap["sv_alpha"], np.float32)
                 last_w = float(snap["last_w"])
                 it = int(snap["n_iter"])
-                self.converged_ = bool(snap["converged"])
+                # a converged snapshot only short-circuits when THIS fit
+                # also checks convergence — resuming with
+                # check_convergence=False means "run the iterations"
+                self.converged_ = bool(snap["converged"]) \
+                    and self.check_convergence
         start_it = it
         for it in range(start_it + 1, self.max_iter + 1):
             if self.converged_:

@@ -174,3 +174,27 @@ class TestCSVMCheckpoint:
             CascadeSVM(max_iter=2, c=100.0, check_convergence=False).fit(
                 ds.array(xh), ds.array(yh),
                 checkpoint=FitCheckpoint(path, every=1))
+        # same shape AND hyperparameters but different data content too
+        xo, yo = self._data(np.random.RandomState(99), n=80)
+        with pytest.raises(ValueError, match="stale or foreign"):
+            CascadeSVM(max_iter=2, check_convergence=False).fit(
+                ds.array(xo), ds.array(yo),
+                checkpoint=FitCheckpoint(path, every=1))
+
+    def test_csvm_resume_without_convergence_check_runs_on(self, rng,
+                                                           tmp_path):
+        from dislib_tpu.classification import CascadeSVM
+        xh, yh = self._data(rng, n=80)
+        x, y = ds.array(xh), ds.array(yh)
+        path = str(tmp_path / "csvm4.npz")
+        kw = dict(cascade_arity=2, kernel="linear")
+        first = CascadeSVM(max_iter=8, check_convergence=True, tol=1e-2,
+                           **kw).fit(x, y,
+                                     checkpoint=FitCheckpoint(path, every=1))
+        assert first.converged_ and first.n_iter_ < 8
+        # converged snapshot + check_convergence=False → keep iterating
+        more = CascadeSVM(max_iter=first.n_iter_ + 2,
+                          check_convergence=False, **kw).fit(
+            x, y, checkpoint=FitCheckpoint(path, every=1))
+        assert more.n_iter_ == first.n_iter_ + 2
+        assert not more.converged_

@@ -100,3 +100,44 @@ class TestRingSanitizers:
         with jax.disable_jit():
             got = dbm.DBSCAN(eps=0.5, min_samples=3).fit(x).labels_
         np.testing.assert_array_equal(got, ref)
+
+
+class TestRound3Paths:
+    """Sanitizer coverage for the round-3 additions: sparse kNN streaming,
+    the distributed full-QR assembly, and the forest async score kernel."""
+
+    def test_sparse_knn_debug_nans(self, rng):
+        import scipy.sparse as sp
+        from dislib_tpu.data.sparse import SparseArray
+        from dislib_tpu.neighbors import NearestNeighbors
+        dense = rng.rand(40, 6).astype(np.float32)
+        dense[dense < 0.6] = 0.0
+        xs = SparseArray.from_scipy(sp.csr_matrix(dense))
+        with jax.debug_nans(True):
+            d, i = NearestNeighbors(n_neighbors=3).fit(xs).kneighbors(xs)
+            assert np.isfinite(np.asarray(d.collect())).all()
+
+    def test_full_qr_no_jit_matches_jit(self, rng, monkeypatch):
+        import importlib
+        qr_mod = importlib.import_module("dislib_tpu.math.qr")
+        monkeypatch.setattr(qr_mod, "_PANEL", 8)
+        x = rng.rand(64, 16).astype(np.float32)
+        q1, r1 = ds.qr(ds.array(x), mode="full")
+        with jax.disable_jit():
+            q2, r2 = ds.qr(ds.array(x), mode="full")
+        np.testing.assert_allclose(np.asarray(q1.collect()),
+                                   np.asarray(q2.collect()),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(r1.collect()),
+                                   np.asarray(r2.collect()),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_forest_async_score_debug_nans(self, rng):
+        from dislib_tpu.trees import RandomForestClassifier
+        x = rng.rand(60, 4).astype(np.float32)
+        y = (x[:, 0] > 0.5).astype(np.float32)[:, None]
+        xa, ya = ds.array(x), ds.array(y)
+        with jax.debug_nans(True):
+            est = RandomForestClassifier(n_estimators=3, random_state=0)
+            st = est._fit_async(xa, ya)
+            assert np.isfinite(float(est._score_async(st, xa, ya)))
