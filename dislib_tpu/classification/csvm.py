@@ -120,26 +120,34 @@ class CascadeSVM(BaseEstimator):
         last_w = None
         self.converged_ = False
         it = 0
-        fp = None
+        fp = digest = None
         if checkpoint is not None:
             # fingerprint of everything the fed-back SV state depends on —
-            # shape, hyperparameters, level-0 partitioning AND data digests
-            # (sum of x, sum and index-weighted sum of y) so a same-shape
-            # snapshot from different data or block size must not silently
-            # resume.  The x digest is one device scalar (pad rows are
-            # zero, so the padded sum equals the logical sum); computed
-            # only for checkpointed fits.
+            # exact part: shape, hyperparameters, level-0 partitioning;
+            # tolerant part: data digests (plain AND index-weighted sums of
+            # x and y, so a row permutation changes them) compared with a
+            # relative tolerance, because float reductions differ in the
+            # last ulps across mesh topologies and a legitimate
+            # resume-after-preemption may land on different hardware.
+            # Digests are device scalars (pad rows are zero, so padded sums
+            # equal logical sums); computed only for checkpointed fits.
             fp = np.asarray([m, n, float(gamma), float(self.c),
                              float(self.cascade_arity),
                              float(("rbf", "linear").index(self.kernel)),
-                             float(part),
-                             float(jax.device_get(jnp.sum(xv))),
-                             float(y_pm.sum()),
-                             float(y_pm @ np.arange(m, dtype=np.float64))],
-                            np.float64)
+                             float(part)], np.float64)
+            riota = jnp.arange(xv.shape[0], dtype=jnp.float32)[:, None]
+            digest = np.asarray(
+                [float(jax.device_get(jnp.sum(xv))),
+                 float(jax.device_get(jnp.sum(xv * riota))),
+                 float(y_pm.sum()),
+                 float(y_pm @ np.arange(m, dtype=np.float64))], np.float64)
             snap = checkpoint.load()
             if snap is not None:
-                if "fp" not in snap or not np.array_equal(snap["fp"], fp):
+                ok = ("fp" in snap and "digest" in snap
+                      and np.array_equal(snap["fp"], fp)
+                      and np.allclose(snap["digest"], digest, rtol=1e-4,
+                                      atol=1e-6, equal_nan=True))
+                if not ok:
                     raise ValueError(
                         "checkpoint does not match this data/estimator "
                         "(shape, data content, block size, kernel, gamma, "
@@ -199,6 +207,7 @@ class CascadeSVM(BaseEstimator):
                 checkpoint.save({"sv_idx": np.asarray(sv_idx, np.int64),
                                  "sv_alpha": self._sv_alpha,
                                  "last_w": w, "n_iter": it, "fp": fp,
+                                 "digest": digest,
                                  "converged": self.converged_})
 
             if self.check_convergence and last_w is not None:

@@ -482,16 +482,27 @@ def array(x, block_size=None, dtype=None) -> Array:
     sparse = sp.issparse(x)
     if sparse:
         x = x.toarray()
-    x = np.asarray(x)
+    on_device = isinstance(x, jax.Array)
+    if not on_device:
+        x = np.asarray(x)
     if x.ndim == 1:
         x = x.reshape(1, -1)
     if x.ndim != 2:
         raise ValueError("ds-arrays are 2-dimensional")
-    x = _coerce_dtype(x, dtype)
+    if on_device:
+        # device input: same dtype policy, applied without a host round-trip
+        if dtype is not None:
+            _require_dtype_support(dtype)
+            x = x.astype(np.dtype(dtype))
+        elif x.dtype == jnp.float64:
+            _warn_f64_narrowing()
+            x = x.astype(jnp.float32)
+    else:
+        x = jnp.asarray(_coerce_dtype(x, dtype))
     if block_size is None:
         block_size = _default_block_size(x.shape, None)
     block_size = _check_block_size(x.shape, block_size)
-    return Array._from_logical(jnp.asarray(x), reg_shape=block_size, sparse=sparse)
+    return Array._from_logical(x, reg_shape=block_size, sparse=sparse)
 
 
 def _require_dtype_support(dtype):
@@ -509,14 +520,18 @@ def _coerce_dtype(x: np.ndarray, dtype):
         _require_dtype_support(dtype)
         return x.astype(np.dtype(dtype), copy=False)
     if x.dtype == np.float64:
-        import warnings
-        warnings.warn(
-            "ds.array received float64 data and is narrowing it to float32 "
-            "(the TPU-native default). Pass dtype=np.float32 to silence, or "
-            "dtype=np.float64 with JAX x64 mode to keep full precision.",
-            UserWarning, stacklevel=3)
+        _warn_f64_narrowing()
         return x.astype(np.float32)
     return x
+
+
+def _warn_f64_narrowing():
+    import warnings
+    warnings.warn(
+        "ds.array received float64 data and is narrowing it to float32 "
+        "(the TPU-native default). Pass dtype=np.float32 to silence, or "
+        "dtype=np.float64 with JAX x64 mode to keep full precision.",
+        UserWarning, stacklevel=4)
 
 
 def _check_block_size(shape, block_size):

@@ -210,3 +210,41 @@ class TestMeshes:
         ds.init((1, 1))
         a, x = _mk(rng, (9, 4))
         np.testing.assert_allclose((a + a).collect(), 2 * x, rtol=1e-6)
+
+
+class TestDeviceInput:
+    def test_array_accepts_jax_array_without_host_roundtrip(self, rng,
+                                                            monkeypatch):
+        import importlib
+        import jax
+        import jax.numpy as jnp
+        arr_mod = importlib.import_module("dislib_tpu.data.array")
+        x_np = rng.rand(20, 5).astype(np.float32)
+        xd = jnp.asarray(x_np)
+        # the host round-trip this guards against was `np.asarray(x)` on
+        # the device input (transfer_guard cannot catch it — __array__
+        # counts as an explicit transfer), so spy on the module's np
+        calls = {"n": 0}
+        real_asarray = np.asarray
+
+        def spy(obj, *a, **k):
+            if isinstance(obj, jax.Array):
+                calls["n"] += 1
+            return real_asarray(obj, *a, **k)
+
+        monkeypatch.setattr(arr_mod.np, "asarray", spy)
+        a = ds.array(xd, block_size=(5, 5))
+        monkeypatch.setattr(arr_mod.np, "asarray", real_asarray)
+        assert calls["n"] == 0, "device input took a host round-trip"
+        np.testing.assert_allclose(a.collect(), x_np, rtol=1e-6)
+        assert a.dtype == np.float32
+
+    def test_device_f64_input_warns_and_narrows(self, rng):
+        import jax
+        import jax.numpy as jnp
+        with jax.enable_x64(True):
+            xd = jnp.asarray(rng.rand(6, 3))          # float64 device array
+            assert xd.dtype == np.float64
+            with pytest.warns(UserWarning, match="narrowing"):
+                a = ds.array(xd)
+        assert a.dtype == np.float32
