@@ -491,12 +491,7 @@ def array(x, block_size=None, dtype=None) -> Array:
         raise ValueError("ds-arrays are 2-dimensional")
     if on_device:
         # device input: same dtype policy, applied without a host round-trip
-        if dtype is not None:
-            _require_dtype_support(dtype)
-            x = x.astype(np.dtype(dtype))
-        elif x.dtype == jnp.float64:
-            _warn_f64_narrowing()
-            x = x.astype(jnp.float32)
+        x = _coerce_dtype(x, dtype)
     else:
         x = jnp.asarray(_coerce_dtype(x, dtype))
     if block_size is None:
@@ -514,11 +509,14 @@ def _require_dtype_support(dtype):
             "default is float32")
 
 
-def _coerce_dtype(x: np.ndarray, dtype):
-    """Apply the library dtype policy (see :func:`array`)."""
+def _coerce_dtype(x, dtype):
+    """Apply the library dtype policy (see :func:`array`) — the ONE
+    implementation, shared by the host (ndarray) and device (jax.Array)
+    input paths."""
     if dtype is not None:
         _require_dtype_support(dtype)
-        return x.astype(np.dtype(dtype), copy=False)
+        dtype = np.dtype(dtype)
+        return x if x.dtype == dtype else x.astype(dtype)
     if x.dtype == np.float64:
         _warn_f64_narrowing()
         return x.astype(np.float32)
@@ -545,21 +543,24 @@ def _check_block_size(shape, block_size):
             min(bc, shape[1]) if shape[1] > 0 else bc)
 
 
-def random_array(shape, block_size=None, random_state=None) -> Array:
+def random_array(shape, block_size=None, random_state=None,
+                 dtype=jnp.float32) -> Array:
     """Uniform [0, 1) ds-array; deterministic per seed, seeded per the whole
     array (the reference seeds per block — an implementation artifact of
     task-parallel generation, not an API contract)."""
+    _require_dtype_support(dtype)
     seed = _seed_from(random_state)
     q = _mesh.pad_quantum()
     pshape = _padded_shape(shape, q)
-    data = _random_uniform(jax.random.PRNGKey(seed), pshape, tuple(int(s) for s in shape))
+    data = _random_uniform(jax.random.PRNGKey(seed), pshape,
+                           tuple(int(s) for s in shape), np.dtype(dtype).name)
     data = jax.device_put(data, _mesh.data_sharding())
     return Array(data, shape, reg_shape=block_size)
 
 
-@partial(jax.jit, static_argnames=("pshape", "shape"))
-def _random_uniform(key, pshape, shape):
-    vals = jax.random.uniform(key, pshape, dtype=jnp.float32)
+@partial(jax.jit, static_argnames=("pshape", "shape", "dtype"))
+def _random_uniform(key, pshape, shape, dtype):
+    vals = jax.random.uniform(key, pshape, dtype=dtype)
     return _zero_pad(vals, shape)
 
 

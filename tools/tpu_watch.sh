@@ -18,8 +18,8 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
         log "TPU PROBE OK — capturing bench"
         timeout 9000 python bench.py > tools/BENCH_watch.jsonl 2> tools/BENCH_watch.err
-        log "bench rc=$? — running TPU test suite"
-        DSLIB_TEST_TPU=1 timeout 7200 python -m pytest tests/ -q \
+        log "bench rc=$? — running TPU test suite (per-file, resumable)"
+        timeout 10800 bash tools/run_tpu_suite.sh /tmp/tpu_suite_results.log \
             > tools/TPU_SUITE_watch.txt 2>&1
         log "suite rc=$? — watcher done"
         exit 0
