@@ -12,20 +12,36 @@ Public API parity contract: SURVEY.md §8 "API parity contract".
 
 import os as _os
 
+
+def _cpu_destined() -> bool:
+    """True when this process is headed for the cpu backend (explicit env
+    or jax config) — the only case the timeout mutation below targets."""
+    if "cpu" in _os.environ.get("JAX_PLATFORMS", ""):
+        return True
+    try:
+        import jax as _j
+        return "cpu" in (_j.config.jax_platforms or "")
+    except Exception:  # noqa: BLE001 — unknown platform: leave flags alone
+        return False
+
+
 # XLA:CPU aborts the process when a collective participant waits >40 s
 # (rendezvous terminate timeout).  On constrained hosts — this build's CI
 # rig runs 8 virtual devices on ONE core — a long compile or any co-tenant
 # load can legitimately stall a participant that long, turning a slow
 # moment into a hard crash.  Raise the abort threshold well past plausible
 # stalls (the warn log stays early).  Must be in XLA_FLAGS before the
-# backend initialises, hence at import; inert for TPU execution.
-for _flag, _default in (
-        ("xla_cpu_collective_call_terminate_timeout_seconds", 600),
-        ("xla_cpu_collective_call_warn_stuck_timeout_seconds", 60)):
-    if _flag not in _os.environ.get("XLA_FLAGS", ""):
-        _os.environ["XLA_FLAGS"] = (
-            _os.environ.get("XLA_FLAGS", "")
-            + f" --{_flag}={_default}").strip()
+# backend initialises, hence at import — and only for cpu-destined
+# processes, so a TPU job's (or an embedding application's) environment
+# is never mutated behind its back.
+if _cpu_destined():
+    for _flag, _default in (
+            ("xla_cpu_collective_call_terminate_timeout_seconds", 600),
+            ("xla_cpu_collective_call_warn_stuck_timeout_seconds", 60)):
+        if _flag not in _os.environ.get("XLA_FLAGS", ""):
+            _os.environ["XLA_FLAGS"] = (
+                _os.environ.get("XLA_FLAGS", "")
+                + f" --{_flag}={_default}").strip()
 
 from dislib_tpu.parallel.mesh import init, get_mesh, set_mesh
 from dislib_tpu.data.array import (

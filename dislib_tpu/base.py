@@ -78,7 +78,12 @@ class BaseEstimator:
 
     def _score_async(self, state, x, y=None):
         """Score a trial from its async state; may return a device scalar —
-        the caller converts to float only after every trial is dispatched."""
+        the caller converts to float only after every trial is dispatched.
+        The fallback materialises the handle first, so an estimator that
+        implements `_fit_async` without a custom `_score_async` still
+        scores a FITTED model."""
+        if state is not None:
+            self._fit_finalize(state)
         if not hasattr(self, "score"):
             raise TypeError(f"{type(self).__name__} has no score(); "
                             "pass scoring=")

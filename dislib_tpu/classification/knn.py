@@ -91,7 +91,9 @@ class KNeighborsClassifier(BaseEstimator):
         if y is None:
             raise ValueError("KNeighborsClassifier requires y")
         self.fit(x, y)
-        return (x,)
+        # sentinel only: the real state lives in self._fit_x/self._codes;
+        # a non-None return tells the search the async path is live
+        return "fitted"
 
     def _score_async(self, state, x, y=None):
         if state is None or y is None:

@@ -100,6 +100,19 @@ def _parse_rows(path, starts, fsize, rlo, rhi, delimiter, dtype, n):
                           delimiter, dtype)
 
 
+def _check_no_blank_lines(starts, fsize):
+    """Raise if the offset table shows blank lines (two adjacent newlines,
+    or a newline at byte 0).  Every host scans the SAME whole-file offsets,
+    so this raises deterministically on all hosts — unlike slab-local parse
+    errors, which would kill one process and hang its peers at the next
+    collective."""
+    del fsize
+    if len(starts) > 1 and bool((np.diff(starts) == 1).any()):
+        raise ValueError(
+            "multi-process text ingest requires one sample per line "
+            "(blank lines found) — load single-process instead")
+
+
 def _process_row_slab(m, n):
     """Padded-row range [lo, hi) this process's addressable shards cover
     under the canonical data sharding for a logical (m, n) array."""
@@ -156,18 +169,32 @@ def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32):
     from dislib_tpu.data.array import _require_dtype_support
     _require_dtype_support(dtype)
     starts, fsize = _scan_line_offsets(path)
+    _check_no_blank_lines(starts, fsize)   # deterministic across hosts
     m = len(starts)
     with open(path, "rb") as f:
         n = _parse_txt_buf(f.readline(), delimiter, dtype).shape[1]
+    if n == 0:
+        raise ValueError(
+            "multi-process text ingest reads the column count from the "
+            "first line, which parsed to no columns (comment/header "
+            "line?) — load single-process instead")
     lo, hi = _process_row_slab(m, n)
     rlo, rhi = min(lo, m), min(hi, m)
     local = _parse_rows(path, starts, fsize, rlo, rhi, delimiter, dtype, n)
     if local.shape[0] != rhi - rlo:
-        # np.loadtxt skips blank/comment lines the offset table counted —
-        # silently zero-filling the shortfall would fabricate rows
+        # np.loadtxt skips comment lines the offset table counted —
+        # silently zero-filling the shortfall would fabricate rows.  NOTE:
+        # this check is slab-local, so only hosts whose slab holds the bad
+        # lines raise; keep files comment-free for multi-host ingest.
         raise ValueError(
             "multi-process text ingest requires one sample per line "
-            "(blank/comment lines found) — load single-process instead")
+            "(comment lines found) — load single-process instead")
+    if local.size and local.shape[1] != n:
+        # a width different from the first line would be silently cropped
+        # or zero-filled by the shard assembly — refuse instead
+        raise ValueError(
+            f"rows {rlo}:{rhi} parsed {local.shape[1]} columns but the "
+            f"first line has {n} — ragged text files are not supported")
     return _from_local_rows(local, rlo, (m, n), block_size, dtype)
 
 
@@ -230,19 +257,32 @@ def _load_svmlight_sharded(path, block_size, n_features):
     import jax
     from jax.experimental import multihost_utils
     starts, fsize = _scan_line_offsets(path)
+    _check_no_blank_lines(starts, fsize)   # deterministic across hosts
     m = len(starts)
     lo, hi = _process_row_slab(m, n_features or 1)
     rlo, rhi = min(lo, m), min(hi, m)
     buf = _read_rows(path, starts, fsize, rlo, rhi)
     rows, labels, max_feat = _parse_svmlight_text(
         buf.decode().splitlines())
-    if len(rows) != rhi - rlo:
+    slab_bad = len(rows) != rhi - rlo
+    if n_features is None:
+        # one scalar allgather establishes the feature count AND carries a
+        # per-host error flag: if any slab had comment lines, EVERY host
+        # raises together instead of one dying and its peers hanging at
+        # this very collective
+        agreed = np.asarray(multihost_utils.process_allgather(
+            np.asarray([max_feat, int(slab_bad)], np.int64)))
+        if agreed.reshape(-1, 2)[:, 1].any():
+            raise ValueError(
+                "multi-process svmlight ingest requires one sample per "
+                "line (comment lines found) — load single-process instead")
+        n_features = int(agreed.reshape(-1, 2)[:, 0].max())
+    elif slab_bad:
+        # no collective in this branch: the raise is slab-local (see the
+        # txt loader note) — keep files comment-free for multi-host ingest
         raise ValueError(
             "multi-process svmlight ingest requires one sample per line "
-            "(blank/comment lines found) — load single-process instead")
-    if n_features is None:
-        n_features = int(np.max(multihost_utils.process_allgather(
-            np.asarray([max_feat], np.int64))))
+            "(comment lines found) — load single-process instead")
     dense = _svmlight_dense(rows, n_features)
     x = _from_local_rows(dense, rlo, (m, n_features), block_size, np.float32)
     yloc = np.asarray(labels, np.float32).reshape(-1, 1)

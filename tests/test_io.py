@@ -157,3 +157,47 @@ class TestDtypePolicy:
             warnings.simplefilter("error")
             b = ds.load_npy_file(path, dtype=np.float32)
         assert b.dtype == np.float32
+
+
+class TestMultiprocGuards:
+    """Multi-process ingest error paths, exercised single-host by
+    monkeypatching process_count (the slab logic is identical; only the
+    process→shard mapping collapses to one host)."""
+
+    def _force_multiproc(self, monkeypatch):
+        import jax
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    def test_blank_line_raises_everywhere(self, rng, tmp_path, monkeypatch):
+        self._force_multiproc(monkeypatch)
+        path = os.path.join(tmp_path, "b.csv")
+        with open(path, "w") as f:
+            f.write("1.0,2.0\n\n3.0,4.0\n")
+        with pytest.raises(ValueError, match="blank lines"):
+            ds.load_txt_file(path)
+
+    def test_comment_first_line_raises(self, rng, tmp_path, monkeypatch):
+        self._force_multiproc(monkeypatch)
+        path = os.path.join(tmp_path, "c.csv")
+        with open(path, "w") as f:
+            f.write("# header\n1.0,2.0\n")
+        with pytest.raises(ValueError, match="single-process"):
+            ds.load_txt_file(path)
+
+    def test_ragged_width_raises(self, rng, tmp_path, monkeypatch):
+        self._force_multiproc(monkeypatch)
+        path = os.path.join(tmp_path, "r.csv")
+        with open(path, "w") as f:
+            f.write("1.0,2.0,3.0\n")
+            f.write("1.0,2.0\n" * 5)          # uniform but != first line
+        with pytest.raises(ValueError):
+            ds.load_txt_file(path)
+
+    def test_clean_file_loads_through_multiproc_path(self, rng, tmp_path,
+                                                     monkeypatch):
+        self._force_multiproc(monkeypatch)
+        x = rng.rand(12, 3).astype(np.float32)
+        path = os.path.join(tmp_path, "ok.csv")
+        np.savetxt(path, x, delimiter=",")
+        a = ds.load_txt_file(path)
+        np.testing.assert_allclose(a.collect(), x, rtol=1e-5)

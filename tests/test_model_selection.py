@@ -373,3 +373,33 @@ class TestScorerStrings:
         with pytest.raises(ValueError, match="unknown scorer"):
             GridSearchCV(KMeans(), {"n_clusters": [2]}, cv=2,
                          scoring="zzz").fit(x)
+
+
+class TestAsyncProtocolFallbacks:
+    def test_default_score_async_finalizes_first(self, rng):
+        """An estimator with _fit_async but no custom _score_async must be
+        scored FITTED — the base fallback materialises the handle."""
+        from dislib_tpu.base import BaseEstimator
+
+        class AsyncOnly(BaseEstimator):
+            def __init__(self, a=1):
+                self.a = a
+
+            def fit(self, x, y=None):
+                self._fit_finalize(self._fit_async(x, y))
+                return self
+
+            def _fit_async(self, x, y=None):
+                return {"val": float(self.a)}
+
+            def _fit_finalize(self, state):
+                if state is not None:
+                    self.val_ = state["val"]
+
+            def score(self, x, y=None):
+                return self.val_          # raises if not finalised
+
+        x = ds.array(rng.rand(30, 3).astype(np.float32))
+        gs = GridSearchCV(AsyncOnly(), {"a": [1, 2]}, cv=2, refit=False)
+        gs.fit(x)
+        assert gs.best_params_ == {"a": 2}
