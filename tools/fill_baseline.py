@@ -23,6 +23,8 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # bench metric prefix → (BASELINE.md row name, config text, is_matmul)
 ROWS = [
+    ("dispatch_rtt", "Dispatch RTT (informational)",
+     "8×8 jitted add + 1-elt fetch", False),
     ("kmeans_10000x100_k8", "KMeans", "k=8, 10000×100 ds-array", False),
     ("matmul_4096", "Blocked matmul (f32)", "4096×4096 @ 4096×4096", True),
     ("tsqr_65536x256", "tsQR", "65536×256 tall-skinny", False),
@@ -30,6 +32,8 @@ ROWS = [
     ("gmm_1000000x50", "GaussianMixture EM", "1M×50, k=16, 5 iter", False),
     ("matmul_16384_f32", "Matmul north star ★ (f32)", "16384×16384", True),
     ("matmul_16384_bf16", "Matmul north star ★ (bf16)", "16384×16384", True),
+    ("kmeans_1Mx100_k10_sustained", "KMeans ★ sustained (500 it/dispatch)",
+     "1M×100, k=10", False),
     ("kmeans_1Mx100_k10_fastdist", "KMeans ★ (bf16 assignment)",
      "1M×100, k=10", False),
     ("kmeans_1Mx100_k10_iter", "KMeans north star ★", "1M×100, k=10", False),
@@ -65,9 +69,11 @@ def main():
             mfu = "—"
             if is_matmul:
                 mfu = f"{100.0 * rec['value'] / (peak_tflops * 1000):.1f}%"
+            vsb = "—" if rec.get("vs_baseline") is None \
+                else f"{rec['vs_baseline']}×"
             out_rows.append(
                 f"| {name} | {cfg} | {rec['value']} | {rec['unit']} | "
-                f"{rec['vs_baseline']}× | {mfu} | {hw} |")
+                f"{vsb} | {mfu} | {hw} |")
 
     path = os.path.join(ROOT, "BASELINE.md")
     text = open(path).read()
