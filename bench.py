@@ -391,6 +391,12 @@ def _run_one(name):
     if name in os.environ.get("DSLIB_BENCH_FAKE_HANG", "").split(","):
         time.sleep(10_000)
     try:
+        if os.environ.get("BENCH_SMOKE"):
+            # smoke mode validates the harness WITHOUT the chip; the platform
+            # must be forced in-process before backend init (JAX_PLATFORMS is
+            # ignored in this environment — round-1 post-mortem)
+            import jax
+            jax.config.update("jax_platforms", "cpu")
         import dislib_tpu as ds
         ds.init()
     except Exception as e:  # noqa: BLE001
@@ -411,9 +417,11 @@ def main():
     # fast probe: a dead tunnel is detected in _PROBE_TIMEOUT_S, not per-
     # config watchdog time.  The parent process never imports jax, so it
     # can always report and exit cleanly.
+    probe_src = "import jax; jax.devices()" if not os.environ.get(
+        "BENCH_SMOKE") else \
+        "import jax; jax.config.update('jax_platforms', 'cpu'); jax.devices()"
     try:
-        subprocess.run([sys.executable, "-c",
-                        "import jax; jax.devices()"],
+        subprocess.run([sys.executable, "-c", probe_src],
                        timeout=_PROBE_TIMEOUT_S, check=True,
                        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
                        text=True)

@@ -264,9 +264,17 @@ def _chol_precisions(covs, cov_type, d):
 def _log_prob(xv, means, prec, cov_type, d):
     """Weighted log N(x | mu_k, Sigma_k): (m, k)."""
     if cov_type == "full":
+        # maha_ik = ‖x_i P_k − μ_k P_k‖², expanded so no (k, m, d) DIFF
+        # intermediate materialises in HBM: the batched GEMM z = x @ P_k is
+        # the only (k, m, d) tensor, and the square-sum + dot against
+        # t_k = μ_k P_k fuse into its single read-back.  Same cancellation
+        # profile as ops.distances_sq (clamped at zero).
         def per_comp(mu, pc):
-            y = (xv - mu[None, :]) @ pc                       # (m, d) GEMM
-            return jnp.sum(y * y, axis=1), jnp.sum(jnp.log(jnp.diag(pc)))
+            z = xv @ pc                                       # (m, d) GEMM
+            t = mu @ pc                                       # (d,)
+            maha = jnp.maximum(
+                jnp.sum(z * z, axis=1) - 2.0 * (z @ t) + t @ t, 0.0)
+            return maha, jnp.sum(jnp.log(jnp.diag(pc)))
         maha, logdet = jax.vmap(per_comp)(means, prec)
         return -0.5 * (d * _LOG2PI + maha.T) + logdet[None, :]
     if cov_type == "tied":
@@ -295,10 +303,14 @@ def _estimate_covs(xv, resp, nk, means, cov_type, reg_covar, w):
     """M-step covariance update; resp already includes the row mask."""
     d = xv.shape[1]
     if cov_type == "full":
+        # √r-weighted single intermediate: wd = √r_k (x − μ_k) makes the
+        # covariance wdᵀwd — symmetric PSD by construction, and only ONE
+        # (k, m, d) tensor reaches HBM (the diff and the weighting fuse
+        # into its materialisation) instead of the two that diff-then-
+        # weight would write.  r_k ≥ 0 always (responsibilities × mask).
         def per_comp(r_k, mu, n_k):
-            diff = xv - mu[None, :]
-            cov = (diff * r_k[:, None]).T @ diff / n_k
-            return cov + reg_covar * jnp.eye(d, dtype=xv.dtype)
+            wd = (xv - mu[None, :]) * jnp.sqrt(r_k)[:, None]
+            return wd.T @ wd / n_k + reg_covar * jnp.eye(d, dtype=xv.dtype)
         return jax.vmap(per_comp)(resp.T, means, nk)
     if cov_type == "tied":
         # Σ_total = XᵀWX - Σ_k n_k μ_k μ_kᵀ, averaged

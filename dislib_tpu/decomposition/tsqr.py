@@ -59,16 +59,49 @@ def tsqr(a: Array, mode: str = "reduced", indexes=None):
     return q, Array._from_logical(r)
 
 
+def _split_count(rows: int, n: int, target: int = 8) -> int:
+    """Largest power-of-two ``s`` dividing ``rows`` with panels ≥ target·n tall."""
+    s = 1
+    while rows % (2 * s) == 0 and rows // (2 * s) >= target * max(n, 1):
+        s *= 2
+    return s
+
+
+def _local_tsqr(a):
+    """Shard-LOCAL tall-skinny QR as a batched reduction tree.
+
+    A single Householder QR of an (M, n) panel is a column-sequential
+    factorisation — each of the n reflector steps is a skinny matvec +
+    rank-1 update, far below MXU occupancy for M ≫ n.  This applies the
+    reference's tsQR reduction tree (SURVEY §3.2: per-block QR + pairwise
+    R merges) *within* one chip: factor ``s`` sub-panels as ONE batched QR
+    (the batch dimension feeds the MXU), then recurse on the (s·n, n)
+    R-stack until it is short enough to factor directly.  Same
+    Householder-tree numerics as the cross-shard tsQR, so stability is
+    unchanged; shapes are static so the whole tree is one traced program.
+    Degrades to a plain ``jnp.linalg.qr`` when the input is too short to
+    split (the CPU-rig test shapes and the p·n R-stack at small p).
+    """
+    rows, n = a.shape
+    s = _split_count(rows, n)
+    if s == 1:
+        return jnp.linalg.qr(a, mode="reduced")
+    q0, r0 = jnp.linalg.qr(a.reshape(s, rows // s, n), mode="reduced")
+    q1, r = _local_tsqr(r0.reshape(s * n, n))
+    q = q0 @ q1.reshape(s, n, n)                             # batched GEMM
+    return q.reshape(rows, n), r
+
+
 @partial(jax.jit, static_argnames=("mesh", "p"))
 @precise
 def _tsqr_shardmap(av, mesh, p):
     n = av.shape[1]
 
     def local(a_shard):
-        q1, r1 = jnp.linalg.qr(a_shard, mode="reduced")      # (m/p, n), (n, n)
+        q1, r1 = _local_tsqr(a_shard)                        # (m/p, n), (n, n)
         r_stack = lax.all_gather(r1, _mesh.ROWS)             # (p, n, n) — ICI
         r_stack = r_stack.reshape(p * n, n)
-        q2, r = jnp.linalg.qr(r_stack, mode="reduced")       # redundant per shard
+        q2, r = _local_tsqr(r_stack)                         # redundant per shard
         idx = lax.axis_index(_mesh.ROWS)
         q2_i = lax.dynamic_slice(q2, (idx * n, 0), (n, n))
         # R is computed identically on every shard, but the static

@@ -193,6 +193,19 @@ class TestTSQR:
         with pytest.raises(ValueError):
             ds.tsqr(ds.array(rng.rand(4, 8)))
 
+    def test_local_tree_path(self, rng):
+        # shard rows (512/8 = 64) ≥ 16·n with power-of-two divisibility, so
+        # _local_tsqr actually recurses (s > 1) instead of degrading to one
+        # flat QR — pin the batched-tree path's invariants
+        from dislib_tpu.decomposition.tsqr import _split_count
+        assert _split_count(512, 2) > 1            # tree engaged at this shape
+        x = rng.rand(512, 2)
+        q, r = ds.tsqr(ds.array(x))
+        qc, rc = q.collect(), r.collect()
+        np.testing.assert_allclose(qc @ rc, x, atol=1e-4)
+        np.testing.assert_allclose(qc.T @ qc, np.eye(2), atol=1e-4)
+        assert np.allclose(rc, np.triu(rc))
+
 
 class TestSVD:
     @pytest.mark.parametrize("shape", [(16, 8), (30, 30), (50, 7)])
