@@ -1,0 +1,85 @@
+"""Communication audits for the north-star fit programs (SURVEY §3.7).
+
+The SPMD memory contract behind every scale claim: a fit over row-sharded
+data reduces small statistics (psum → all-reduce of (k, n)-sized tensors)
+but NEVER all-gathers the (m, n) operand onto one device.  The reference
+holds this by construction (per-block tasks + arity-tree merges of
+partials); here it must be pinned, because one misplaced sharding
+constraint would make XLA "helpfully" gather — correct results, broken
+memory scaling, invisible to oracle tests.  Same technique as
+test_math.py's QR gather audit: compile at a sharded shape and inspect the
+HLO's collectives.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.parallel import mesh as _mesh
+
+
+def _collective_sizes(hlo, op):
+    """Element counts of every `op` collective in the HLO text."""
+    sizes = []
+    for m_ in re.finditer(op + r"[^\n]*?f32\[([\d,]*)\]", hlo):
+        dims = [int(d) for d in m_.group(1).split(",") if d]
+        elems = 1
+        for d in dims:
+            elems *= d
+        sizes.append(elems)
+    return sizes
+
+
+def _assert_no_operand_gather(hlo, full_elems):
+    for op in ("all-gather", "all-to-all"):
+        for elems in _collective_sizes(hlo, op):
+            assert elems < full_elems, \
+                f"{op} of {elems} elems covers the full {full_elems} operand"
+
+
+class TestFitCommAudit:
+    M, N = 4096, 32
+
+    def _sharded(self, rng):
+        x = rng.rand(self.M, self.N).astype(np.float32)
+        return ds.array(x, block_size=(self.M // 8, self.N)), x
+
+    def test_kmeans_fit_never_gathers_data(self, rng):
+        from dislib_tpu.cluster.kmeans import _kmeans_fit
+        a, x = self._sharded(rng)
+        c0 = jnp.asarray(x[:4])
+        hlo = _kmeans_fit.lower(a._data, a.shape, c0, 3, 0.0,
+                                fast=False).compile().as_text()
+        _assert_no_operand_gather(hlo, self.M * self.N)
+        # the psum of per-cluster (Σx, count) partials must be there — the
+        # reference's arity-tree merge, as an all-reduce over 'rows'
+        assert "all-reduce" in hlo
+
+    def test_gmm_fit_never_gathers_data(self, rng):
+        from dislib_tpu.cluster.gm import _gm_fit
+        a, x = self._sharded(rng)
+        resp0 = jnp.ones((a._data.shape[0], 3), jnp.float32) / 3.0
+        hlo = _gm_fit.lower(a._data, a.shape, resp0, "full", 1e-6, 0.0,
+                            3).compile().as_text()
+        # responsibilities are (m, k) row-sharded state — also never gathered
+        _assert_no_operand_gather(hlo, self.M * 3)
+        _assert_no_operand_gather(hlo, self.M * self.N)
+        assert "all-reduce" in hlo
+
+    def test_kmeans_per_device_memory_scales(self, rng):
+        """memory_analysis: per-device temporaries stay ~O(m/p · (n + k)),
+        nowhere near a replicated (m, n) copy of the operand."""
+        from dislib_tpu.cluster.kmeans import _kmeans_fit
+        a, x = self._sharded(rng)
+        c0 = jnp.asarray(x[:4])
+        mem = _kmeans_fit.lower(a._data, a.shape, c0, 3, 0.0,
+                                fast=False).compile().memory_analysis()
+        if mem is None:
+            pytest.skip("backend reports no memory analysis")
+        full = self.M * self.N * 4
+        assert mem.temp_size_in_bytes < full, \
+            f"per-device temp {mem.temp_size_in_bytes} >= full operand {full}"
