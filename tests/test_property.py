@@ -138,3 +138,20 @@ def test_row_steps_invariants(seed, m, chunk, density):
     want = max(64, 4 * int(np.ceil(xs.nnz * chunk / max(m, 1))),
                int(row_nnz.max(initial=1)))
     assert data.shape[1] <= want
+
+
+@given(st.integers(0, 2**16), st.integers(1, 9), st.integers(1, 8))
+@_settings
+def test_tsqr_invariants(seed, n, mult):
+    """QᵀQ≈I and QR≈A across tall shapes, including ones that engage the
+    batched-tree local QR (rows ≫ n) and ones that pad shards (rows < p·n)."""
+    m = n * mult * 8 + (seed % 7)           # sometimes ragged vs the mesh
+    if m < n:
+        m = n
+    x = np.random.RandomState(seed).standard_normal((m, n)).astype(np.float32)
+    q, r = ds.tsqr(ds.array(x))
+    qc, rc = q.collect(), r.collect()
+    assert qc.shape == (m, n) and rc.shape == (n, n)
+    np.testing.assert_allclose(qc @ rc, x, atol=5e-4 * max(1, np.abs(x).max()))
+    np.testing.assert_allclose(qc.T @ qc, np.eye(n), atol=5e-4)
+    assert np.allclose(rc, np.triu(rc))
