@@ -77,11 +77,10 @@ class TestKMeans:
 
 
 def test_fast_distance_flag_matches(monkeypatch):
-    """DSLIB_KMEANS_FAST_DISTANCE routes the E-step distance GEMM to default
-    matmul precision; on the CPU rig precision is a no-op, so results must
-    be identical — this pins the flag's plumbing, the TPU accuracy gate
-    lives in bench.py."""
-    import os
+    """DSLIB_KMEANS_FAST_DISTANCE stores the E-step operand as bfloat16 —
+    the same input rounding the TPU MXU applies at default precision, so
+    the CPU rig now exercises the fast path's true numerics.  Gate mirrors
+    bench.py's: centers within bf16 tolerance, inertia within 0.1%."""
     import dislib_tpu as ds
     from dislib_tpu.cluster import KMeans
 
@@ -94,6 +93,10 @@ def test_fast_distance_flag_matches(monkeypatch):
                      fast_distance=True).fit(x)
     monkeypatch.setenv("DSLIB_KMEANS_FAST_DISTANCE", "1")
     km_env = KMeans(n_clusters=4, init=init, max_iter=7, tol=0.0).fit(x)
-    np.testing.assert_allclose(km_env.centers_, km_ref.centers_, rtol=1e-6)
-    np.testing.assert_allclose(km_fast.centers_, km_ref.centers_, rtol=1e-6)
-    assert km_fast.n_iter_ == km_ref.n_iter_
+    np.testing.assert_allclose(km_env.centers_, km_fast.centers_, rtol=1e-6)
+    np.testing.assert_allclose(km_fast.centers_, km_ref.centers_,
+                               rtol=2e-2, atol=2e-2)
+    # 7 iterations on 200 points: a few bf16 boundary flips can drift the
+    # trajectory to a nearby local optimum — gate on objective QUALITY (1%);
+    # the tight 0.1% single-iteration gate lives in bench.py at m=1M
+    np.testing.assert_allclose(km_fast.inertia_, km_ref.inertia_, rtol=1e-2)
