@@ -2,9 +2,10 @@ from dislib_tpu.utils.base import shuffle, train_test_split
 from dislib_tpu.utils.saving import save_model, load_model
 from dislib_tpu.utils.checkpoint import FitCheckpoint
 from dislib_tpu.utils.profiling import (
-    start_trace, stop_trace, trace, annotate, op_graph,
+    start_trace, stop_trace, trace, annotate, op_graph, memory_stats,
 )
 
 __all__ = ["shuffle", "train_test_split", "save_model", "load_model",
            "FitCheckpoint",
-           "start_trace", "stop_trace", "trace", "annotate", "op_graph"]
+           "start_trace", "stop_trace", "trace", "annotate", "op_graph",
+           "memory_stats"]

@@ -53,3 +53,15 @@ def annotate(name: str):
 def op_graph(fn, *args, **kwargs) -> str:
     """Compiled-HLO text of `fn(*args)` — the task-DAG dump analog."""
     return jax.jit(fn).lower(*args, **kwargs).compile().as_text()
+
+
+def memory_stats():
+    """Per-device memory stats (SURVEY §6 observability row — the COMPSs
+    monitoring resource-load view's analog).
+
+    Returns ``{device_str: stats_dict_or_None}``; keys of each stats dict
+    are backend-defined (TPU reports e.g. ``bytes_in_use``,
+    ``bytes_limit``, ``peak_bytes_in_use``), and devices whose backend
+    exposes no allocator stats (CPU) map to None.
+    """
+    return {str(d): d.memory_stats() for d in jax.local_devices()}

@@ -152,3 +152,13 @@ class TestShuffleScale:
         np.testing.assert_allclose(np.sort(got @ key), np.sort(x_np @ key),
                                    rtol=1e-5)
         assert not np.allclose(got, x_np)
+
+
+class TestMemoryStats:
+    def test_reports_per_device(self):
+        from dislib_tpu.utils import memory_stats
+        import jax
+        stats = memory_stats()
+        assert len(stats) == len(jax.local_devices())
+        for v in stats.values():
+            assert v is None or isinstance(v, dict)
