@@ -2,17 +2,35 @@
 `shuffle(x, y, random_state)` is a random global permutation via
 partition-and-rebuild tasks; SURVEY.md §3.3).
 
-TPU-native: a global permutation of a row-sharded array is an all-to-all over
-shards.  We express it as a gather with a permuted index vector — XLA lowers
-the cross-shard gather to its collective machinery (ppermute/all-to-all) —
-rather than re-building the reference's partition/merge task pipeline.
+TPU-native: a global row permutation of a row-sharded array IS an
+all-to-all over shards (SURVEY §3.7 "all-to-all reshuffle" row).  The
+permutation is drawn on host (it is O(m) index bookkeeping, the same place
+the reference plans its partition/rebuild tasks), routing is precomputed
+per (source shard → destination shard) pair, and the data movement is ONE
+`lax.all_to_all` over the mesh 'rows' axis inside a `shard_map`:
+
+    per shard:  send[d] = local rows destined for shard d   (local gather)
+    collective: recv = all_to_all(send)                     — ICI
+    per shard:  out[dst slots] = recv                       (local scatter)
+
+Per-device memory is O(shard + exchange buffers) — the operand is never
+gathered onto one device, which the memory/HLO tests pin.  For a uniform
+random permutation the (s, d) bucket sizes concentrate at m/p², so the
+padded exchange buffer is ~1 shard with a small slack factor.
 """
 
 from __future__ import annotations
 
+from functools import partial
+
+import jax
+import jax.numpy as jnp
 import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from dislib_tpu.data.array import Array
+from dislib_tpu.parallel import mesh as _mesh
 
 
 def shuffle(x: Array, y: Array | None = None, random_state=None):
@@ -20,12 +38,79 @@ def shuffle(x: Array, y: Array | None = None, random_state=None):
     rng = random_state if isinstance(random_state, np.random.RandomState) \
         else np.random.RandomState(random_state)
     perm = rng.permutation(x.shape[0])
-    xs = x[perm, :]
+    xs = _apply_perm(x, perm)
     if y is None:
         return xs
     if y.shape[0] != x.shape[0]:
         raise ValueError("x and y must have the same number of rows")
-    return xs, y[perm, :]
+    return xs, _apply_perm(y, perm)
+
+
+def _apply_perm(x: Array, perm: np.ndarray) -> Array:
+    mesh = _mesh.get_mesh()
+    p = mesh.shape[_mesh.ROWS]
+    m_loc = x._data.shape[0] // p
+    send_idx, dst_idx = _routing(perm, m_loc, p)
+    out = _shuffle_exchange(x._data, jnp.asarray(send_idx),
+                            jnp.asarray(dst_idx), mesh, p)
+    return Array(out, x._shape, x._reg_shape, x._sparse)
+
+
+def _routing(perm, m_loc, p):
+    """Host-side routing plan for ``out[i] = x[perm[i]]`` on contiguous
+    row shards of height ``m_loc``.
+
+    Returns (send_idx, dst_idx), both (p, p, cap) int32:
+    - ``send_idx[s, d, c]``: local row (within shard s) of the c-th row
+      shard s sends to shard d; padding slots repeat row 0.
+    - ``dst_idx[d, s, c]``: local output slot (within shard d) for the
+      c-th row received from shard s; padding slots hold ``m_loc``
+      (out of range → dropped by the scatter).
+    """
+    m = len(perm)
+    i = np.arange(m)
+    src = perm
+    s_shard = src // m_loc
+    d_shard = i // m_loc
+    order = np.lexsort((i, d_shard, s_shard))   # group by (s, d), stable in i
+    s_sorted, d_sorted = s_shard[order], d_shard[order]
+    counts = np.zeros((p, p), np.int64)
+    np.add.at(counts, (s_sorted, d_sorted), 1)
+    cap = max(1, int(counts.max()))
+    send_idx = np.zeros((p, p, cap), np.int32)
+    dst_idx = np.full((p, p, cap), m_loc, np.int32)
+    # slot index of each routed row within its (s, d) bucket
+    flat = s_sorted * p + d_sorted
+    starts = np.zeros(p * p, np.int64)
+    np.add.at(starts, flat, 1)
+    starts = np.concatenate([[0], np.cumsum(starts)[:-1]])
+    slot = np.arange(m) - starts[flat]
+    send_idx[s_sorted, d_sorted, slot] = (src[order] % m_loc).astype(np.int32)
+    dst_idx[d_sorted, s_sorted, slot] = (i[order] % m_loc).astype(np.int32)
+    return send_idx, dst_idx
+
+
+@partial(jax.jit, static_argnames=("mesh", "p"))
+def _shuffle_exchange(xp, send_idx, dst_idx, mesh, p):
+    m_loc = xp.shape[0] // p
+
+    def shard_fn(x_s, send_s, dst_s):
+        send = x_s[0][send_s[0]]                       # (p, cap, n) gather
+        recv = lax.all_to_all(send, _mesh.ROWS, split_axis=0, concat_axis=0)
+        n = x_s.shape[-1]
+        cap = send_s.shape[-1]
+        out = jnp.zeros((m_loc, n), x_s.dtype)
+        out = out.at[dst_s[0].reshape(p * cap)].set(
+            recv.reshape(p * cap, n), mode="drop")
+        return out[None]
+
+    out = jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(_mesh.ROWS, None), P(_mesh.ROWS), P(_mesh.ROWS)),
+        out_specs=P(_mesh.ROWS, None),
+        check_vma=True,
+    )(xp.reshape(p, m_loc, -1), send_idx, dst_idx)
+    return out.reshape(xp.shape)
 
 
 def train_test_split(x: Array, y: Array | None = None, test_size: float = 0.25,
