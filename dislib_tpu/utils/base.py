@@ -121,7 +121,14 @@ def train_test_split(x: Array, y: Array | None = None, test_size: float = 0.25,
     n_train = n - n_test if train_size is None else int(round(n * train_size))
     rng = np.random.RandomState(random_state)
     perm = rng.permutation(n)
-    tr, te = perm[:n_train], perm[n_train:n_train + n_test]
+    # permute once via the bounded all-to-all exchange, then take contiguous
+    # row slices — identical values to fancy-gathering perm[:n_train] etc.,
+    # without a full-size gather per split
+    xs = _apply_perm(x, perm)
     if y is None:
-        return x[tr, :], x[te, :]
-    return x[tr, :], x[te, :], y[tr, :], y[te, :]
+        return xs[:n_train, :], xs[n_train:n_train + n_test, :]
+    if y.shape[0] != n:
+        raise ValueError("x and y must have the same number of rows")
+    ys = _apply_perm(y, perm)
+    return (xs[:n_train, :], xs[n_train:n_train + n_test, :],
+            ys[:n_train, :], ys[n_train:n_train + n_test, :])
