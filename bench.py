@@ -391,10 +391,12 @@ def _run_one(name):
     if name in os.environ.get("DSLIB_BENCH_FAKE_HANG", "").split(","):
         time.sleep(10_000)
     try:
-        if os.environ.get("BENCH_SMOKE"):
+        if os.environ.get("BENCH_SMOKE") and "JAX_PLATFORMS" not in os.environ:
             # smoke mode validates the harness WITHOUT the chip; the platform
             # must be forced in-process before backend init (JAX_PLATFORMS is
-            # ignored in this environment — round-1 post-mortem)
+            # ignored by the axon sitecustomize — round-1 post-mortem).  An
+            # EXPLICIT JAX_PLATFORMS in the environment wins (test hooks
+            # inject failures through it).
             import jax
             jax.config.update("jax_platforms", "cpu")
         import dislib_tpu as ds
@@ -417,9 +419,11 @@ def main():
     # fast probe: a dead tunnel is detected in _PROBE_TIMEOUT_S, not per-
     # config watchdog time.  The parent process never imports jax, so it
     # can always report and exit cleanly.
-    probe_src = "import jax; jax.devices()" if not os.environ.get(
-        "BENCH_SMOKE") else \
-        "import jax; jax.config.update('jax_platforms', 'cpu'); jax.devices()"
+    if os.environ.get("BENCH_SMOKE") and "JAX_PLATFORMS" not in os.environ:
+        probe_src = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+                     "jax.devices()")
+    else:
+        probe_src = "import jax; jax.devices()"
     try:
         subprocess.run([sys.executable, "-c", probe_src],
                        timeout=_PROBE_TIMEOUT_S, check=True,
