@@ -143,6 +143,13 @@ def main():
         .kneighbors(x)
     ring_d = np.asarray(d_ring.collect())
 
+    # all-to-all: the global shuffle exchange crosses the process boundary
+    # (row content must be preserved exactly, just reordered)
+    from dislib_tpu.utils import shuffle
+    xsh = np.asarray(shuffle(x, random_state=7).collect())
+    shuffle_ok = sorted(map(tuple, xsh.tolist())) == \
+        sorted(map(tuple, xs_host.tolist()))
+
     # SPMD discipline: EVERY rank runs the same collectives in the same
     # order (collect() is a process_allgather) — only the file write is
     # rank-conditional
@@ -155,6 +162,7 @@ def main():
                        "shape": list(x.shape),
                        "gram_trace": gram_trace,
                        "qr_err": qr_err,
+                       "shuffle_ok": bool(shuffle_ok),
                        "ring_d_sum": float(ring_d.sum())}, f)
     print(f"worker {rank} done", flush=True)
 

@@ -89,6 +89,7 @@ def test_two_process_kmeans_matches_single(tmp_path):
     np.testing.assert_allclose(got["gram_trace"],
                                np.trace(parsed @ parsed.T), rtol=1e-4)
     assert got["qr_err"] < 1e-3
+    assert got["shuffle_ok"], "all-to-all shuffle lost/changed rows across hosts"
     dd = ((parsed[:, None, :] - parsed[None]) ** 2).sum(-1)
     k3 = np.sqrt(np.maximum(np.sort(dd, axis=1)[:, :3], 0.0))
     np.testing.assert_allclose(got["ring_d_sum"], k3.sum(), rtol=1e-3)
