@@ -5,7 +5,9 @@ syncing all futures first; SURVEY.md §3.3 and §6 "Checkpoint / resume").
 TPU-native: same semantics — save syncs device state to host (`collect()`)
 and encodes hyperparameters + trailing-underscore fitted attributes.  No
 pickle (portability, same stance as the reference's JSON/CBOR choice).
-Formats: 'json' (reference parity), 'cbor' (reference parity, needs cbor2),
+Formats: 'json' (reference parity), 'cbor' (reference parity — uses cbor2
+when importable, else the in-tree RFC 8949 subset codec
+`dislib_tpu.utils.cbor_lite`, byte-compatible for these payloads),
 'npz' (compact binary, numpy-native).
 
 Mid-fit checkpointing of iterative estimators (TPU preemption reality) lives
@@ -104,6 +106,17 @@ def _estimator_restore(state):
     return model
 
 
+def _cbor():
+    """cbor2 when available (interop with reference-written files), else
+    the in-tree RFC 8949 subset codec."""
+    try:
+        import cbor2
+        return cbor2
+    except ImportError:
+        from dislib_tpu.utils import cbor_lite
+        return cbor_lite
+
+
 def save_model(model, filepath: str, overwrite: bool = True,
                save_format: str = "json") -> None:
     """Persist a fitted dislib_tpu estimator (reference: utils.saving.save_model)."""
@@ -115,12 +128,8 @@ def save_model(model, filepath: str, overwrite: bool = True,
         with open(filepath, "w") as f:
             json.dump(state, f)
     elif save_format == "cbor":
-        try:
-            import cbor2
-        except ImportError as e:  # pragma: no cover - env-dependent
-            raise ImportError("cbor format requires the cbor2 package") from e
         with open(filepath, "wb") as f:
-            cbor2.dump(state, f)
+            _cbor().dump(state, f)
     elif save_format == "npz":
         flat = json.dumps(state).encode()
         np.savez_compressed(filepath, state=np.frombuffer(flat, dtype=np.uint8))
@@ -140,12 +149,8 @@ def load_model(filepath: str, load_format: str | None = None):
         with open(filepath) as f:
             state = json.load(f)
     elif load_format == "cbor":
-        try:
-            import cbor2
-        except ImportError as e:  # pragma: no cover
-            raise ImportError("cbor format requires the cbor2 package") from e
         with open(filepath, "rb") as f:
-            state = cbor2.load(f)
+            state = _cbor().load(f)
     elif load_format == "npz":
         raw = np.load(filepath)["state"].tobytes()
         state = json.loads(raw.decode())

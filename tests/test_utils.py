@@ -206,3 +206,48 @@ class TestShuffleScaling:
         if mem is not None:
             assert mem.temp_size_in_bytes < full * 4, \
                 f"per-device temp {mem.temp_size_in_bytes} ~ full operand"
+
+
+class TestCborLite:
+    def test_rfc8949_known_vectors(self):
+        """Byte-exact against RFC 8949 appendix-A examples (the encodings
+        cbor2 produces for the same values — interop is byte compatibility)."""
+        from dislib_tpu.utils import cbor_lite as c
+        vectors = [
+            (0, "00"), (10, "0a"), (23, "17"), (24, "1818"), (100, "1864"),
+            (1000, "1903e8"), (1000000, "1a000f4240"),
+            (-1, "20"), (-10, "29"), (-100, "3863"),
+            (1.1, "fb3ff199999999999a"), (-4.1, "fbc010666666666666"),
+            (False, "f4"), (True, "f5"), (None, "f6"),
+            ("", "60"), ("a", "6161"), ("IETF", "6449455446"),
+            (b"\x01\x02\x03\x04", "4401020304"),
+            ([1, 2, 3], "83010203"),
+            ({"a": 1, "b": [2, 3]}, "a26161016162820203"),
+            ([1, [2, 3], [4, 5]], "8301820203820405"),
+        ]
+        for val, hexs in vectors:
+            assert c.dumps(val).hex() == hexs, val
+            back = c.loads(bytes.fromhex(hexs))
+            assert back == val and type(back) is type(val)
+
+    def test_decoder_accepts_small_floats_rejects_indefinite(self):
+        from dislib_tpu.utils import cbor_lite as c
+        assert c.loads(bytes.fromhex("f93c00")) == 1.0       # float16
+        assert c.loads(bytes.fromhex("fa47c35000")) == 100000.0   # float32
+        with pytest.raises(ValueError):
+            c.loads(bytes.fromhex("9f01ff"))                 # indefinite list
+        with pytest.raises(ValueError):
+            c.loads(bytes.fromhex("c074"))                   # tagged item
+
+    def test_model_roundtrip_cbor(self, rng, tmp_path):
+        import dislib_tpu as ds
+        from dislib_tpu.cluster import KMeans
+        from dislib_tpu.utils import save_model, load_model
+        x = ds.array(rng.rand(60, 5).astype(np.float32))
+        km = KMeans(n_clusters=3, random_state=0).fit(x)
+        p = str(tmp_path / "model.cbor")
+        save_model(km, p, save_format="cbor")
+        km2 = load_model(p)
+        np.testing.assert_allclose(km2.centers_, km.centers_)
+        np.testing.assert_array_equal(km2.predict(x).collect(),
+                                      km.predict(x).collect())
