@@ -21,8 +21,12 @@ TPU-native redesign — no sklearn, no ragged SV sets:
   solve over all nodes of the level (the reference's task-level parallelism,
   recovered as batching).
 - Kernel values are computed **per node** from gathered rows — a node's
-  (cap, cap) sub-Gram, never the m×m Gram of the whole fit set.  Peak
-  memory is O(nodes·cap²) per level, which is what lets the cascade scale
+  (cap, cap) sub-Gram, never the m×m Gram of the whole fit set.  Level-0
+  partition height is capped (``DSLIB_CSVM_MAX_PARTITION``, default 4096)
+  so an inherited default block size of m/p cannot make level 0 quadratic
+  in m, and wide levels solve in node batches bounded by a byte budget
+  (``DSLIB_CSVM_SOLVE_BUDGET``, default 2 GiB) — peak memory per level is
+  O(batch·cap²) regardless of m, which is what lets the cascade scale
   past single-chip HBM the way the reference's partitioning does.
 """
 
@@ -111,8 +115,11 @@ class CascadeSVM(BaseEstimator):
         yv = jnp.asarray(np.pad(y_pm, (0, xv.shape[0] - m)))
 
         # level-0 partitions = row-block index chunks (reference: one SVC
-        # task per row block)
-        part = max(1, x._reg_shape[0])
+        # task per row block) — BOUNDED: a partition of p rows costs a
+        # (p, p) sub-Gram, so inheriting a huge default block size (m/p_mesh)
+        # would make level 0 quadratic in m.  The cascade exists precisely
+        # to keep solves small; cap at DSLIB_CSVM_MAX_PARTITION (4096).
+        part = min(max(1, x._reg_shape[0]), _max_partition())
         nodes0 = _pack_nodes([np.arange(s, min(s + part, m))
                               for s in range(0, m, part)])
 
@@ -181,9 +188,9 @@ class CascadeSVM(BaseEstimator):
                 nodes = nodes0
             # cascade reduction to one node
             while True:
-                alphas, objs = _solve_level(xv, yv, jnp.asarray(nodes),
-                                            float(self.c), n, self.kernel,
-                                            gamma)
+                alphas, objs = _solve_level_batched(xv, yv, nodes,
+                                                    float(self.c), n,
+                                                    self.kernel, gamma)
                 if nodes.shape[0] == 1:
                     break
                 nodes = self._merge_level(nodes, np.asarray(alphas))
@@ -280,6 +287,44 @@ class CascadeSVM(BaseEstimator):
     def _check_fitted(self):
         if not hasattr(self, "_sv_x"):
             raise RuntimeError("CascadeSVM is not fitted")
+
+
+def _max_partition() -> int:
+    import os
+    return int(os.environ.get("DSLIB_CSVM_MAX_PARTITION", 4096))
+
+
+def _solve_budget() -> int:
+    import os
+    return int(os.environ.get("DSLIB_CSVM_SOLVE_BUDGET", 2 << 30))
+
+
+def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma):
+    """`_solve_level` in node batches bounded by a byte budget.
+
+    A level's vmapped solve holds ~3 (cap, cap) f32 buffers per node
+    (K, Q, and GEMV temporaries); solving every node of a wide level at
+    once would scale per-level memory with m.  Batches are padded to a
+    fixed node count with all-invalid rows (C pinned to 0 → their alpha
+    converges to 0 immediately) so only one shape per cap compiles."""
+    n_nodes, cap = nodes.shape
+    per_node = 3 * cap * cap * 4
+    batch = max(1, _solve_budget() // per_node)
+    if n_nodes <= batch:
+        return _solve_level(xv, yv, jnp.asarray(nodes), c, n_feat, kernel,
+                            gamma)
+    alphas, objs = [], []
+    for s in range(0, n_nodes, batch):
+        chunk = nodes[s:s + batch]
+        if chunk.shape[0] < batch:
+            chunk = np.concatenate(
+                [chunk, np.full((batch - chunk.shape[0], cap), -1, np.int64)])
+        a, o = _solve_level(xv, yv, jnp.asarray(chunk), c, n_feat, kernel,
+                            gamma)
+        alphas.append(np.asarray(a))
+        objs.append(np.asarray(o))
+    return (np.concatenate(alphas)[:n_nodes],
+            np.concatenate(objs)[:n_nodes])
 
 
 def _pack_nodes(rows):

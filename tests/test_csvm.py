@@ -76,3 +76,42 @@ class TestCascadeSVM:
             CascadeSVM(kernel="poly").fit(x, y3)
         with pytest.raises(ValueError):
             CascadeSVM().fit(x, y3)
+
+
+class TestSolveBatching:
+    def test_batched_solve_is_invariant(self, rng, monkeypatch):
+        """A tiny solve budget forces one-node batches; the cascade must
+        produce the identical model (same partitions, same math)."""
+        import dislib_tpu as ds
+        from dislib_tpu.classification import CascadeSVM
+        x = rng.rand(120, 4).astype(np.float32)
+        y = (x[:, 0] > 0.5).astype(np.float32).reshape(-1, 1)
+        xa, ya = ds.array(x, block_size=(16, 4)), ds.array(y, block_size=(16, 1))
+        ref = CascadeSVM(kernel="rbf", max_iter=2, random_state=0).fit(xa, ya)
+        monkeypatch.setenv("DSLIB_CSVM_SOLVE_BUDGET", "1")
+        batched = CascadeSVM(kernel="rbf", max_iter=2, random_state=0).fit(xa, ya)
+        assert batched.support_vectors_count_ == ref.support_vectors_count_
+        np.testing.assert_array_equal(batched._sv_idx, ref._sv_idx)
+        np.testing.assert_allclose(batched._sv_alpha, ref._sv_alpha, rtol=1e-6)
+
+    def test_default_blocks_partition_is_bounded(self, rng, monkeypatch):
+        """With the mesh-default block size (m/p rows), level-0 partitions
+        must still be capped — the accidental-quadratic-Gram guard."""
+        import dislib_tpu as ds
+        from dislib_tpu.classification import CascadeSVM
+        from dislib_tpu.classification import csvm as csvm_mod
+        monkeypatch.setenv("DSLIB_CSVM_MAX_PARTITION", "32")
+        x = rng.rand(400, 4).astype(np.float32)
+        y = (x[:, 0] + x[:, 1] > 1.0).astype(np.float32).reshape(-1, 1)
+        xa, ya = ds.array(x), ds.array(y)   # default blocks: 400/8 = 50 > 32
+        seen = []
+        real = csvm_mod._solve_level_batched
+
+        def spy(xv, yv, nodes, *a, **k):
+            seen.append(nodes.shape)
+            return real(xv, yv, nodes, *a, **k)
+
+        monkeypatch.setattr(csvm_mod, "_solve_level_batched", spy)
+        model = CascadeSVM(kernel="linear", max_iter=1).fit(xa, ya)
+        assert seen[0][1] <= 64, f"level-0 cap {seen[0][1]} not bounded"
+        assert model.score(xa, ya) > 0.9
