@@ -14,7 +14,9 @@ the result; XLA emits the SUMMA-style collective_permute/all_gather pattern
 over ICI (the survey's §4.3 TPU mapping).  Zero padding makes the contraction
 exact with no masking.  `svd` keeps the reference's one-sided Jacobi
 *algorithm* (it is communication-friendly and converges quadratically) but
-runs the rotation sweeps as jitted device loops over column pairs.
+runs the rotation sweeps as jitted device loops — scalar column pairs at
+small n, the reference's column-BLOCK pairs (batched QR + small SVD per
+pair, MXU-shaped) at n ≥ 128.
 """
 
 from __future__ import annotations
@@ -131,23 +133,41 @@ def _kron_kernel(ap, bp, shapes, pshape):
 
 def svd(a: Array, compute_uv: bool = True, sort: bool = True,
         copy: bool = True, eps: float = 1e-9, max_sweeps: int = 30):
-    """One-sided Jacobi SVD (reference: dislib.math.svd — round-robin column
-    pair rotations until all pairs are ε-orthogonal).
+    """One-sided Jacobi SVD (reference: dislib.math.svd — round-robin
+    rotations of column pairs until all pairs are ε-orthogonal; the
+    reference pairs column BLOCKS, SURVEY §3.2 svd row).
 
     Returns (U, S, V) ds-arrays with S of shape (1, n) — or S alone when
-    ``compute_uv=False``.  The sweep loop runs on device in a while_loop; the
-    rotation of column pairs is batched over all pairs of a round-robin round
-    (each column index appears in exactly one pair per round, so rotations in
-    a round commute — the same property the reference's task graph exploits
-    for parallelism across pairs)."""
+    ``compute_uv=False``.  The sweep loop runs on device in a while_loop.
+    Two tiers, both batching every disjoint pair of a round-robin round:
+
+    - n < 2·64: scalar column pairs, one Givens rotation per pair.
+    - n ≥ 2·64: the reference's COLUMN-BLOCK pairing — per pair, the
+      (2b, 2b) Gram of the two blocks, one batched ``eigh``, and a tall
+      (m, 2b) GEMM apply.  A sweep is n/b−1 rounds instead of n−1, and
+      every round is MXU-shaped GEMM work instead of skinny
+      gather/scatter — the block structure is exactly why the reference
+      chose block pairs too.  For rank-deficient input the null-space
+      columns of V (σ = 0) are implementation-defined on this tier;
+      singular vectors for σ > 0 are exact.
+    """
     m, n = a.shape
     # Operate on the full padded backing: pad rows/cols are zero under the
     # pad-and-mask invariant, so they contribute nothing to column dot
     # products and their rotations are exact no-ops (off-diagonal = 0) —
     # the input stays row-sharded on the mesh instead of being gathered by
     # an eager logical slice (round-2 fix for the replicated-SVD ceiling).
-    u, s, v = _jacobi_svd(a._data.astype(jnp.float32), n, sort, eps,
-                          max_sweeps)
+    # the kernels run float32: an eps below f32's pairwise-orthogonality
+    # floor (~5e-8 observed) is unreachable and would burn max_sweeps in
+    # full every call — clamp to a floor a converged f32 sweep does reach
+    # (the reference's 1e-9 default presumes float64 blocks)
+    eps = max(float(eps), 1e-6)
+    if a._data.shape[1] >= 2 * _JACOBI_BLOCK:
+        u, s, v = _jacobi_svd_block(a._data.astype(jnp.float32), n, sort,
+                                    eps, max_sweeps)
+    else:
+        u, s, v = _jacobi_svd(a._data.astype(jnp.float32), n, sort, eps,
+                              max_sweeps)
     s_arr = Array._from_logical(s[:n].reshape(1, -1))
     if not compute_uv:
         return s_arr
@@ -220,6 +240,100 @@ def _jacobi_svd(a, n_valid, sort, eps, max_sweeps):
         u = u[:, order]
         v = v[:, order]
     return u, s, v
+
+
+_JACOBI_BLOCK = 64
+
+
+@partial(jax.jit, static_argnames=("n_valid", "sort", "max_sweeps"))
+@precise
+def _jacobi_svd_block(a, n_valid, sort, eps, max_sweeps):
+    """One-sided BLOCK Jacobi: round-robin over column blocks of width b.
+
+    Per disjoint block pair (I, J), batched over the round's pairs:
+    W = [U_I | U_J] is factored W = Q_w R (one batched tall QR), the
+    small R gets a batched SVD R = U_r Σ V_rᵀ, and the pair updates are
+    U_pair ← Q_w U_r Σ (tall GEMM) and V_pair ← V_pair V_r.  V_r is
+    orthogonal, so this is a valid one-sided Jacobi step, and — unlike
+    the Gram+eigh formulation — the new columns are orthogonal to
+    machine precision INDEPENDENT of the pair's conditioning (a Gram
+    eigh's residual scales with λmax, wrecking small-σ columns; R's SVD
+    is σ-relative).  Convergence follows the same cyclic-Jacobi argument
+    as the scalar tier, measured on G = RᵀR.  Zero (padding) columns
+    stay exactly zero (σ = 0 scales them out); V starts with pad columns
+    zeroed (not identity) so degenerate null-space shuffling moves only
+    zeros.  Column order migrates across rounds (each pair sorts by σ);
+    the final global sort restores it, and positions ≥ n_valid are
+    re-masked after the sort.
+    """
+    m, n_in = a.shape
+    b = _JACOBI_BLOCK
+    nb = -(-n_in // b)
+    n = nb * b
+    u0 = jnp.pad(a, ((0, 0), (0, n - n_in)))
+    col_ok0 = lax.broadcasted_iota(jnp.int32, (n,), 0) < n_valid
+    v0 = jnp.eye(n, dtype=a.dtype) * col_ok0[None, :].astype(a.dtype)
+    pairs = _round_robin_pairs(nb)            # (rounds, width, 2) block ids
+    shard = _mesh.data_sharding()
+
+    def rotate_round(carry, pr):
+        u, v = carry
+        i, j = pr[:, 0], pr[:, 1]                                # (w,)
+        ur = u.reshape(m, nb, b)
+        vr = v.reshape(n, nb, b)
+        w_u = jnp.concatenate([ur[:, i], ur[:, j]], axis=-1)     # (m, w, 2b)
+        qw, r = jnp.linalg.qr(w_u.transpose(1, 0, 2),
+                              mode="reduced")      # (w, m, 2b), (w, 2b, 2b)
+        g = jnp.einsum("wki,wkj->wij", r, r)       # G = RᵀR, small
+        d = jnp.diagonal(g, axis1=1, axis2=2)
+        # clamp the PRODUCT, not the factors: clamped factors of 1e-30
+        # multiply to exactly 0 in f32 (underflow) and 0/0 = NaN — a NaN
+        # off makes `off > eps` false and silently ends the sweep loop
+        # after one iteration (the scalar tier's formula, same reason)
+        denom = jnp.sqrt(jnp.maximum(d[:, :, None] * d[:, None, :], 1e-30))
+        off_d = jnp.where(jnp.eye(2 * b, dtype=bool)[None],
+                          0.0, jnp.abs(g) / denom)
+        u_r, s_r, vh = jnp.linalg.svd(r)           # batched (2b, 2b) SVD
+        u_new = jnp.einsum("wmi,wij->mwj", qw, u_r * s_r[:, None, :])
+        w_v = jnp.concatenate([vr[:, i], vr[:, j]], axis=-1)
+        v_new = jnp.einsum("nwi,wji->nwj", w_v, vh)              # V · V_r
+        # a duplicated (padding) pair in a round recomputes the identical
+        # q from the identical pre-round blocks — the duplicate .set
+        # writes identical values (idempotent), as in the scalar tier
+        u = ur.at[:, i].set(u_new[..., :b]).at[:, j].set(u_new[..., b:]) \
+            .reshape(m, n)
+        v = vr.at[:, i].set(v_new[..., :b]).at[:, j].set(v_new[..., b:]) \
+            .reshape(n, n)
+        return (u, v), jnp.max(off_d)
+
+    def sweep(carry):
+        u, v, _, it = carry
+        (u, v), offs = lax.scan(rotate_round, (u, v), pairs)
+        u = lax.with_sharding_constraint(u, shard)
+        return u, v, jnp.max(offs), it + 1
+
+    def cond(carry):
+        _, _, off, it = carry
+        return (off > eps) & (it < max_sweeps)
+
+    u, v, _, _ = lax.while_loop(cond, sweep,
+                                (u0, v0, jnp.asarray(jnp.inf), 0))
+    s = jnp.linalg.norm(u, axis=0)
+    u = u / jnp.where(s < 1e-30, 1.0, s)[None, :]
+    if sort:
+        order = jnp.argsort(-s, stable=True)
+        s = s[order]
+        u = u[:, order]
+        v = v[:, order]
+    # post-sort positional mask: σ>0 columns sort into [0, rank); anything
+    # at positions ≥ n_valid is padding or null space — zero it to restore
+    # the pad-and-mask invariant of the returned canvases
+    keep = lax.broadcasted_iota(jnp.int32, (n,), 0) < n_valid
+    s = jnp.where(keep, s, 0.0)
+    u = u * keep[None, :].astype(u.dtype)
+    v = v * (keep[None, :] & (lax.broadcasted_iota(jnp.int32, (n, 1), 0)
+                              < n_valid)).astype(v.dtype)
+    return u[:, :n_in], s[:n_in], v[:n_in, :n_in]
 
 
 def _round_robin_pairs(n):

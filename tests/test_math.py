@@ -309,3 +309,22 @@ class TestPCA:
         sk = SkPCA(n_components=3).fit(x)
         np.testing.assert_allclose(p.explained_variance_.collect().ravel(),
                                    sk.explained_variance_, rtol=1e-3)
+
+
+class TestBlockJacobiSVD:
+    def test_block_tier_matches_numpy(self, rng):
+        # n >= 2*_JACOBI_BLOCK engages the block tier; include a ragged n
+        # so the zero pad block exercises the NaN-proof off metric
+        for (m, n) in [(300, 130), (200, 150)]:
+            x = rng.rand(m, n).astype(np.float32)
+            u, s, v = ds.svd(ds.array(x))
+            uc, sc, vc = u.collect(), np.asarray(s.collect()).ravel(), v.collect()
+            s_ref = np.linalg.svd(x, compute_uv=False)
+            np.testing.assert_allclose(sc, s_ref, rtol=1e-4, atol=1e-4)
+            np.testing.assert_allclose(uc @ np.diag(sc) @ vc.T, x, atol=1e-3)
+            np.testing.assert_allclose(uc.T @ uc, np.eye(n), atol=1e-3)
+            np.testing.assert_allclose(vc.T @ vc, np.eye(n), atol=1e-3)
+
+    def test_block_tier_engaged(self):
+        from dislib_tpu.math.base import _JACOBI_BLOCK
+        assert 130 >= 2 * _JACOBI_BLOCK  # shapes above actually take the tier
