@@ -41,13 +41,15 @@ _PROBE_TIMEOUT_S = int(os.environ.get("DSLIB_BENCH_PROBE_S", "60"))
 
 
 def _smoke_wants_cpu() -> bool:
-    """Smoke mode forces the CPU platform unless the caller EXPLICITLY
-    requested a different one.  ``JAX_PLATFORMS=axon`` is this box's
-    session-wide default export (the TPU tunnel), not a caller request —
-    honouring it would make `BENCH_SMOKE=1 python bench.py` hang on a
-    wedged tunnel, which smoke mode exists to avoid.  Test hooks inject
-    probe failures by setting a non-axon platform."""
-    return os.environ.get("JAX_PLATFORMS", "axon") == "axon"
+    """True when smoke mode should force the CPU platform: BENCH_SMOKE is
+    on and the caller did not EXPLICITLY request a different platform.
+    ``JAX_PLATFORMS=axon`` is this box's session-wide default export (the
+    TPU tunnel), not a caller request — honouring it would make
+    `BENCH_SMOKE=1 python bench.py` hang on a wedged tunnel, which smoke
+    mode exists to avoid.  Test hooks inject probe failures by setting a
+    non-axon platform."""
+    return bool(os.environ.get("BENCH_SMOKE")) and \
+        os.environ.get("JAX_PLATFORMS", "axon") == "axon"
 
 
 def _median_time(fn, repeats=5):
@@ -429,7 +431,7 @@ def _run_one(name):
     if name in os.environ.get("DSLIB_BENCH_FAKE_HANG", "").split(","):
         time.sleep(10_000)
     try:
-        if os.environ.get("BENCH_SMOKE") and _smoke_wants_cpu():
+        if _smoke_wants_cpu():
             # smoke mode validates the harness WITHOUT the chip; the platform
             # must be forced in-process before backend init (JAX_PLATFORMS is
             # ignored by the axon sitecustomize — round-1 post-mortem).
@@ -455,7 +457,7 @@ def main():
     # fast probe: a dead tunnel is detected in _PROBE_TIMEOUT_S, not per-
     # config watchdog time.  The parent process never imports jax, so it
     # can always report and exit cleanly.
-    if os.environ.get("BENCH_SMOKE") and _smoke_wants_cpu():
+    if _smoke_wants_cpu():
         probe_src = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
                      "jax.devices()")
     else:

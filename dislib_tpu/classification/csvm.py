@@ -32,6 +32,7 @@ TPU-native redesign — no sklearn, no ragged SV sets:
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -290,12 +291,10 @@ class CascadeSVM(BaseEstimator):
 
 
 def _max_partition() -> int:
-    import os
     return int(os.environ.get("DSLIB_CSVM_MAX_PARTITION", 4096))
 
 
 def _solve_budget() -> int:
-    import os
     return int(os.environ.get("DSLIB_CSVM_SOLVE_BUDGET", 2 << 30))
 
 

@@ -142,8 +142,8 @@ def svd(a: Array, compute_uv: bool = True, sort: bool = True,
     Two tiers, both batching every disjoint pair of a round-robin round:
 
     - n < 2·64: scalar column pairs, one Givens rotation per pair.
-    - n ≥ 2·64: the reference's COLUMN-BLOCK pairing — per pair, the
-      (2b, 2b) Gram of the two blocks, one batched ``eigh``, and a tall
+    - n ≥ 2·64: the reference's COLUMN-BLOCK pairing — per pair, one
+      batched tall QR, a small SVD of R, and a tall
       (m, 2b) GEMM apply.  A sweep is n/b−1 rounds instead of n−1, and
       every round is MXU-shaped GEMM work instead of skinny
       gather/scatter — the block structure is exactly why the reference
@@ -331,8 +331,7 @@ def _jacobi_svd_block(a, n_valid, sort, eps, max_sweeps):
     keep = lax.broadcasted_iota(jnp.int32, (n,), 0) < n_valid
     s = jnp.where(keep, s, 0.0)
     u = u * keep[None, :].astype(u.dtype)
-    v = v * (keep[None, :] & (lax.broadcasted_iota(jnp.int32, (n, 1), 0)
-                              < n_valid)).astype(v.dtype)
+    v = v * (keep[None, :] & keep[:, None]).astype(v.dtype)
     return u[:, :n_in], s[:n_in], v[:n_in, :n_in]
 
 

@@ -38,22 +38,28 @@ def shuffle(x: Array, y: Array | None = None, random_state=None):
     rng = random_state if isinstance(random_state, np.random.RandomState) \
         else np.random.RandomState(random_state)
     perm = rng.permutation(x.shape[0])
-    xs = _apply_perm(x, perm)
+    if y is not None and y.shape[0] != x.shape[0]:
+        raise ValueError("x and y must have the same number of rows")
+    xs, plan = _apply_perm(x, perm)
     if y is None:
         return xs
-    if y.shape[0] != x.shape[0]:
-        raise ValueError("x and y must have the same number of rows")
-    return xs, _apply_perm(y, perm)
+    # y has the same padded row count (one mesh quantum), so it reuses x's
+    # routing plan instead of re-planning the identical exchange
+    ys, _ = _apply_perm(y, perm, plan)
+    return xs, ys
 
 
-def _apply_perm(x: Array, perm: np.ndarray) -> Array:
+def _apply_perm(x: Array, perm: np.ndarray, plan=None):
+    """Apply ``out[i] = x[perm[i]]`` via the exchange; returns (Array, plan)
+    so a same-length companion array can reuse the routing plan."""
     mesh = _mesh.get_mesh()
     p = mesh.shape[_mesh.ROWS]
     m_loc = x._data.shape[0] // p
-    send_idx, dst_idx = _routing(perm, m_loc, p)
-    out = _shuffle_exchange(x._data, jnp.asarray(send_idx),
-                            jnp.asarray(dst_idx), mesh, p)
-    return Array(out, x._shape, x._reg_shape, x._sparse)
+    if plan is None:
+        send_idx, dst_idx = _routing(perm, m_loc, p)
+        plan = (jnp.asarray(send_idx), jnp.asarray(dst_idx))
+    out = _shuffle_exchange(x._data, plan[0], plan[1], mesh, p)
+    return Array(out, x._shape, x._reg_shape, x._sparse), plan
 
 
 def _routing(perm, m_loc, p):
@@ -81,9 +87,8 @@ def _routing(perm, m_loc, p):
     dst_idx = np.full((p, p, cap), m_loc, np.int32)
     # slot index of each routed row within its (s, d) bucket
     flat = s_sorted * p + d_sorted
-    starts = np.zeros(p * p, np.int64)
-    np.add.at(starts, flat, 1)
-    starts = np.concatenate([[0], np.cumsum(starts)[:-1]])
+    bucket_sizes = np.bincount(flat, minlength=p * p)
+    starts = np.concatenate([[0], np.cumsum(bucket_sizes)[:-1]])
     slot = np.arange(m) - starts[flat]
     send_idx[s_sorted, d_sorted, slot] = (src[order] % m_loc).astype(np.int32)
     dst_idx[d_sorted, s_sorted, slot] = (i[order] % m_loc).astype(np.int32)
@@ -124,11 +129,11 @@ def train_test_split(x: Array, y: Array | None = None, test_size: float = 0.25,
     # permute once via the bounded all-to-all exchange, then take contiguous
     # row slices — identical values to fancy-gathering perm[:n_train] etc.,
     # without a full-size gather per split
-    xs = _apply_perm(x, perm)
+    xs, plan = _apply_perm(x, perm)
     if y is None:
         return xs[:n_train, :], xs[n_train:n_train + n_test, :]
     if y.shape[0] != n:
         raise ValueError("x and y must have the same number of rows")
-    ys = _apply_perm(y, perm)
+    ys, _ = _apply_perm(y, perm, plan)
     return (xs[:n_train, :], xs[n_train:n_train + n_test, :],
             ys[:n_train, :], ys[n_train:n_train + n_test, :])
