@@ -251,3 +251,11 @@ class TestCborLite:
         np.testing.assert_allclose(km2.centers_, km.centers_)
         np.testing.assert_array_equal(km2.predict(x).collect(),
                                       km.predict(x).collect())
+
+    def test_large_lengths_roundtrip(self):
+        from dislib_tpu.utils import cbor_lite as c
+        big = {"s": "x" * 70_000,                   # 4-byte text length
+               "b": bytes(range(256)) * 300,        # 2-byte bytes length
+               "l": list(range(700)),               # 2-byte array length
+               "i": [2**40, -(2**40), 2**63 - 1, -(2**63)]}
+        assert c.loads(c.dumps(big)) == big
