@@ -328,3 +328,21 @@ class TestBlockJacobiSVD:
     def test_block_tier_engaged(self):
         from dislib_tpu.math.base import _JACOBI_BLOCK
         assert 130 >= 2 * _JACOBI_BLOCK  # shapes above actually take the tier
+
+    def test_block_tier_ill_conditioned(self, rng):
+        """6-decade geometric spectrum: errors stay at the f32 floor
+        relative to sigma_max, orthogonality at machine precision, no NaN
+        (the QR+small-SVD pair solve is conditioning-independent)."""
+        m, n = 600, 192
+        u0, _ = np.linalg.qr(rng.standard_normal((m, n)))
+        v0, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        sv = np.logspace(3, -3, n).astype(np.float32)
+        x = ((u0 * sv) @ v0.T).astype(np.float32)
+        u, s, v = ds.svd(ds.array(x))
+        sc = np.asarray(s.collect()).ravel()
+        s_ref = np.linalg.svd(x, compute_uv=False)
+        assert not np.isnan(sc).any()
+        assert np.abs(sc - s_ref).max() / s_ref[0] < 1e-4
+        uc, vc = u.collect(), v.collect()
+        np.testing.assert_allclose(uc.T @ uc, np.eye(n), atol=1e-4)
+        np.testing.assert_allclose(vc.T @ vc, np.eye(n), atol=1e-4)
