@@ -20,6 +20,12 @@ TPU-native redesign — no sklearn, no ragged SV sets:
   at 0 and they can never become SVs.  Each cascade level is ONE `vmap`-ed
   solve over all nodes of the level (the reference's task-level parallelism,
   recovered as batching).
+- **Sparse-native** (SURVEY §8 hard part 2): a `SparseArray` fit keeps a
+  host CSR copy (O(nnz) — the layout the reference's per-partition SVC
+  tasks consume on CPU workers) and stages each node batch's sub-Gram
+  with one sparse GEMM; the dual solves run on device from the
+  precomputed K, and sparse queries classify via one spmm cross-term.
+  The full matrix is never densified on either side of the fit.
 - Kernel values are computed **per node** from gathered rows — a node's
   (cap, cap) sub-Gram, never the m×m Gram of the whole fit set.  Level-0
   partition height is capped (``DSLIB_CSVM_MAX_PARTITION``, default 4096)
@@ -112,8 +118,23 @@ class CascadeSVM(BaseEstimator):
         y_pm = np.where(y_host == classes[1], 1.0, -1.0).astype(np.float32)
 
         gamma = self._gamma_value(n)
-        xv = x._data
-        yv = jnp.asarray(np.pad(y_pm, (0, xv.shape[0] - m)))
+        # SPARSE-NATIVE path (SURVEY §8 hard part 2): the matrix is never
+        # densified.  A host CSR copy (O(nnz), the same layout the
+        # reference's per-partition SVC tasks consume on CPU workers)
+        # stages each node batch's sub-Gram; the boxed-dual solves stay on
+        # device.  Dense inputs keep the all-device gather path.
+        from dislib_tpu.data.sparse import SparseArray
+        sparse_in = isinstance(x, SparseArray)
+        if sparse_in:
+            x_csr = x.collect().tocsr()
+            rowsq = np.asarray(x_csr.multiply(x_csr).sum(axis=1),
+                               dtype=np.float32).ravel()
+            k_of = _host_gram(x_csr, rowsq, self.kernel, gamma)
+            xv = yv = None
+        else:
+            x_csr = k_of = None
+            xv = x._data
+            yv = jnp.asarray(np.pad(y_pm, (0, xv.shape[0] - m)))
 
         # level-0 partitions = row-block index chunks (reference: one SVC
         # task per row block) — BOUNDED: a partition of p rows costs a
@@ -147,11 +168,17 @@ class CascadeSVM(BaseEstimator):
                              float(self.cascade_arity),
                              float(("rbf", "linear").index(self.kernel)),
                              float(part)], np.float64)
-            riota = jnp.arange(xv.shape[0], dtype=jnp.float32)
+            if sparse_in:
+                x_sum = float(x_csr.sum())
+                x_rowsum = float(np.arange(m, dtype=np.float64)
+                                 @ np.asarray(x_csr.sum(axis=1)).ravel())
+            else:
+                riota = jnp.arange(xv.shape[0], dtype=jnp.float32)
+                x_sum = float(jax.device_get(jnp.sum(xv)))
+                x_rowsum = float(jax.device_get(
+                    jnp.einsum("ij,i->", xv, riota)))
             digest = np.asarray(
-                [float(jax.device_get(jnp.sum(xv))),
-                 float(jax.device_get(jnp.einsum("ij,i->", xv, riota))),
-                 float(y_pm.sum()),
+                [x_sum, x_rowsum, float(y_pm.sum()),
                  float(y_pm @ np.arange(m, dtype=np.float64))], np.float64)
             snap = checkpoint.load()
             if snap is not None:
@@ -191,7 +218,8 @@ class CascadeSVM(BaseEstimator):
             while True:
                 alphas, objs = _solve_level_batched(xv, yv, nodes,
                                                     float(self.c), n,
-                                                    self.kernel, gamma)
+                                                    self.kernel, gamma,
+                                                    k_of=k_of, y_host=y_pm)
                 if nodes.shape[0] == 1:
                     break
                 nodes = self._merge_level(nodes, np.asarray(alphas))
@@ -236,9 +264,13 @@ class CascadeSVM(BaseEstimator):
 
         self.iterations_n = self.n_iter_ = it
         self._sv_idx = sv_idx
-        # gather SV rows on device, then fetch only those (not the dataset)
-        self._sv_x = np.asarray(jax.device_get(
-            x._data[jnp.asarray(sv_idx), : n]))
+        # gather SV rows only (n_sv × n, never the dataset): from the host
+        # CSR on the sparse path, on device for dense inputs
+        if sparse_in:
+            self._sv_x = np.asarray(x_csr[sv_idx].toarray(), np.float32)
+        else:
+            self._sv_x = np.asarray(jax.device_get(
+                x._data[jnp.asarray(sv_idx), : n]))
         self._sv_y = y_pm[sv_idx]
         self._gamma_fit = gamma
         self.support_vectors_count_ = len(sv_idx)
@@ -265,9 +297,20 @@ class CascadeSVM(BaseEstimator):
 
     def decision_function(self, x: Array) -> Array:
         self._check_fitted()
-        dec = _decision(x._data, x.shape, jnp.asarray(self._sv_x),
-                        jnp.asarray(self._sv_y), jnp.asarray(self._sv_alpha),
-                        self.kernel, self._gamma_fit)
+        from dislib_tpu.data.sparse import SparseArray
+        if isinstance(x, SparseArray):
+            # sparse queries: cross-term as one spmm against the (small)
+            # dense SV block — the query matrix never densifies
+            dec = _decision_sparse(x._bcoo, x.row_norms_sq(),
+                                   jnp.asarray(self._sv_x),
+                                   jnp.asarray(self._sv_y),
+                                   jnp.asarray(self._sv_alpha),
+                                   self.kernel, self._gamma_fit)
+        else:
+            dec = _decision(x._data, x.shape, jnp.asarray(self._sv_x),
+                            jnp.asarray(self._sv_y),
+                            jnp.asarray(self._sv_alpha),
+                            self.kernel, self._gamma_fit)
         return Array._from_logical_padded(_repad(dec, (x.shape[0], 1)),
                                           (x.shape[0], 1))
 
@@ -298,28 +341,68 @@ def _solve_budget() -> int:
     return int(os.environ.get("DSLIB_CSVM_SOLVE_BUDGET", 2 << 30))
 
 
-def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma):
-    """`_solve_level` in node batches bounded by a byte budget.
+def _host_gram(csr, rowsq, kernel, gamma):
+    """Sub-Gram stager for the sparse path: per node, slice the node's rows
+    out of the host CSR (the reference's per-partition data movement) and
+    compute its (cap, cap) kernel block with one sparse GEMM — the full
+    matrix is never densified; the dense footprint is the sub-Gram the
+    dual solve needs anyway.  Padded node slots stay zero rows (their C is
+    pinned to 0 in the solve)."""
+    def k_of(nodes_chunk):
+        w, cap = nodes_chunk.shape
+        k = np.zeros((w, cap, cap), np.float32)
+        for t in range(w):
+            idx = nodes_chunk[t][nodes_chunk[t] >= 0]
+            if not len(idx):
+                continue
+            sub = csr[idx]
+            cross = np.asarray((sub @ sub.T).todense(), dtype=np.float32)
+            if kernel == "rbf":
+                rq = rowsq[idx]
+                cross = np.exp(-gamma * np.maximum(
+                    rq[:, None] + rq[None, :] - 2.0 * cross, 0.0))
+            nv = len(idx)
+            k[t, :nv, :nv] = cross
+        return k
+    return k_of
+
+
+def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma,
+                         k_of=None, y_host=None):
+    """One cascade level in node batches bounded by a byte budget.
 
     A level's vmapped solve holds ~3 (cap, cap) f32 buffers per node
     (K, Q, and GEMV temporaries); solving every node of a wide level at
     once would scale per-level memory with m.  Batches are padded to a
     fixed node count with all-invalid rows (C pinned to 0 → their alpha
-    converges to 0 immediately) so only one shape per cap compiles."""
+    converges to 0 immediately) so only one shape per cap compiles.
+    ``k_of`` (sparse path) stages each batch's kernel blocks host-side;
+    the device then runs the same dual ascent on the precomputed K."""
     n_nodes, cap = nodes.shape
     per_node = 3 * cap * cap * 4
-    batch = max(1, _solve_budget() // per_node)
-    if n_nodes <= batch:
-        return _solve_level(xv, yv, jnp.asarray(nodes), c, n_feat, kernel,
-                            gamma)
+    batch = min(n_nodes, max(1, _solve_budget() // per_node))
+
+    def solve_chunk(chunk):
+        if k_of is None:
+            return _solve_level(xv, yv, jnp.asarray(chunk), c, n_feat,
+                                kernel, gamma)
+        valid = chunk >= 0
+        k_sub = k_of(chunk)
+        y_sub = np.where(valid, y_host[np.maximum(chunk, 0)], 0.0) \
+            .astype(np.float32)
+        c_vec = np.where(valid, c, 0.0).astype(np.float32)
+        return _solve_level_k(jnp.asarray(k_sub), jnp.asarray(y_sub),
+                              jnp.asarray(c_vec))
+
+    if n_nodes <= batch and k_of is None:
+        return solve_chunk(nodes)
     alphas, objs = [], []
     for s in range(0, n_nodes, batch):
         chunk = nodes[s:s + batch]
         if chunk.shape[0] < batch:
             chunk = np.concatenate(
                 [chunk, np.full((batch - chunk.shape[0], cap), -1, np.int64)])
-        a, o = _solve_level(xv, yv, jnp.asarray(chunk), c, n_feat, kernel,
-                            gamma)
+        a, o = solve_chunk(chunk)
         alphas.append(np.asarray(a))
         objs.append(np.asarray(o))
     return (np.concatenate(alphas)[:n_nodes],
@@ -348,6 +431,31 @@ def _gram(a, b, kernel, gamma):
     return a @ b.T
 
 
+def _dual_ascent(q, c_vec):
+    """Box-constrained projected gradient ascent on one node's dual
+    (shared by the gathered-rows and precomputed-K solvers)."""
+    eta = 1.0 / jnp.maximum(jnp.max(jnp.sum(jnp.abs(q), axis=1)), 1e-12)
+
+    def body(carry):
+        alpha, i, _ = carry
+        grad = 1.0 - q @ alpha
+        new = jnp.clip(alpha + eta * grad, 0.0, c_vec)
+        delta = jnp.max(jnp.abs(new - alpha))
+        return new, i + 1, delta
+
+    def cond(carry):
+        _, i, delta = carry
+        return (i < 500) & (delta > 1e-6)
+
+    alpha0 = jnp.zeros_like(c_vec)
+    alpha, _, _ = lax.while_loop(cond, body, (alpha0, jnp.int32(0),
+                                              jnp.float32(jnp.inf)))
+    # dual objective on the Q this solve already holds — callers read
+    # the top node's value for the convergence check
+    obj = jnp.sum(alpha) - 0.5 * alpha @ (q @ alpha)
+    return alpha, obj
+
+
 @partial(jax.jit, static_argnames=("n_feat", "kernel"))
 @precise
 def _solve_level(xv, yv, nodes, c, n_feat, kernel, gamma):
@@ -363,28 +471,34 @@ def _solve_level(xv, yv, nodes, c, n_feat, kernel, gamma):
         y_sub = yv[safe]
         q = k_sub * (y_sub[:, None] * y_sub[None, :])
         c_vec = jnp.where(valid, c, 0.0)            # padded slots pinned at 0
-        eta = 1.0 / jnp.maximum(jnp.max(jnp.sum(jnp.abs(q), axis=1)), 1e-12)
-
-        def body(carry):
-            alpha, i, _ = carry
-            grad = 1.0 - q @ alpha
-            new = jnp.clip(alpha + eta * grad, 0.0, c_vec)
-            delta = jnp.max(jnp.abs(new - alpha))
-            return new, i + 1, delta
-
-        def cond(carry):
-            _, i, delta = carry
-            return (i < 500) & (delta > 1e-6)
-
-        alpha0 = jnp.zeros_like(y_sub)
-        alpha, _, _ = lax.while_loop(cond, body, (alpha0, jnp.int32(0),
-                                                  jnp.float32(jnp.inf)))
-        # dual objective on the Q this solve already holds — callers read
-        # the top node's value for the convergence check
-        obj = jnp.sum(alpha) - 0.5 * alpha @ (q @ alpha)
-        return alpha, obj
+        return _dual_ascent(q, c_vec)
 
     return jax.vmap(solve_one)(nodes)
+
+
+@jax.jit
+@precise
+def _solve_level_k(k_sub, y_sub, c_vec):
+    """Same dual solves on host-staged kernel blocks (the sparse path)."""
+    def solve_one(k1, y1, cv):
+        q = (k1 + 1.0) * (y1[:, None] * y1[None, :])
+        return _dual_ascent(q, cv)
+    return jax.vmap(solve_one)(k_sub, y_sub, c_vec)
+
+
+@partial(jax.jit, static_argnames=("kernel",))
+@precise
+def _decision_sparse(bcoo, rowsq, sv_x, sv_y, sv_alpha, kernel, gamma):
+    """Decision values for sparse queries: cross = one spmm (m, n_sv)."""
+    from dislib_tpu.data.sparse import _spmm
+    cross = _spmm(bcoo, sv_x.T)
+    if kernel == "rbf":
+        sv_sq = jnp.sum(sv_x * sv_x, axis=1)
+        k = jnp.exp(-gamma * jnp.maximum(
+            rowsq[:, None] - 2.0 * cross + sv_sq[None, :], 0.0))
+    else:
+        k = cross
+    return ((k + 1.0) @ (sv_alpha * sv_y))[:, None]
 
 
 @partial(jax.jit, static_argnames=("q_shape", "kernel"))

@@ -27,8 +27,11 @@ TPU-native design and its honest limits:
     contractions).
   * ALS — dense-with-mask (see `recommendation/als.py`: a zero rating IS
     the mask; the normal-equation GEMMs need the dense mask anyway).
-  * CascadeSVM / trees / others — densify (`to_dense()`); same stance as
-    the reference's per-block `.toarray()` escape hatches.
+  * CascadeSVM — sparse-native: host-CSR-staged per-node sub-Grams feed
+    the device dual solves; queries classify via one spmm cross-term
+    (`classification/csvm.py`).
+  * trees / others — densify (`to_dense()`); same stance as the
+    reference's per-block `.toarray()` escape hatches.
 """
 
 from __future__ import annotations
@@ -79,7 +82,8 @@ class SparseArray:
                     f"~{need / 2**30:.1f} GiB (> budget "
                     f"{budget / 2**30:.1f} GiB). This estimator has no "
                     "sparse-native path; use a sparse-aware one (KMeans, "
-                    "NearestNeighbors, KNeighborsClassifier, ALS, scalers) "
+                    "NearestNeighbors, KNeighborsClassifier, CascadeSVM, "
+                    "ALS, scalers) "
                     "or raise DSLIB_SPARSE_DENSIFY_BUDGET to densify "
                     "anyway.")
             self._dense_cache = self.to_dense()._data

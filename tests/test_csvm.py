@@ -115,3 +115,57 @@ class TestSolveBatching:
         model = CascadeSVM(kernel="linear", max_iter=1).fit(xa, ya)
         assert seen[0][1] <= 64, f"level-0 cap {seen[0][1]} not bounded"
         assert model.score(xa, ya) > 0.9
+
+
+class TestSparseNative:
+    def _blobs(self, rng, m=240, nf=40):
+        x = np.zeros((m, nf), np.float32)
+        half = m // 2
+        for i in range(m):
+            feats = rng.choice(nf // 2, 4, replace=False) \
+                + (0 if i < half else nf // 2)
+            x[i, feats] = 1.0 + rng.rand(4).astype(np.float32)
+        y = np.r_[np.zeros(half), np.ones(half)].astype(np.float32)
+        p = rng.permutation(m)
+        return x[p], y[p]
+
+    @pytest.mark.parametrize("kern", ["rbf", "linear"])
+    def test_matches_dense_path(self, rng, kern):
+        import scipy.sparse as sp
+        import dislib_tpu as ds
+        from dislib_tpu.classification import CascadeSVM
+        from dislib_tpu.data.sparse import SparseArray
+        x, yv = self._blobs(rng)
+        xd = ds.array(x, block_size=(48, x.shape[1]))
+        xs = SparseArray.from_scipy(sp.csr_matrix(x),
+                                    block_size=(48, x.shape[1]))
+        ya = ds.array(yv.reshape(-1, 1))
+        md = CascadeSVM(kernel=kern, max_iter=2,
+                        check_convergence=False).fit(xd, ya)
+        ms = CascadeSVM(kernel=kern, max_iter=2,
+                        check_convergence=False).fit(xs, ya)
+        np.testing.assert_array_equal(ms.predict(xs).collect(),
+                                      md.predict(xd).collect())
+        # a fitted-on-sparse model also classifies dense queries (and
+        # vice versa) identically
+        np.testing.assert_array_equal(ms.predict(xd).collect(),
+                                      ms.predict(xs).collect())
+        assert ms.score(xs, ya) == 1.0
+
+    def test_never_densifies(self, rng, monkeypatch):
+        """Fit + predict on SparseArray must not touch the dense escape
+        hatch at all (the whole point of the sparse-native path)."""
+        import scipy.sparse as sp
+        import dislib_tpu as ds
+        from dislib_tpu.classification import CascadeSVM
+        from dislib_tpu.data import sparse as sparse_mod
+        x, yv = self._blobs(rng, m=120)
+        xs = sparse_mod.SparseArray.from_scipy(sp.csr_matrix(x))
+        ya = ds.array(yv.reshape(-1, 1))
+
+        def boom(self):
+            raise AssertionError("sparse CSVM touched the dense escape hatch")
+
+        monkeypatch.setattr(sparse_mod.SparseArray, "_data", property(boom))
+        model = CascadeSVM(kernel="rbf", max_iter=1).fit(xs, ya)
+        assert model.predict(xs).collect().shape == (120, 1)
