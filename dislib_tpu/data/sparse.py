@@ -139,6 +139,30 @@ class SparseArray:
         """Densify onto the mesh (the reference's `.toarray()` escape hatch)."""
         return Array._from_logical(self._bcoo.todense())
 
+    def _csr(self):
+        """Cached host CSR mirror (O(nnz)) — the staging layout for row
+        selection and the CSVM sub-Gram path."""
+        if getattr(self, "_csr_cache", None) is None:
+            self._csr_cache = self.collect().tocsr()
+        return self._csr_cache
+
+    def __getitem__(self, key) -> "SparseArray":
+        """Slice / fancy-index rows and columns, staying sparse.
+
+        Selection is staged through the cached host CSR (scipy's indexed
+        slicing keeps exactly the selected nonzeros — the same block
+        movement the reference's KFold does between CSR blocks), then
+        returns a new device SparseArray.  This is what KFold /
+        train_test_split / shuffle use on sparse inputs.
+        """
+        from dislib_tpu.data.array import _split_key, _normalize_index
+        rows, cols = _split_key(key)
+        r_idx, r_len = _normalize_index(rows, self._shape[0])
+        c_idx, c_len = _normalize_index(cols, self._shape[1])
+        del r_len, c_len  # scipy's indexed shape is already exact
+        sub = self._csr()[r_idx][:, c_idx]
+        return SparseArray.from_scipy(sub.tocsr())
+
     # -- ops -----------------------------------------------------------------
 
     def transpose(self) -> "SparseArray":

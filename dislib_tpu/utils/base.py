@@ -51,7 +51,12 @@ def shuffle(x: Array, y: Array | None = None, random_state=None):
 
 def _apply_perm(x: Array, perm: np.ndarray, plan=None):
     """Apply ``out[i] = x[perm[i]]`` via the exchange; returns (Array, plan)
-    so a same-length companion array can reuse the routing plan."""
+    so a same-length companion array can reuse the routing plan.  Sparse
+    arrays permute through their sparsity-preserving row indexing instead
+    (no dense exchange buffers)."""
+    from dislib_tpu.data.sparse import SparseArray
+    if isinstance(x, SparseArray):
+        return x[perm, :], plan
     mesh = _mesh.get_mesh()
     p = mesh.shape[_mesh.ROWS]
     m_loc = x._data.shape[0] // p

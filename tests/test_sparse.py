@@ -337,3 +337,45 @@ class TestSparseKNN:
             assert ro == covered
             covered += int(rc)
         assert covered == m
+
+
+class TestSparseIndexingAndMeta:
+    def test_getitem_matches_dense_oracle(self, rng):
+        import scipy.sparse as sp
+        x = sp.random(80, 10, density=0.3, random_state=0,
+                      dtype=np.float32).tocsr()
+        xs = SparseArray.from_scipy(x)
+        d = ds.array(np.asarray(x.todense()))
+        for key in [(slice(3, 40), slice(None)), ([5, 2, 9], slice(1, 7)),
+                    (np.arange(80) % 3 == 0, slice(None, None, 2)), 7]:
+            got = np.asarray(xs[key].collect().todense())
+            np.testing.assert_allclose(got, d[key].collect())
+
+    def test_kfold_and_search_over_sparse(self, rng):
+        import scipy.sparse as sp
+        from dislib_tpu.model_selection import KFold, GridSearchCV
+        from dislib_tpu.cluster import KMeans
+        x = sp.random(80, 10, density=0.3, random_state=0,
+                      dtype=np.float32).tocsr()
+        xs = SparseArray.from_scipy(x)
+        folds = list(KFold(n_splits=3).split(xs))
+        assert all(isinstance(f[0], SparseArray) for f in folds)
+        assert sum(f[2].shape[0] for f in folds) == 80
+        gs = GridSearchCV(KMeans(random_state=0, max_iter=3),
+                          {"n_clusters": [2, 3]}, cv=2, refit=False).fit(xs)
+        assert np.isfinite(gs.best_score_)
+
+    def test_shuffle_and_split_stay_sparse(self, rng):
+        import scipy.sparse as sp
+        from dislib_tpu.utils import shuffle, train_test_split
+        x = sp.random(60, 8, density=0.3, random_state=1,
+                      dtype=np.float32).tocsr()
+        xs = SparseArray.from_scipy(x)
+        xsh = shuffle(xs, random_state=1)
+        assert isinstance(xsh, SparseArray)
+        a = np.asarray(x.todense())
+        b = np.asarray(xsh.collect().todense())
+        assert sorted(map(tuple, a.tolist())) == sorted(map(tuple, b.tolist()))
+        tr, te = train_test_split(xs, test_size=0.25, random_state=2)
+        assert isinstance(tr, SparseArray) and tr.shape == (45, 8)
+        assert te.shape == (15, 8)
