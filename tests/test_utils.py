@@ -182,10 +182,13 @@ class TestShuffleScaling:
         operand onto every device."""
         import re
         import jax.numpy as jnp
+        import pytest
         import dislib_tpu as ds
         from dislib_tpu.utils import base as ub
         from dislib_tpu.parallel import mesh as _mesh
 
+        if _mesh.get_mesh().shape[_mesh.ROWS] < 2:
+            pytest.skip("needs a multi-device rows axis")
         m, n, p = 4096, 64, 8
         perm = np.random.RandomState(0).permutation(m)
         a = ds.array(np.zeros((m, n), np.float32))
