@@ -379,7 +379,9 @@ def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma,
     ``k_of`` (sparse path) stages each batch's kernel blocks host-side;
     the device then runs the same dual ascent on the precomputed K."""
     n_nodes, cap = nodes.shape
-    per_node = 3 * cap * cap * 4
+    # dense path also gathers a (cap, n_feat) row block per node — at
+    # n_feat >> cap that term, not the (cap, cap) buffers, bounds memory
+    per_node = 3 * cap * cap * 4 + (cap * n_feat * 4 if k_of is None else 0)
     batch = min(n_nodes, max(1, _solve_budget() // per_node))
 
     def solve_chunk(chunk):

@@ -132,7 +132,7 @@ def _kron_kernel(ap, bp, shapes, pshape):
 # ---------------------------------------------------------------------------
 
 def svd(a: Array, compute_uv: bool = True, sort: bool = True,
-        copy: bool = True, eps: float = 1e-9, max_sweeps: int = 30):
+        copy: bool = True, eps: float = 1e-6, max_sweeps: int = 30):
     """One-sided Jacobi SVD (reference: dislib.math.svd — round-robin
     rotations of column pairs until all pairs are ε-orthogonal; the
     reference pairs column BLOCKS, SURVEY §3.2 svd row).
@@ -150,6 +150,11 @@ def svd(a: Array, compute_uv: bool = True, sort: bool = True,
       chose block pairs too.  For rank-deficient input the null-space
       columns of V (σ = 0) are implementation-defined on this tier;
       singular vectors for σ > 0 are exact.
+
+    ``eps`` defaults to 1e-6 (not the reference's 1e-9, which presumes
+    float64 blocks): the kernels run float32, whose pairwise-orthogonality
+    floor is ~5e-8, so tighter requests are unreachable and are clamped to
+    1e-6 with a warning.
     """
     m, n = a.shape
     # Operate on the full padded backing: pad rows/cols are zero under the
@@ -160,7 +165,12 @@ def svd(a: Array, compute_uv: bool = True, sort: bool = True,
     # the kernels run float32: an eps below f32's pairwise-orthogonality
     # floor (~5e-8 observed) is unreachable and would burn max_sweeps in
     # full every call — clamp to a floor a converged f32 sweep does reach
-    # (the reference's 1e-9 default presumes float64 blocks)
+    if float(eps) < 1e-6:
+        import warnings
+        warnings.warn(
+            f"svd: eps={eps:g} is below the float32 convergence floor; "
+            "clamping to 1e-6 (the 1e-9-style defaults presume float64 "
+            "blocks)", RuntimeWarning, stacklevel=2)
     eps = max(float(eps), 1e-6)
     if a._data.shape[1] >= 2 * _JACOBI_BLOCK:
         u, s, v = _jacobi_svd_block(a._data.astype(jnp.float32), n, sort,
