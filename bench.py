@@ -463,6 +463,143 @@ def bench_gmm(m, n, k, iters=5):
             "vs_baseline": round(cpu_wall / t, 2)}
 
 
+def _numpy_csvm_fit(x, y_pm, part, c, gamma, max_iter, arity=2):
+    """Same-algorithm cascade proxy: K+1-augmented boxed dual solved by
+    projected gradient ascent (Gershgorin step, ≤500 steps, 1e-6 delta —
+    the device solver's exact loop), SV merge up an arity tree, global SV
+    feedback.  Mirrors classification/csvm.py with NumPy GEMVs."""
+    m = x.shape[0]
+
+    def solve(idx):
+        xs = x[idx]
+        sq = (xs * xs).sum(1)
+        d = np.maximum(sq[:, None] - 2.0 * (xs @ xs.T) + sq[None, :], 0.0)
+        k = np.exp(-gamma * d) + 1.0
+        q = k * np.outer(y_pm[idx], y_pm[idx])
+        eta = 1.0 / max(np.abs(q).sum(1).max(), 1e-12)
+        a = np.zeros(len(idx), np.float32)
+        for _ in range(500):
+            new = np.clip(a + eta * (1.0 - q @ a), 0.0, c).astype(np.float32)
+            delta = np.abs(new - a).max()
+            a = new
+            if delta <= 1e-6:
+                break
+        return a, a.sum() - 0.5 * a @ (q @ a)
+
+    sv = alpha = None
+    for _ in range(max_iter):
+        nodes = [np.arange(s, min(s + part, m)) for s in range(0, m, part)]
+        if sv is not None and len(sv):
+            nodes = [np.unique(np.r_[nd, sv]) for nd in nodes]
+        while True:
+            res = [solve(nd) for nd in nodes]
+            if len(nodes) == 1:
+                break
+            merged = []
+            for i in range(0, len(nodes), arity):
+                grp = []
+                for j in range(i, min(i + arity, len(nodes))):
+                    grp.extend(nodes[j][res[j][0] > 1e-8].tolist())
+                merged.append(np.unique(grp) if grp else nodes[i][:1])
+            nodes = merged
+        a, _ = res[0]
+        keep = a > 1e-8
+        sv, alpha = nodes[0][keep], a[keep]
+    return sv, alpha
+
+
+def bench_csvm(m, n, tag, max_iter=3, part=1024):
+    """CascadeSVM fit wall clock — the first irregular-tier row (round-3
+    verdict #8): cascades of masked fixed-capacity dual solves, nothing
+    like the dense-linalg tier's single fused program."""
+    import dislib_tpu as ds
+    from dislib_tpu.classification import CascadeSVM
+
+    rng = np.random.RandomState(0)
+    half = m // 2
+    x_host = np.vstack([rng.randn(half, n) + 2.0,
+                        rng.randn(m - half, n) - 2.0]).astype(np.float32)
+    y_host = np.r_[np.ones(half), -np.ones(m - half)].astype(np.float32)
+    perm = rng.permutation(m)
+    x_host, y_host = x_host[perm], y_host[perm]
+    gamma = 1.0 / n
+
+    t0 = time.perf_counter()
+    sv, alpha = _numpy_csvm_fit(x_host, y_host, part, 1.0, gamma, max_iter)
+    cpu_wall = time.perf_counter() - t0
+    # proxy correctness gate: its SV model must classify the blobs
+    k_dec = np.exp(-gamma * np.maximum(
+        ((x_host * x_host).sum(1)[:, None] - 2.0 * x_host @ x_host[sv].T
+         + (x_host[sv] * x_host[sv]).sum(1)[None]), 0.0)) + 1.0
+    proxy_acc = float(np.mean(np.sign(k_dec @ (alpha * y_host[sv])) == y_host))
+    assert proxy_acc > 0.95, f"proxy cascade degenerate: acc={proxy_acc}"
+
+    a = ds.array(x_host, block_size=(part, n))
+    ya = ds.array(y_host.reshape(-1, 1), block_size=(part, 1))
+
+    def fit_once():
+        est = CascadeSVM(kernel="rbf", c=1.0, gamma=gamma,
+                         max_iter=max_iter, check_convergence=False)
+        est.fit(a, ya)
+        return est
+
+    est = fit_once()  # warmup/compile + correctness gate
+    acc = est.score(a, ya)
+    assert acc > 0.95 and acc > proxy_acc - 0.02, \
+        f"device cascade acc {acc} vs proxy {proxy_acc}"
+    t = _median_time(lambda: fit_once())
+    return {"metric": f"csvm_{tag}_rbf_{max_iter}it_fit_wall_s "
+                      "(baseline: numpy same-algorithm cascade proxy)",
+            "value": round(t, 4), "unit": "s",
+            "vs_baseline": round(cpu_wall / t, 2),
+            "device_train_acc": round(acc, 4),
+            "proxy_train_acc": round(proxy_acc, 4)}
+
+
+def bench_gridsearch(m, n, cands, folds, kmeans_iters, tag):
+    """GridSearchCV wall clock over KMeans candidates — the first measured
+    search-throughput row; on TPU it exercises the pipelined async-trial
+    protocol (all fits of a fold in flight before any host read), which
+    the cpu rig deliberately serializes (round-3 verdict weak #3)."""
+    import dislib_tpu as ds
+    from dislib_tpu.cluster import KMeans
+    from dislib_tpu.model_selection import GridSearchCV
+
+    rng = np.random.RandomState(0)
+    x_host = rng.rand(m, n).astype(np.float32)
+
+    # proxy: same folds (contiguous KFold splits), same fixed-iteration
+    # Lloyd's per candidate, NumPy single-node
+    t0 = time.perf_counter()
+    bounds = np.linspace(0, m, folds + 1).astype(int)
+    for k in cands:
+        for f in range(folds):
+            tr = np.concatenate([x_host[: bounds[f]], x_host[bounds[f + 1]:]])
+            c = tr[:k].copy()
+            for _ in range(kmeans_iters):
+                c = _numpy_kmeans_iter(tr, c)
+    cpu_wall = time.perf_counter() - t0
+
+    a = ds.array(x_host, block_size=(max(1, m // 8), n))
+
+    def search_once():
+        gs = GridSearchCV(KMeans(random_state=0, max_iter=kmeans_iters,
+                                 tol=0.0),
+                          {"n_clusters": list(cands)}, cv=folds, refit=False)
+        gs.fit(a)
+        return gs
+
+    gs = search_once()  # warmup/compile + gate
+    scores = gs.cv_results_["mean_test_score"]
+    assert np.all(np.isfinite(scores)) and len(scores) == len(cands)
+    assert gs.best_index_ == int(np.argmax(scores))
+    t = _median_time(lambda: search_once())
+    return {"metric": f"gridsearch_kmeans_{tag}_{len(cands)}x{folds}fits_"
+                      "wall_s (baseline: numpy same-folds kmeans proxy)",
+            "value": round(t, 4), "unit": "s",
+            "vs_baseline": round(cpu_wall / t, 2)}
+
+
 def _configs():
     """Ordered (name, thunk) list.  BENCH_SMOKE=1: every config at ~1/100
     scale — validates the whole harness (gates, proxies, JSON, watchdog
@@ -482,6 +619,10 @@ def _configs():
             ("tsqr_smoke", lambda: bench_tsqr(2048, 64)),
             ("randomsvd_smoke", lambda: bench_randomsvd(1024, 128, nsv=16)),
             ("svd_smoke", lambda: bench_svd(256, 130)),
+            ("csvm_smoke", lambda: bench_csvm(600, 8, "smoke", max_iter=2,
+                                              part=128)),
+            ("gridsearch_smoke",
+             lambda: bench_gridsearch(2000, 8, (2, 3), 2, 4, "smoke")),
             ("gmm_smoke", lambda: bench_gmm(2000, 8, 3, 2)),
             ("kmeans_smoke_star",
              lambda: bench_kmeans(4000, 20, 4, 5, "smoke_star")),
@@ -503,6 +644,11 @@ def _configs():
         ("svd_4096x512_wall_s", lambda: bench_svd(4096, 512)),
         ("gmm_1000000x50_k16_5it_wall_s",
          lambda: bench_gmm(1_000_000, 50, 16, 5)),
+        ("csvm_20000x20_fit_wall_s",
+         lambda: bench_csvm(20_000, 20, "20000x20")),
+        ("gridsearch_kmeans_200000x20_wall_s",
+         lambda: bench_gridsearch(200_000, 20, (4, 8, 12), 3, 10,
+                                  "200000x20")),
         ("matmul_16384_f32_gflops_per_chip",
          lambda: bench_matmul(16384, "16384", proxy_dim=8192, chain=6)),
         # informational variants — headline ★ stays the full-precision path

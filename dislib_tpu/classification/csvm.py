@@ -125,14 +125,24 @@ class CascadeSVM(BaseEstimator):
         # device.  Dense inputs keep the all-device gather path.
         from dislib_tpu.data.sparse import SparseArray
         sparse_in = isinstance(x, SparseArray)
+        ell = x_csr = k_of = None
         if sparse_in:
-            x_csr = x.collect().tocsr()
-            rowsq = np.asarray(x_csr.multiply(x_csr).sum(axis=1),
-                               dtype=np.float32).ravel()
-            k_of = _host_gram(x_csr, rowsq, self.kernel, gamma)
-            xv = yv = None
+            # preferred staging: device-resident ELL row gather — each node
+            # batch densifies its rows and computes its sub-Gram ON DEVICE,
+            # no host scipy product in the cascade loop (round-3 verdict
+            # #5).  Falls back to host-CSR staging when row-nnz skew makes
+            # the padded ELL buffers bigger than the budget.
+            ell = x.ell()
+            if ell is not None:
+                xv = None
+                yv = jnp.asarray(y_pm)
+            else:
+                x_csr = x.collect().tocsr()
+                rowsq = np.asarray(x_csr.multiply(x_csr).sum(axis=1),
+                                   dtype=np.float32).ravel()
+                k_of = _host_gram(x_csr, rowsq, self.kernel, gamma)
+                xv = yv = None
         else:
-            x_csr = k_of = None
             xv = x._data
             yv = jnp.asarray(np.pad(y_pm, (0, xv.shape[0] - m)))
 
@@ -169,9 +179,12 @@ class CascadeSVM(BaseEstimator):
                              float(("rbf", "linear").index(self.kernel)),
                              float(part)], np.float64)
             if sparse_in:
-                x_sum = float(x_csr.sum())
-                x_rowsum = float(np.arange(m, dtype=np.float64)
-                                 @ np.asarray(x_csr.sum(axis=1)).ravel())
+                # same math as the dense einsum digests, over the nonzeros
+                # (Σv and Σ row·v) — works for both staging modes
+                idxh = np.asarray(jax.device_get(x._bcoo.indices))
+                valh = np.asarray(jax.device_get(x._bcoo.data), np.float64)
+                x_sum = float(valh.sum())
+                x_rowsum = float((valh * idxh[:, 0]).sum())
             else:
                 riota = jnp.arange(xv.shape[0], dtype=jnp.float32)
                 x_sum = float(jax.device_get(jnp.sum(xv)))
@@ -219,7 +232,8 @@ class CascadeSVM(BaseEstimator):
                 alphas, objs = _solve_level_batched(xv, yv, nodes,
                                                     float(self.c), n,
                                                     self.kernel, gamma,
-                                                    k_of=k_of, y_host=y_pm)
+                                                    k_of=k_of, y_host=y_pm,
+                                                    ell=ell)
                 if nodes.shape[0] == 1:
                     break
                 nodes = self._merge_level(nodes, np.asarray(alphas))
@@ -267,7 +281,11 @@ class CascadeSVM(BaseEstimator):
         # gather SV rows only (n_sv × n, never the dataset): from the host
         # CSR on the sparse path, on device for dense inputs
         if sparse_in:
-            self._sv_x = np.asarray(x_csr[sv_idx].toarray(), np.float32)
+            if ell is not None:
+                self._sv_x = np.asarray(jax.device_get(_ell_rows_dense(
+                    ell[0], ell[1], jnp.asarray(sv_idx), n)))
+            else:
+                self._sv_x = np.asarray(x_csr[sv_idx].toarray(), np.float32)
         else:
             self._sv_x = np.asarray(jax.device_get(
                 x._data[jnp.asarray(sv_idx), : n]))
@@ -368,7 +386,7 @@ def _host_gram(csr, rowsq, kernel, gamma):
 
 
 def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma,
-                         k_of=None, y_host=None):
+                         k_of=None, y_host=None, ell=None):
     """One cascade level in node batches bounded by a byte budget.
 
     A level's vmapped solve holds ~3 (cap, cap) f32 buffers per node
@@ -376,15 +394,24 @@ def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma,
     once would scale per-level memory with m.  Batches are padded to a
     fixed node count with all-invalid rows (C pinned to 0 → their alpha
     converges to 0 immediately) so only one shape per cap compiles.
-    ``k_of`` (sparse path) stages each batch's kernel blocks host-side;
-    the device then runs the same dual ascent on the precomputed K."""
+    Sparse staging: ``ell`` gathers + densifies each node's rows ON
+    DEVICE (no host product anywhere in the level); ``k_of`` is the
+    host-CSR fallback that stages precomputed kernel blocks."""
     n_nodes, cap = nodes.shape
-    # dense path also gathers a (cap, n_feat) row block per node — at
-    # n_feat >> cap that term, not the (cap, cap) buffers, bounds memory
-    per_node = 3 * cap * cap * 4 + (cap * n_feat * 4 if k_of is None else 0)
+    # dense/ell paths also gather a (cap, n_feat) row block per node — at
+    # n_feat >> cap that term, not the (cap, cap) buffers, bounds memory;
+    # the ell gather adds the (cap, r) vals+cols staging buffers
+    per_node = 3 * cap * cap * 4
+    if k_of is None:
+        per_node += cap * n_feat * 4
+    if ell is not None:
+        per_node += cap * ell[0].shape[1] * 8
     batch = min(n_nodes, max(1, _solve_budget() // per_node))
 
     def solve_chunk(chunk):
+        if ell is not None:
+            return _solve_level_ell(ell[0], ell[1], yv, jnp.asarray(chunk),
+                                    c, n_feat, kernel, gamma)
         if k_of is None:
             return _solve_level(xv, yv, jnp.asarray(chunk), c, n_feat,
                                 kernel, gamma)
@@ -473,6 +500,40 @@ def _solve_level(xv, yv, nodes, c, n_feat, kernel, gamma):
         y_sub = yv[safe]
         q = k_sub * (y_sub[:, None] * y_sub[None, :])
         c_vec = jnp.where(valid, c, 0.0)            # padded slots pinned at 0
+        return _dual_ascent(q, c_vec)
+
+    return jax.vmap(solve_one)(nodes)
+
+
+@partial(jax.jit, static_argnames=("n_feat",))
+def _ell_rows_dense(ev, ec, idx, n_feat):
+    """Densify the rows ``idx`` of an ELL-format sparse matrix on device:
+    one scatter-add per gather — the device replacement for slicing a host
+    CSR (`SparseArray.ell`)."""
+    v = ev[idx]                                   # (cap, r)
+    cc = ec[idx]
+    cap, r = v.shape
+    rows = jnp.broadcast_to(jnp.arange(cap)[:, None], (cap, r))
+    return jnp.zeros((cap, n_feat), ev.dtype).at[rows, cc].add(v)
+
+
+@partial(jax.jit, static_argnames=("n_feat", "kernel"))
+@precise
+def _solve_level_ell(ev, ec, yv, nodes, c, n_feat, kernel, gamma):
+    """Boxed-dual solves with device-resident sparse staging: each node
+    gathers its rows from the ELL buffers, densifies its (cap, n) block by
+    scatter, and computes its (cap, cap) sub-Gram on device — the whole
+    cascade level is one program, no host kernel products (the sparse
+    analog of `_solve_level`)."""
+
+    def solve_one(idx):
+        valid = idx >= 0
+        safe = jnp.maximum(idx, 0)
+        x_sub = _ell_rows_dense(ev, ec, safe, n_feat)
+        k_sub = _gram(x_sub, x_sub, kernel, gamma) + 1.0
+        y_sub = yv[safe]
+        q = k_sub * (y_sub[:, None] * y_sub[None, :])
+        c_vec = jnp.where(valid, c, 0.0)
         return _dual_ascent(q, c_vec)
 
     return jax.vmap(solve_one)(nodes)

@@ -305,6 +305,45 @@ class SparseArray:
         return out
 
 
+    def ell(self, budget=None):
+        """Padded ELL buffers ``(vals (m, r), cols (m, r))`` with r = max
+        row nnz — the device-resident row-GATHER layout: ``vals[i]`` /
+        ``cols[i]`` densify row i by one scatter, so an estimator that
+        needs arbitrary row subsets (CascadeSVM node staging) gathers them
+        entirely on device instead of slicing a host CSR per node.
+        Padding entries are (v=0, col=0) and scatter-add to nothing.
+
+        Skew guard: one dense row inflates r to n, making the buffers
+        O(m·n) — when the padded bytes exceed ``budget`` (default
+        ``DSLIB_SPARSE_ELL_BUDGET``, 2 GiB) this returns None and callers
+        fall back to host-CSR staging.  Cached."""
+        import os
+        if budget is None:
+            budget = int(os.environ.get("DSLIB_SPARSE_ELL_BUDGET", 2 << 30))
+        # budget is re-checked against the CACHED buffers too: a caller
+        # lowering the budget between fits must get the fallback, not the
+        # over-budget cache
+        cached = getattr(self, "_ell_cache", None)
+        if cached is not None:
+            m_c, r_c = cached[0].shape
+            return cached if m_c * r_c * 8 <= budget else None
+        m = self._shape[0]
+        idx = np.asarray(jax.device_get(self._bcoo.indices))
+        val = np.asarray(jax.device_get(self._bcoo.data))
+        row_nnz = np.bincount(idx[:, 0], minlength=m)
+        r = max(1, int(row_nnz.max(initial=1)))
+        if m * r * 8 > budget:      # f32 vals + i32 cols
+            return None
+        vals = np.zeros((m, r), np.float32)
+        cols = np.zeros((m, r), np.int32)
+        order = np.argsort(idx[:, 0], kind="stable")
+        slot = np.arange(len(val)) - np.concatenate(
+            [[0], np.cumsum(row_nnz)])[idx[order, 0]]
+        vals[idx[order, 0], slot] = val[order]
+        cols[idx[order, 0], slot] = idx[order, 1]
+        self._ell_cache = (jnp.asarray(vals), jnp.asarray(cols))
+        return self._ell_cache
+
     def row_steps(self, chunk):
         """Equal-shape per-step triplet buffers for streaming a bounded
         dense window of the matrix (the kNN sparse path): rows are packed

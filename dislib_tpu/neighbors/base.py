@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import PartitionSpec as P
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
@@ -150,7 +151,13 @@ def _kneighbors(qp, fp, q_shape, f_shape, k, chunk=None):
 
 def _kneighbors_sparse(x, f, k):
     """kNN with a sparse fit set and/or sparse queries — streams the fit
-    rows as bounded dense windows, never densifies a whole matrix."""
+    rows as bounded dense windows, never densifies a whole matrix.
+
+    Dense queries take the SHARDED schedule (`shard_map` over 'rows': each
+    device scores its own query shard against the replicated bounded
+    windows — manual SPMD, because GSPMD replicates a row-sharded operand
+    to partition `top_k`, which the round-4 comm audit pins).  Sparse
+    queries stay a single-program path (BCOO buffers don't mesh-shard)."""
     from dislib_tpu.data.sparse import SparseArray
     n = f.shape[1]
     chunk = min(_CHUNK, max(1, f.shape[0]))
@@ -165,28 +172,64 @@ def _kneighbors_sparse(x, f, k):
         f_args = (None, None, None, row_off, rows_in,
                   f._data[: f.shape[0], : f.shape[1]])
     if isinstance(x, SparseArray):
-        q_bcoo, q_dense = x._bcoo, None
+        q_bcoo = x._bcoo
         q_rowsq = x.row_norms_sq()
-    else:
-        q_dense = x._data[: x.shape[0], : x.shape[1]]
-        q_bcoo = None
-        q_rowsq = jnp.sum(q_dense * q_dense, axis=1)
-    return _kneighbors_sparse_kernel(
-        q_bcoo, q_dense, q_rowsq, *f_args, n=n, mq=x.shape[0],
-        mf=f.shape[0], k=k, chunk=chunk)
+        return _kneighbors_sparse_kernel(
+            q_bcoo, None, q_rowsq, *f_args, n=n, mq=x.shape[0],
+            mf=f.shape[0], k=k, chunk=chunk)
+    return _kneighbors_sparse_sharded_q(
+        x._data, *f_args[:5], n=n, mq=x.shape[0], mf=f.shape[0], k=k,
+        chunk=chunk, mesh=_mesh.get_mesh())
 
 
-@partial(jax.jit, static_argnames=("n", "mq", "mf", "k", "chunk"))
+@partial(jax.jit, static_argnames=("n", "mq", "mf", "k", "chunk", "mesh"))
 @precise
-def _kneighbors_sparse_kernel(q_bcoo, q_dense, q_rowsq, fdat, flr, fcol,
-                              row_off, rows_in, f_dense, n, mq, mf, k,
-                              chunk):
+def _kneighbors_sparse_sharded_q(qp, fdat, flr, fcol, row_off, rows_in,
+                                 n, mq, mf, k, chunk, mesh):
+    """Dense queries over a streamed sparse fit set, row-sharded BY HAND
+    (`shard_map`): queries and the running top-k never leave their shard;
+    the only replicated tensors are the O(chunk·n) step windows and their
+    triplet buffers.  Manual because GSPMD replicates a row-sharded
+    operand to partition `lax.top_k` (observed on the 8-device rig: an
+    all-gather of the whole candidate buffer), exactly the gather the comm
+    audit forbids — the same reason `ops/ring.py` is a shard_map."""
+    p = mesh.shape[_mesh.ROWS]
+    mq_loc = qp.shape[0] // p
+
+    def local(q_s, fdat_s, flr_s, fcol_s, ro_s, ri_s):
+        qv = q_s[:, :n]
+        q_rowsq = jnp.sum(qv * qv, axis=1)
+        neg, idx = _stream_topk(qv, q_rowsq, None, fdat_s, flr_s, fcol_s,
+                                ro_s, ri_s, None, n, mf, k, chunk,
+                                varying_axes=(_mesh.ROWS,))
+        d = jnp.sqrt(jnp.maximum(-neg, 0.0))
+        # zero this shard's padded query rows (global pad-and-mask invariant)
+        my = lax.axis_index(_mesh.ROWS)
+        valid = (my * mq_loc
+                 + lax.broadcasted_iota(jnp.int32, (qv.shape[0], 1), 0)) < mq
+        return jnp.where(valid, d, 0.0), jnp.where(valid, idx, 0)
+
+    repl = [P(*([None] * a.ndim)) for a in (fdat, flr, fcol, row_off,
+                                            rows_in)]
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(_mesh.ROWS, None), *repl),
+        out_specs=(P(_mesh.ROWS, None), P(_mesh.ROWS, None)),
+        check_vma=True,
+    )(qp, fdat, flr, fcol, row_off, rows_in)
+
+
+def _stream_topk(qv, q_rowsq, q_bcoo, fdat, flr, fcol, row_off, rows_in,
+                 f_dense, n, mf, k, chunk, varying_axes=None):
     """Running top-k over fit-row steps (same merge as the dense chunked
     path).  Each step covers rows [row_off, row_off+rows_in) — its dense
     window materialises by scatter-add from the step's triplet buffer
     (sparse fit) or a dynamic slice (dense fit); the cross-term is one
-    GEMM (dense queries) or one spmm (sparse queries).  Window rows beyond
-    rows_in belong to OTHER steps and are masked to +inf."""
+    GEMM (dense queries ``qv``) or one spmm (sparse queries ``q_bcoo``).
+    Window rows beyond rows_in belong to OTHER steps and are masked to
+    +inf.  Traced inside both the single-program kernel and the per-shard
+    body of the sharded dense-query schedule.  Returns the NEGATED best
+    squared distances and indices."""
     n_steps = row_off.shape[0]
 
     def window(i, ro):
@@ -209,7 +252,7 @@ def _kneighbors_sparse_kernel(q_bcoo, q_dense, q_rowsq, fdat, flr, fcol,
             from dislib_tpu.data.sparse import _spmm
             cross = _spmm(q_bcoo, dense.T)                   # (mq, chunk)
         else:
-            cross = q_dense @ dense.T
+            cross = qv @ dense.T
         dist = jnp.maximum(q_rowsq[:, None] - 2.0 * cross + f_rowsq[None, :],
                            0.0)
         col = ro + lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
@@ -221,12 +264,30 @@ def _kneighbors_sparse_kernel(q_bcoo, q_dense, q_rowsq, fdat, flr, fcol,
         neg, sel = lax.top_k(cand_neg, k)
         return (neg, jnp.take_along_axis(cand_idx, sel, axis=1)), None
 
-    init = (jnp.full((mq, k), -jnp.inf, q_rowsq.dtype),
-            jnp.zeros((mq, k), jnp.int32))
+    mq_rows = q_rowsq.shape[0]
+    init = (jnp.full((mq_rows, k), -jnp.inf, q_rowsq.dtype),
+            jnp.zeros((mq_rows, k), jnp.int32))
+    if varying_axes:
+        # inside a shard_map the constant seeds become shard-varying on the
+        # first merge; declaring it up front keeps check_vma provable (the
+        # same pattern as ops/ring.py)
+        init = tuple(lax.pcast(b, varying_axes, to="varying") for b in init)
     (best_neg, best_idx), _ = lax.scan(
         body, init,
         (jnp.arange(n_steps, dtype=jnp.int32), row_off, rows_in))
-    return jnp.sqrt(jnp.maximum(-best_neg, 0.0)), best_idx
+    return best_neg, best_idx
+
+
+@partial(jax.jit, static_argnames=("n", "mq", "mf", "k", "chunk"))
+@precise
+def _kneighbors_sparse_kernel(q_bcoo, q_dense, q_rowsq, fdat, flr, fcol,
+                              row_off, rows_in, f_dense, n, mq, mf, k,
+                              chunk):
+    """Single-program wrapper over `_stream_topk` (sparse queries; also
+    the dense-fit-with-sparse-query combination)."""
+    neg, idx = _stream_topk(q_dense, q_rowsq, q_bcoo, fdat, flr, fcol,
+                            row_off, rows_in, f_dense, n, mf, k, chunk)
+    return jnp.sqrt(jnp.maximum(-neg, 0.0)), idx
 
 
 def _kneighbors_chunked(qv, fv, mf, k, chunk):

@@ -124,7 +124,7 @@ def _shuffle_exchange(xp, send_idx, dst_idx, mesh, p):
     # out_specs is row-only, which on a cols>1 mesh would leave the result
     # column-replicated until some later op reshards it (round-3 advisor)
     return lax.with_sharding_constraint(out.reshape(xp.shape),
-                                        _mesh.data_sharding())
+                                        _mesh.data_sharding(mesh))
 
 
 def train_test_split(x: Array, y: Array | None = None, test_size: float = 0.25,

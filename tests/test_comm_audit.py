@@ -212,6 +212,69 @@ class TestShuffleCommAudit:
         _assert_no_operand_gather(hlo, full)
 
 
+class TestSparseStagingCommAudit:
+    """The round-4 sparse staging paths: CSVM's ELL node solves and the
+    sparse-fit kNN stream must not smuggle operand-sized collectives in."""
+
+    def test_csvm_ell_level_no_operand_collectives(self, rng):
+        """A cascade level over ELL staging is node-local batched work —
+        any operand-scale collective means the partitioner replicated or
+        regathered the staging buffers."""
+        import scipy.sparse as sp
+        from dislib_tpu.data.sparse import SparseArray
+        from dislib_tpu.classification.csvm import _solve_level_ell
+        m, n = 512, 32
+        xs = sp.random(m, n, density=0.1, random_state=42,
+                       dtype=np.float32).tocsr()
+        sa = SparseArray.from_scipy(xs)
+        ev, ec = sa.ell()
+        yv = jnp.asarray(np.where(rng.rand(m) > 0.5, 1.0, -1.0)
+                         .astype(np.float32))
+        nodes = jnp.asarray(np.arange(m).reshape(4, m // 4))
+        hlo = _solve_level_ell.lower(ev, ec, yv, nodes, 1.0, n, "rbf",
+                                     1.0 / n).compile().as_text()
+        _assert_no_operand_gather(hlo, m * n)
+        for elems in _collective_sizes(hlo, "all-reduce"):
+            assert elems < m * n
+
+    def test_sparse_knn_no_query_gather(self, rng):
+        """Dense queries over a sparse fit stream: the query operand and
+        the running top-k stay row-sharded; the only replicated tensors
+        are the bounded O(chunk·n) windows."""
+        _needs_multirow()
+        import scipy.sparse as sp
+        from dislib_tpu.data.sparse import SparseArray
+        from dislib_tpu.neighbors import NearestNeighbors
+        from dislib_tpu.neighbors.base import (_kneighbors_sparse_sharded_q,
+                                               _CHUNK)
+        mq, mf, n, k = 4096, 600, 16, 3
+        f = SparseArray.from_scipy(sp.random(mf, n, density=0.1,
+                                             random_state=0,
+                                             dtype=np.float32).tocsr())
+        q = ds.array(rng.rand(mq, n).astype(np.float32),
+                     block_size=(mq // 8, n))
+        chunk = min(_CHUNK, mf)
+        hlo = _kneighbors_sparse_sharded_q.lower(
+            q._data, *f.row_steps(chunk), n=n, mq=mq, mf=mf, k=k,
+            chunk=chunk, mesh=_mesh.get_mesh()).compile().as_text()
+        _assert_no_operand_gather(hlo, mq * n)
+        for op in ("all-gather", "all-to-all", "collective-permute"):
+            for elems in _collective_sizes(hlo, op):
+                assert elems < mq * n, \
+                    f"{op} of {elems} elems covers the query operand"
+        # and the result must actually be correct at this sharded shape
+        nn = NearestNeighbors(n_neighbors=k).fit(f)
+        d, i = nn.kneighbors(q)
+        xd = f.collect().toarray()
+        qd = np.asarray(q.collect())
+        ref = np.sqrt(np.maximum(
+            (qd * qd).sum(1)[:, None] - 2 * qd @ xd.T
+            + (xd * xd).sum(1)[None], 0.0))
+        np.testing.assert_allclose(np.sort(np.asarray(d.collect()), axis=1),
+                                   np.sort(np.sort(ref, axis=1)[:, :k],
+                                           axis=1), rtol=1e-4, atol=1e-4)
+
+
 class TestRingKnnCommAudit:
     """Ring kNN rotates one fitted SHARD per hop (ppermute); the fitted set
     never materialises on one device."""
