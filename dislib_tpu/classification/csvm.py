@@ -195,16 +195,8 @@ class CascadeSVM(BaseEstimator):
                  float(y_pm @ np.arange(m, dtype=np.float64))], np.float64)
             snap = checkpoint.load()
             if snap is not None:
-                ok = ("fp" in snap and "digest" in snap
-                      and np.array_equal(snap["fp"], fp)
-                      and np.allclose(snap["digest"], digest, rtol=1e-5,
-                                      atol=1e-6))
-                if not ok:
-                    raise ValueError(
-                        "checkpoint does not match this data/estimator "
-                        "(shape, data content, block size, kernel, gamma, "
-                        "C or cascade_arity differ) — stale or foreign "
-                        "snapshot")
+                from dislib_tpu.utils.checkpoint import validate_snapshot
+                validate_snapshot(snap, fp, digest)
                 sv_idx = np.asarray(snap["sv_idx"], np.int64)
                 self._sv_alpha = np.asarray(snap["sv_alpha"], np.float32)
                 last_w = float(snap["last_w"])

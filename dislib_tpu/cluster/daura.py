@@ -59,12 +59,20 @@ class Daura(BaseEstimator):
     def __init__(self, cutoff=1.0):
         self.cutoff = cutoff
 
-    def fit(self, x: Array, y=None):
+    def fit(self, x: Array, y=None, checkpoint=None):
+        """Fit.  With ``checkpoint=FitCheckpoint(path, every=k)`` the greedy
+        state (active mask, labels, medoids, cluster counter) snapshots
+        every k extracted clusters on the tiled tier; a re-run resumes the
+        extraction and lands on the uninterrupted run's clustering (the
+        greedy loop is deterministic in its carried state — SURVEY §6)."""
         if x.shape[1] % 3 != 0:
             raise ValueError("Daura expects rows of 3*n_atoms coordinates")
         n_atoms = x.shape[1] // 3
         mesh = _mesh.get_mesh()
-        if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
+        if checkpoint is not None:
+            labels, medoids = self._fit_tiled_checkpointed(x, n_atoms,
+                                                           checkpoint)
+        elif ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
             labels, medoids = _daura_fit_ring(x._data, x.shape,
                                               float(self.cutoff), n_atoms,
                                               mesh)
@@ -91,6 +99,42 @@ class Daura(BaseEstimator):
         lab = jnp.asarray(self.labels_.astype(np.int32)[:, None])
         return Array._from_logical_padded(_repad(lab, (x.shape[0], 1)),
                                           (x.shape[0], 1))
+
+    def _fit_tiled_checkpointed(self, x: Array, n_atoms, checkpoint):
+        """Chunked tiled fit: `every` cluster extractions per dispatch, the
+        greedy state snapshotted between chunks."""
+        from dislib_tpu.utils.checkpoint import data_digest, validate_snapshot
+        cutoff = float(self.cutoff)
+        fp = np.asarray([x.shape[0], x.shape[1], cutoff], np.float64)
+        digest = data_digest(x._data)
+        snap = checkpoint.load()
+        # tiles-padded row count, computed arithmetically (pad_to_tiles'
+        # own formula) — no eager padded copy of the dataset
+        mp = -(-x._data.shape[0] // _tiled.TILE) * _tiled.TILE
+        if snap is not None:
+            validate_snapshot(snap, fp, digest)
+            active = jnp.asarray(snap["active"])
+            labels = jnp.asarray(snap["labels"])
+            medoids = jnp.asarray(snap["medoids"])
+            cid = jnp.int32(int(snap["cid"]))
+        else:
+            active = jnp.arange(mp, dtype=jnp.int32) < x.shape[0]
+            labels = jnp.full((mp,), -1, jnp.int32)
+            medoids = jnp.full((mp,), -1, jnp.int32)
+            cid = jnp.int32(0)
+        while True:
+            active, labels, medoids, cid = _daura_extract_tiled(
+                x._data, x.shape, cutoff, n_atoms, _tiled.TILE, active,
+                labels, medoids, cid, max_new=checkpoint.every)
+            done = not bool(jax.device_get(jnp.any(active)))
+            checkpoint.save({"active": np.asarray(jax.device_get(active)),
+                             "labels": np.asarray(jax.device_get(labels)),
+                             "medoids": np.asarray(jax.device_get(medoids)),
+                             "cid": int(jax.device_get(cid)),
+                             "fp": fp, "digest": digest})
+            if done:
+                break
+        return labels, medoids
 
 
 @partial(jax.jit, static_argnames=("shape", "n_atoms"))
@@ -129,39 +173,58 @@ def _daura_fit(xp, shape, cutoff, n_atoms):
     return labels, medoids
 
 
-@partial(jax.jit, static_argnames=("shape", "n_atoms", "tile"))
+@partial(jax.jit, static_argnames=("shape", "n_atoms", "tile", "max_new"))
 @precise
+def _daura_extract_tiled(xp, shape, cutoff, n_atoms, tile, active, labels,
+                         medoids, cid, max_new):
+    """Extract ≤ max_new clusters from the current greedy state (tiled
+    passes).  Each extraction is one cluster = one pass; bounding the count
+    is the mid-fit checkpoint boundary (SURVEY §6): the carried
+    (active, labels, medoids, cid) state between chunks IS the resumable
+    state, and greedy extraction is deterministic given it."""
+    m, n = shape
+    cut2 = cutoff * cutoff * n_atoms          # rmsd² ≤ cutoff² ⇔ d² ≤ cut2
+    xv, _ = _tiled.pad_to_tiles(xp[:, :n], tile)
+    mp = xv.shape[0]
+    ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
+
+    def body(carry):
+        active_, labels_, medoids_, cid_, k = carry
+        counts, _ = _tiled.neigh_count_min(xv, cut2, ids, active_,
+                                           jnp.int32(mp), tile)
+        counts = jnp.where(active_, counts, -1)
+        medoid = jnp.argmax(counts).astype(jnp.int32)
+        mrow = distances_sq(xv[medoid][None, :], xv)[0]
+        members = ((mrow <= cut2) | (ids == medoid)) & active_
+        labels_ = jnp.where(members, cid_, labels_)
+        medoids_ = medoids_.at[cid_].set(medoid)
+        return active_ & ~members, labels_, medoids_, cid_ + 1, k + 1
+
+    def cond(carry):
+        return jnp.any(carry[0]) & (carry[4] < max_new)
+
+    active, labels, medoids, cid, _ = lax.while_loop(
+        cond, body, (active, labels, medoids, cid, jnp.int32(0)))
+    return active, labels, medoids, cid
+
+
 def _daura_fit_tiled(xp, shape, cutoff, n_atoms, tile):
     """Greedy GROMOS loop without the resident m×m adjacency: each round's
     active-neighbor counts are a streamed tile pass (`ops/tiled.py`), and
     the extracted medoid's neighborhood is one (1, m) distance row.  Trades
     one O(m²/tile²)-GEMM pass per extracted cluster for O(tile²) memory —
     the same memory-for-recompute trade the reference's block-pair count
-    tasks made."""
+    tasks made.  One unbounded call of the chunkable extraction kernel
+    (the tiles-padded row count is arithmetic — padding happens inside
+    the jitted kernel, never eagerly)."""
     m, n = shape
-    cut2 = cutoff * cutoff * n_atoms          # rmsd² ≤ cutoff² ⇔ d² ≤ cut2
-    xv, _ = _tiled.pad_to_tiles(xp[:, :n], tile)
-    mp = xv.shape[0]
-
-    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
-    ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
-
-    def body(carry):
-        active, labels, medoids, cid = carry
-        counts, _ = _tiled.neigh_count_min(xv, cut2, ids, active,
-                                           jnp.int32(mp), tile)
-        counts = jnp.where(active, counts, -1)
-        medoid = jnp.argmax(counts).astype(jnp.int32)
-        mrow = distances_sq(xv[medoid][None, :], xv)[0]
-        members = ((mrow <= cut2) | (ids == medoid)) & active
-        labels = jnp.where(members, cid, labels)
-        medoids = medoids.at[cid].set(medoid)
-        return active & ~members, labels, medoids, cid + 1
-
+    mp = -(-xp.shape[0] // tile) * tile
+    valid = jnp.arange(mp, dtype=jnp.int32) < m
     labels0 = jnp.full((mp,), -1, jnp.int32)
     medoids0 = jnp.full((mp,), -1, jnp.int32)
-    _, labels, medoids, _ = lax.while_loop(
-        lambda c: jnp.any(c[0]), body, (valid, labels0, medoids0, jnp.int32(0)))
+    _, labels, medoids, _ = _daura_extract_tiled(
+        xp, shape, cutoff, n_atoms, tile, valid, labels0, medoids0,
+        jnp.int32(0), max_new=1 << 30)
     return labels, medoids
 
 

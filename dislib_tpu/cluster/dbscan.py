@@ -84,9 +84,17 @@ class DBSCAN(BaseEstimator):
         self.dimensions = dimensions
         self.max_samples = max_samples
 
-    def fit(self, x: Array, y=None):
+    def fit(self, x: Array, y=None, checkpoint=None):
+        """Fit.  With ``checkpoint=FitCheckpoint(path, every=k)`` the label
+        vector snapshots every k propagation rounds on the tiled tier (the
+        per-pass boundary — SURVEY §6 checkpoint/resume); a re-run resumes
+        the propagation from the snapshot and lands on the uninterrupted
+        run's clustering (min-label propagation is monotone in the label
+        vector, so resuming from any intermediate state is exact)."""
         mesh = _mesh.get_mesh()
-        if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
+        if checkpoint is not None:
+            raw, core = self._fit_tiled_checkpointed(x, checkpoint)
+        elif ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
             raw, core = _dbscan_fit_ring(x._data, x.shape, float(self.eps),
                                          int(self.min_samples), mesh)
         elif x._data.shape[0] <= _DENSE_MAX:
@@ -116,6 +124,35 @@ class DBSCAN(BaseEstimator):
         lab = jnp.asarray(self.labels_.astype(np.int32)[:, None])
         return Array._from_logical_padded(_repad(lab, (x.shape[0], 1)),
                                           (x.shape[0], 1))
+
+    def _fit_tiled_checkpointed(self, x: Array, checkpoint):
+        """Chunked tiled fit: `every` propagation rounds per dispatch, the
+        (label, core) state snapshotted between chunks.  Runs the tiled
+        tier at any size (the chunk boundary is what checkpointing needs)."""
+        from dislib_tpu.utils.checkpoint import data_digest, validate_snapshot
+        eps, ms = float(self.eps), int(self.min_samples)
+        fp = np.asarray([x.shape[0], x.shape[1], eps, ms], np.float64)
+        digest = data_digest(x._data)
+        snap = checkpoint.load()
+        if snap is not None:
+            validate_snapshot(snap, fp, digest)
+            label = jnp.asarray(snap["label"])
+            core = jnp.asarray(snap["core"])
+        else:
+            core, label = _dbscan_setup_tiled(x._data, x.shape, eps, ms,
+                                              _tiled.TILE)
+        while True:
+            label, changed = _dbscan_propagate_tiled(
+                x._data, x.shape, eps, label, core, _tiled.TILE,
+                max_rounds=checkpoint.every)
+            checkpoint.save({"label": np.asarray(jax.device_get(label)),
+                             "core": np.asarray(jax.device_get(core)),
+                             "fp": fp, "digest": digest})
+            if not bool(jax.device_get(changed)):
+                break
+        final = _dbscan_finalize_tiled(x._data, x.shape, eps, label, core,
+                                       _tiled.TILE)
+        return final, core
 
 
 @partial(jax.jit, static_argnames=("shape", "min_samples"))
@@ -165,41 +202,75 @@ def _dbscan_fit(xp, shape, eps, min_samples):
 
 @partial(jax.jit, static_argnames=("shape", "min_samples", "tile"))
 @precise
-def _dbscan_fit_tiled(xp, shape, eps, min_samples, tile):
-    """Same algorithm as `_dbscan_fit`, adjacency streamed in tiles — the
-    distance GEMM is recomputed per propagation round (O(log n) rounds via
-    pointer jumping) instead of held resident."""
+def _dbscan_setup_tiled(xp, shape, eps, min_samples, tile):
+    """Tiled tier, phase 1: core mask + initial labels (one ε-pass)."""
     m, n = shape
     xv, _ = _tiled.pad_to_tiles(xp[:, :n], tile)
     mp = xv.shape[0]
     sentinel = jnp.int32(mp)
-    eps2 = eps * eps
-
     valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
     ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
-
-    counts, _ = _tiled.neigh_count_min(xv, eps2, ids, valid, sentinel, tile)
+    counts, _ = _tiled.neigh_count_min(xv, eps * eps, ids, valid, sentinel,
+                                       tile)
     core = (counts >= min_samples) & valid
+    return core, jnp.where(core, ids, sentinel)
 
-    label0 = jnp.where(core, ids, sentinel)
+
+@partial(jax.jit, static_argnames=("shape", "tile", "max_rounds"))
+@precise
+def _dbscan_propagate_tiled(xp, shape, eps, label, core, tile, max_rounds):
+    """Tiled tier, phase 2: ≤ max_rounds min-label propagation rounds with
+    pointer jumping.  Returns (label, changed) — ``changed`` True means the
+    bound was hit mid-propagation and the caller must run another chunk
+    (the mid-fit checkpoint boundary; SURVEY §6)."""
+    m, n = shape
+    xv, _ = _tiled.pad_to_tiles(xp[:, :n], tile)
+    mp = xv.shape[0]
+    sentinel = jnp.int32(mp)
 
     def body(carry):
-        label, _ = carry
-        _, neigh_min = _tiled.neigh_count_min(xv, eps2, label, core,
+        lab, _, it = carry
+        _, neigh_min = _tiled.neigh_count_min(xv, eps * eps, lab, core,
                                               sentinel, tile)
-        new = jnp.where(core, jnp.minimum(label, neigh_min), sentinel)
+        new = jnp.where(core, jnp.minimum(lab, neigh_min), sentinel)
         jumped = jnp.where(new < sentinel, new[jnp.minimum(new, mp - 1)],
                            sentinel)
         new = jnp.minimum(new, jumped)
-        return new, jnp.any(new != label)
+        return new, jnp.any(new != lab), it + 1
 
-    label, _ = lax.while_loop(lambda c: c[1], body, (label0, jnp.bool_(True)))
+    def cond(carry):
+        return carry[1] & (carry[2] < max_rounds)
 
-    _, border_label = _tiled.neigh_count_min(xv, eps2, label, core,
+    label, changed, _ = lax.while_loop(
+        cond, body, (label, jnp.bool_(True), jnp.int32(0)))
+    return label, changed
+
+
+@partial(jax.jit, static_argnames=("shape", "tile"))
+@precise
+def _dbscan_finalize_tiled(xp, shape, eps, label, core, tile):
+    """Tiled tier, phase 3: border labels + compact -1 noise encoding."""
+    m, n = shape
+    xv, _ = _tiled.pad_to_tiles(xp[:, :n], tile)
+    mp = xv.shape[0]
+    sentinel = jnp.int32(mp)
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
+    _, border_label = _tiled.neigh_count_min(xv, eps * eps, label, core,
                                              sentinel, tile)
     final = jnp.where(core, label, jnp.where(valid, border_label, sentinel))
-    final = jnp.where(final < sentinel, final, -1)
-    return final, core
+    return jnp.where(final < sentinel, final, -1)
+
+
+def _dbscan_fit_tiled(xp, shape, eps, min_samples, tile):
+    """Same algorithm as `_dbscan_fit`, adjacency streamed in tiles — the
+    distance GEMM is recomputed per propagation round (O(log n) rounds via
+    pointer jumping) instead of held resident.  Expressed as
+    setup → propagate(unbounded) → finalize, the same three programs the
+    checkpointed fit runs in bounded chunks."""
+    core, label0 = _dbscan_setup_tiled(xp, shape, eps, min_samples, tile)
+    label, _ = _dbscan_propagate_tiled(xp, shape, eps, label0, core, tile,
+                                       max_rounds=1 << 30)
+    return _dbscan_finalize_tiled(xp, shape, eps, label, core, tile), core
 
 
 @partial(jax.jit, static_argnames=("shape", "min_samples", "mesh"))

@@ -458,6 +458,11 @@ def _normalize_index(idx, dim):
         if arr.shape[0] != dim:
             raise IndexError("boolean index length mismatch")
         arr = np.nonzero(arr)[0]
+    if arr.size and not np.issubdtype(arr.dtype, np.integer):
+        # silent float→int truncation would index the wrong rows; an empty
+        # selection (np.asarray([]) is float64) stays valid, as in NumPy
+        raise IndexError(f"fancy index must be integer or boolean, got "
+                         f"dtype {arr.dtype}")
     arr = arr.astype(np.int64)
     arr = np.where(arr < 0, arr + dim, arr)
     if arr.size and (arr.min() < 0 or arr.max() >= dim):
@@ -651,6 +656,12 @@ def apply_along_axis(func, axis, x: Array, *args, **kwargs) -> Array:
 
 def concat_rows(arrays) -> Array:
     """Stack ds-arrays vertically (logical concatenation)."""
+    arrays = list(arrays)
+    if not arrays:
+        raise ValueError("concat_rows needs at least one array")
+    cols = {a.shape[1] for a in arrays}
+    if len(cols) > 1:
+        raise ValueError(f"concat_rows: column counts differ: {sorted(cols)}")
     datas = [a._data[: a._shape[0], : a._shape[1]] for a in arrays]
     out = jnp.concatenate(datas, axis=0)
     return Array._from_logical(out, reg_shape=arrays[0]._reg_shape)
@@ -658,6 +669,12 @@ def concat_rows(arrays) -> Array:
 
 def concat_cols(arrays) -> Array:
     """Concatenate ds-arrays along columns (block-grid hstack role)."""
+    arrays = list(arrays)
+    if not arrays:
+        raise ValueError("concat_cols needs at least one array")
+    rows = {a.shape[0] for a in arrays}
+    if len(rows) > 1:
+        raise ValueError(f"concat_cols: row counts differ: {sorted(rows)}")
     datas = [a._data[: a._shape[0], : a._shape[1]] for a in arrays]
     out = jnp.concatenate(datas, axis=1)
     return Array._from_logical(out, reg_shape=arrays[0]._reg_shape)

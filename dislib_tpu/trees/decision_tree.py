@@ -212,13 +212,42 @@ class _BaseTreeEnsemble(BaseEstimator):
             return max(1, n // 3)
         return max(1, int(tf))
 
-    def _grow_forest(self, x: Array, stats_host, n_trees, bootstrap):
+    def _grow_forest(self, x: Array, stats_host, n_trees, bootstrap,
+                     checkpoint=None):
         """Dispatch the whole forest growth as device programs — no host
-        read (the async-fit half; `_adopt_forest` materialises attrs)."""
+        read (the async-fit half; `_adopt_forest` materialises attrs).
+
+        With ``checkpoint`` the grown-so-far state (node assignment,
+        bootstrap weights, per-level splits, seed, level counter)
+        snapshots every `every` LEVELS — trees grow level-synchronously,
+        so a level boundary is the natural resumable point (SURVEY §6);
+        the PRNG key chain is re-derived from the stored seed so a resumed
+        growth is bit-identical to the uninterrupted one.  Checkpointed
+        growth reads state to host between chunks (only then)."""
         m, n = x.shape
         depth = self._effective_depth(m)
-        seed = self.random_state if self.random_state is not None else \
-            np.random.randint(0, 2**31 - 1)
+        snap = fp = digest = None
+        if checkpoint is not None:
+            from dislib_tpu.utils.checkpoint import (data_digest,
+                                                     validate_snapshot)
+            tf = self._try_features_count(n)
+            rs = self.random_state
+            # every knob the grown state depends on is fingerprinted —
+            # resuming with a changed seed or feature-sampling width must
+            # refuse, not grow a hybrid forest (round-4 review)
+            fp = np.asarray([m, n, n_trees, depth, int(bootstrap),
+                             float(("gini", "mse").index(self._criterion)),
+                             -1.0 if tf is None else float(tf),
+                             -1.0 if rs is None else float(rs)], np.float64)
+            digest = data_digest(x._data, stats=stats_host)
+            snap = checkpoint.load()
+            if snap is not None:
+                validate_snapshot(snap, fp, digest)
+        if snap is not None:
+            seed = int(snap["seed"])
+        else:
+            seed = self.random_state if self.random_state is not None else \
+                np.random.randint(0, 2**31 - 1)
         key = jax.random.PRNGKey(int(seed))
 
         edges = _quantile_bins(x._data, x.shape)
@@ -227,18 +256,29 @@ class _BaseTreeEnsemble(BaseEstimator):
         valid = (np.arange(mp) < m).astype(np.float32)
 
         k_boot, key = jax.random.split(key)
-        if bootstrap:
-            w = jax.random.poisson(k_boot, 1.0, (n_trees, mp)).astype(jnp.float32)
+        if snap is not None:
+            start_lvl = int(snap["lvl"])
+            node = jnp.asarray(snap["node"])
+            w = jnp.asarray(snap["w"])
+            feats = [jnp.asarray(snap[f"feats_{i}"]) for i in range(start_lvl)]
+            tbins = [jnp.asarray(snap[f"tbins_{i}"]) for i in range(start_lvl)]
+            for _ in range(start_lvl):       # replay the key chain
+                key, _ = jax.random.split(key)
         else:
-            w = jnp.ones((n_trees, mp), jnp.float32)
-        w = w * jnp.asarray(valid)[None, :]
+            start_lvl = 0
+            if bootstrap:
+                w = jax.random.poisson(k_boot, 1.0,
+                                       (n_trees, mp)).astype(jnp.float32)
+            else:
+                w = jnp.ones((n_trees, mp), jnp.float32)
+            w = w * jnp.asarray(valid)[None, :]
+            node = jnp.zeros((n_trees, mp), jnp.int32)
+            feats, tbins = [], []
 
         stats = jnp.asarray(stats_host)               # (mp, S)
         try_features = self._try_features_count(n)
 
-        node = jnp.zeros((n_trees, mp), jnp.int32)
-        feats, tbins = [], []
-        for lvl in range(depth):
+        for lvl in range(start_lvl, depth):
             key, k_lvl = jax.random.split(key)
             keys = jax.random.split(k_lvl, n_trees)
             feat, tbin, is_split, node, _ = _forest_level(
@@ -246,6 +286,16 @@ class _BaseTreeEnsemble(BaseEstimator):
                 0.0, self._criterion)
             feats.append(feat)
             tbins.append(tbin)
+            if checkpoint is not None and (lvl + 1 - start_lvl) \
+                    % checkpoint.every == 0 and lvl + 1 < depth:
+                state = {"lvl": lvl + 1, "seed": seed, "fp": fp,
+                         "digest": digest,
+                         "node": np.asarray(jax.device_get(node)),
+                         "w": np.asarray(jax.device_get(w))}
+                for i, (f_, t_) in enumerate(zip(feats, tbins)):
+                    state[f"feats_{i}"] = np.asarray(jax.device_get(f_))
+                    state[f"tbins_{i}"] = np.asarray(jax.device_get(t_))
+                checkpoint.save(state)
 
         leaves = _leaf_stats(node, w, stats, 2 ** depth)
         # feats/tbins stay as the ragged per-level device arrays: packing
@@ -277,10 +327,11 @@ class _BaseTreeEnsemble(BaseEstimator):
         self.n_features_ = grown["n_features"]
         return self
 
-    def fit(self, x: Array, y: Array):
+    def fit(self, x: Array, y: Array, checkpoint=None):
         """Shared fit = the async protocol run to completion (one recipe —
-        sync and async fits cannot diverge)."""
-        self._fit_finalize(self._fit_async(x, y))
+        sync and async fits cannot diverge).  ``checkpoint``: see
+        `_grow_forest` (per-level snapshots + resume)."""
+        self._fit_finalize(self._fit_async(x, y, checkpoint=checkpoint))
         return self
 
     # async trial protocol (SURVEY §4.5): growth is read-free device
@@ -288,12 +339,13 @@ class _BaseTreeEnsemble(BaseEstimator):
     # reads the INPUT y (prep, not fit results) at dispatch time, cached
     # per (y, padding) so a search encodes each fold once, not once per
     # candidate.
-    def _fit_async(self, x, y=None):
+    def _fit_async(self, x, y=None, checkpoint=None):
         if y is None:
             raise ValueError(f"{type(self).__name__} requires y")
         stats = self._encode_stats(x, y)
         n_trees, bootstrap = self._fit_spec()
-        return self._grow_forest(x, stats, n_trees, bootstrap)
+        return self._grow_forest(x, stats, n_trees, bootstrap,
+                                 checkpoint=checkpoint)
 
     def _fit_finalize(self, state):
         if state is None:

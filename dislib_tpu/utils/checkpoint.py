@@ -69,3 +69,36 @@ class FitCheckpoint:
     def delete(self) -> None:
         if os.path.exists(self.path):
             os.remove(self.path)
+
+
+def data_digest(xp, stats=None):
+    """Order-sensitive float64 digest of a (padded) device matrix — plain
+    and index-weighted sums, so a row permutation changes it.  Pad rows are
+    zero under the pad-and-mask invariant, so padded sums equal logical
+    sums.  Best-effort (a tiny relative perturbation at very large m can
+    evade a sum digest); NaN digests never match → NaN data fails closed.
+    ``stats`` (host per-row stats, e.g. tree label encodings) contributes
+    the same two sums when given."""
+    import jax
+    import jax.numpy as jnp
+    riota = jnp.arange(xp.shape[0], dtype=jnp.float32)
+    vals = [float(jax.device_get(jnp.sum(xp))),
+            float(jax.device_get(jnp.einsum("ij,i->", xp, riota)))]
+    if stats is not None:
+        vals += [float(np.sum(stats)),
+                 float(np.arange(stats.shape[0]) @ np.sum(stats, axis=1))]
+    return np.asarray(vals, np.float64)
+
+
+def validate_snapshot(snap, fp, digest):
+    """Refuse a snapshot whose fingerprint/digest doesn't match this fit —
+    shared by every checkpointed estimator so the guard can't drift.
+    Foreign .npz files (missing keys) fail the same way."""
+    ok = ("fp" in snap and "digest" in snap
+          and np.array_equal(snap["fp"], fp)
+          and np.shape(snap["digest"]) == np.shape(digest)
+          and np.allclose(snap["digest"], digest, rtol=1e-5, atol=1e-6))
+    if not ok:
+        raise ValueError(
+            "checkpoint does not match this data/estimator (shape, data "
+            "content or hyperparameters differ) — stale or foreign snapshot")
