@@ -171,7 +171,17 @@ def _kneighbors_sparse(x, f, k):
         rows_in = jnp.minimum(chunk, f.shape[0] - row_off).astype(jnp.int32)
         f_args = (None, None, None, row_off, rows_in,
                   f._data[: f.shape[0], : f.shape[1]])
+    mesh = _mesh.get_mesh()
     if isinstance(x, SparseArray):
+        if mesh.shape[_mesh.ROWS] > 1:
+            # row-sharded schedule: each shard rebuilds its local BCOO
+            # from the rectangular `sharded_rows` buffers and streams the
+            # replicated fit windows — same shard_map reasoning as the
+            # dense-query path (GSPMD would gather the top-k operand)
+            qdat, qlr, qcol, qrsq = x.sharded_rows(mesh)
+            return _kneighbors_sparse_sharded_sq(
+                qdat, qlr, qcol, qrsq, *f_args, n=n, mq=x.shape[0],
+                mf=f.shape[0], k=k, chunk=chunk, mesh=mesh)
         q_bcoo = x._bcoo
         q_rowsq = x.row_norms_sq()
         return _kneighbors_sparse_kernel(
@@ -179,7 +189,7 @@ def _kneighbors_sparse(x, f, k):
             mf=f.shape[0], k=k, chunk=chunk)
     return _kneighbors_sparse_sharded_q(
         x._data, *f_args[:5], n=n, mq=x.shape[0], mf=f.shape[0], k=k,
-        chunk=chunk, mesh=_mesh.get_mesh())
+        chunk=chunk, mesh=mesh)
 
 
 @partial(jax.jit, static_argnames=("n", "mq", "mf", "k", "chunk", "mesh"))
@@ -217,6 +227,53 @@ def _kneighbors_sparse_sharded_q(qp, fdat, flr, fcol, row_off, rows_in,
         out_specs=(P(_mesh.ROWS, None), P(_mesh.ROWS, None)),
         check_vma=True,
     )(qp, fdat, flr, fcol, row_off, rows_in)
+
+
+@partial(jax.jit, static_argnames=("n", "mq", "mf", "k", "chunk", "mesh"))
+@precise
+def _kneighbors_sparse_sharded_sq(qdat, qlr, qcol, qrsq, fdat, flr, fcol,
+                                  row_off, rows_in, f_dense, n, mq, mf, k,
+                                  chunk, mesh):
+    """SPARSE queries over a streamed fit set, row-sharded by hand: each
+    shard rebuilds its local-row BCOO from the rectangular `sharded_rows`
+    buffers (padding entries are v=0 → contribute nothing) and runs the
+    same streamed top-k; per-shard spmm work is O(nnz/p · chunk), the
+    same economics as the sparse KMeans E-step."""
+    from jax.experimental import sparse as jsparse
+    p = mesh.shape[_mesh.ROWS]
+    m_loc = qrsq.shape[1]
+
+    def local(qd_s, qlr_s, qcol_s, qrsq_s, *f_s):
+        fs = iter(f_s)
+        fdat_l = next(fs) if fdat is not None else None
+        flr_l = next(fs) if flr is not None else None
+        fcol_l = next(fs) if fcol is not None else None
+        ro_l = next(fs)
+        ri_l = next(fs)
+        fd_l = next(fs) if f_dense is not None else None
+        idx = jnp.stack([qlr_s[0], qcol_s[0]], axis=1)
+        bcoo = jsparse.BCOO((qd_s[0], idx), shape=(m_loc, n))
+        neg, idxk = _stream_topk(None, qrsq_s[0], bcoo, fdat_l, flr_l,
+                                 fcol_l, ro_l, ri_l, fd_l, n, mf, k, chunk,
+                                 varying_axes=(_mesh.ROWS,))
+        d = jnp.sqrt(jnp.maximum(-neg, 0.0))
+        my = lax.axis_index(_mesh.ROWS)
+        valid = (my * m_loc
+                 + lax.broadcasted_iota(jnp.int32, (m_loc, 1), 0)) < mq
+        return (jnp.where(valid, d, 0.0)[None],
+                jnp.where(valid, idxk, 0)[None])
+
+    f_ops = [a for a in (fdat, flr, fcol, row_off, rows_in, f_dense)
+             if a is not None]
+    repl = [P(*([None] * a.ndim)) for a in f_ops]
+    d, idxk = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(_mesh.ROWS), P(_mesh.ROWS), P(_mesh.ROWS),
+                  P(_mesh.ROWS), *repl),
+        out_specs=(P(_mesh.ROWS), P(_mesh.ROWS)),
+        check_vma=True,
+    )(qdat, qlr, qcol, qrsq, *f_ops)
+    return d.reshape(p * m_loc, k), idxk.reshape(p * m_loc, k)
 
 
 def _stream_topk(qv, q_rowsq, q_bcoo, fdat, flr, fcol, row_off, rows_in,

@@ -275,6 +275,46 @@ class TestSparseStagingCommAudit:
                                            axis=1), rtol=1e-4, atol=1e-4)
 
 
+    def test_sparse_query_knn_no_gather(self, rng):
+        """Sparse queries (round-4b): per-shard local BCOO from
+        sharded_rows + replicated windows — no operand-scale collective."""
+        _needs_multirow()
+        import scipy.sparse as sp
+        from dislib_tpu.data.sparse import SparseArray
+        from dislib_tpu.neighbors import NearestNeighbors
+        from dislib_tpu.neighbors.base import (_kneighbors_sparse_sharded_sq,
+                                               _CHUNK)
+        mq, mf, n, k = 2048, 500, 16, 3
+        q = SparseArray.from_scipy(sp.random(mq, n, density=0.15,
+                                             random_state=1,
+                                             dtype=np.float32).tocsr())
+        f = SparseArray.from_scipy(sp.random(mf, n, density=0.1,
+                                             random_state=0,
+                                             dtype=np.float32).tocsr())
+        mesh = _mesh.get_mesh()
+        chunk = min(_CHUNK, mf)
+        qdat, qlr, qcol, qrsq = q.sharded_rows(mesh)
+        hlo = _kneighbors_sparse_sharded_sq.lower(
+            qdat, qlr, qcol, qrsq, *f.row_steps(chunk), None, n=n, mq=mq,
+            mf=mf, k=k, chunk=chunk, mesh=mesh).compile().as_text()
+        _assert_no_operand_gather(hlo, mq * n)
+        for op in ("all-gather", "all-to-all", "collective-permute"):
+            for elems in _collective_sizes(hlo, op):
+                assert elems < mq * n, \
+                    f"{op} of {elems} elems covers the query operand"
+        # oracle at the sharded shape, both fit kinds
+        qd = q.collect().toarray()
+        for fit in (f, ds.array(f.collect().toarray())):
+            d, i = NearestNeighbors(n_neighbors=k).fit(fit).kneighbors(q)
+            xd = f.collect().toarray()
+            ref = np.sqrt(np.maximum(
+                (qd * qd).sum(1)[:, None] - 2 * qd @ xd.T
+                + (xd * xd).sum(1)[None], 0.0))
+            np.testing.assert_allclose(
+                np.asarray(d.collect()), np.sort(ref, axis=1)[:, :k],
+                rtol=1e-4, atol=1e-4)
+
+
 class TestRingKnnCommAudit:
     """Ring kNN rotates one fitted SHARD per hop (ppermute); the fitted set
     never materialises on one device."""
