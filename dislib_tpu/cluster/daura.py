@@ -62,16 +62,18 @@ class Daura(BaseEstimator):
     def fit(self, x: Array, y=None, checkpoint=None):
         """Fit.  With ``checkpoint=FitCheckpoint(path, every=k)`` the greedy
         state (active mask, labels, medoids, cluster counter) snapshots
-        every k extracted clusters on the tiled tier; a re-run resumes the
-        extraction and lands on the uninterrupted run's clustering (the
-        greedy loop is deterministic in its carried state — SURVEY §6)."""
+        every k extracted clusters, on whichever streamed tier the plain
+        fit would pick (ring on a multi-row mesh, tiled otherwise); a
+        re-run resumes the extraction and lands on the uninterrupted
+        run's clustering (the greedy loop is deterministic in its carried
+        state — SURVEY §6)."""
         if x.shape[1] % 3 != 0:
             raise ValueError("Daura expects rows of 3*n_atoms coordinates")
         n_atoms = x.shape[1] // 3
         mesh = _mesh.get_mesh()
         if checkpoint is not None:
-            labels, medoids = self._fit_tiled_checkpointed(x, n_atoms,
-                                                           checkpoint)
+            labels, medoids = self._fit_checkpointed(x, n_atoms, checkpoint,
+                                                     mesh)
         elif ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
             labels, medoids = _daura_fit_ring(x._data, x.shape,
                                               float(self.cutoff), n_atoms,
@@ -100,17 +102,34 @@ class Daura(BaseEstimator):
         return Array._from_logical_padded(_repad(lab, (x.shape[0], 1)),
                                           (x.shape[0], 1))
 
-    def _fit_tiled_checkpointed(self, x: Array, n_atoms, checkpoint):
-        """Chunked tiled fit: `every` cluster extractions per dispatch, the
-        greedy state snapshotted between chunks."""
+    def _fit_checkpointed(self, x: Array, n_atoms, checkpoint, mesh):
+        """Chunked fit: `every` cluster extractions per dispatch, the
+        greedy state snapshotted between chunks.  The ring tier is picked
+        by the same policy as the plain fit (scale-out + fault tolerance
+        compose); the pad width in the fingerprint pins the tier so a
+        resume can't mix label paddings."""
         from dislib_tpu.utils.checkpoint import data_digest, validate_snapshot
         cutoff = float(self.cutoff)
-        fp = np.asarray([x.shape[0], x.shape[1], cutoff], np.float64)
+        ring = ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX)
+        if ring:
+            mp = x._data.shape[0]
+
+            def extract(active, labels, medoids, cid):
+                return _daura_extract_ring(
+                    x._data, cutoff, n_atoms, mesh, active, labels,
+                    medoids, cid, max_new=checkpoint.every)
+        else:
+            # tiles-padded row count, computed arithmetically (pad_to_tiles'
+            # own formula) — no eager padded copy of the dataset
+            mp = -(-x._data.shape[0] // _tiled.TILE) * _tiled.TILE
+
+            def extract(active, labels, medoids, cid):
+                return _daura_extract_tiled(
+                    x._data, x.shape, cutoff, n_atoms, _tiled.TILE, active,
+                    labels, medoids, cid, max_new=checkpoint.every)
+        fp = np.asarray([x.shape[0], x.shape[1], cutoff, mp], np.float64)
         digest = data_digest(x._data)
         snap = checkpoint.load()
-        # tiles-padded row count, computed arithmetically (pad_to_tiles'
-        # own formula) — no eager padded copy of the dataset
-        mp = -(-x._data.shape[0] // _tiled.TILE) * _tiled.TILE
         if snap is not None:
             validate_snapshot(snap, fp, digest)
             active = jnp.asarray(snap["active"])
@@ -123,9 +142,8 @@ class Daura(BaseEstimator):
             medoids = jnp.full((mp,), -1, jnp.int32)
             cid = jnp.int32(0)
         while True:
-            active, labels, medoids, cid = _daura_extract_tiled(
-                x._data, x.shape, cutoff, n_atoms, _tiled.TILE, active,
-                labels, medoids, cid, max_new=checkpoint.every)
+            active, labels, medoids, cid = extract(active, labels, medoids,
+                                                   cid)
             done = not bool(jax.device_get(jnp.any(active)))
             checkpoint.save({"active": np.asarray(jax.device_get(active)),
                              "labels": np.asarray(jax.device_get(labels)),
@@ -228,34 +246,45 @@ def _daura_fit_tiled(xp, shape, cutoff, n_atoms, tile):
     return labels, medoids
 
 
-@partial(jax.jit, static_argnames=("shape", "n_atoms", "mesh"))
+@partial(jax.jit, static_argnames=("n_atoms", "mesh", "max_new"))
 @precise
-def _daura_fit_ring(xp, shape, cutoff, n_atoms, mesh):
-    """`_daura_fit_tiled` with the per-round active-neighbor counts
-    ring-distributed over the mesh 'rows' axis (ops/ring.py): frames stay
-    row-sharded, only the medoid's (1, m) distance row and the greedy
-    control flow are global."""
-    m, n = shape
+def _daura_extract_ring(xp, cutoff, n_atoms, mesh, active, labels,
+                        medoids, cid, max_new):
+    """Ring-tier bounded extraction: ≤ max_new clusters from the current
+    greedy state, active-neighbor counts ring-distributed over the mesh
+    'rows' axis (ops/ring.py) — frames stay row-sharded, only the
+    medoid's (1, m) distance row and the greedy control flow are global.
+    The bound is the mid-fit checkpoint boundary, as in the tiled tier."""
     cut2 = jnp.asarray(cutoff * cutoff * n_atoms, xp.dtype)
     mp = xp.shape[0]
-
-    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
     ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
 
     def body(carry):
-        active, labels, medoids, cid = carry
-        counts, _ = ring_neigh_count_min(xp, cut2, ids, active,
+        active_, labels_, medoids_, cid_, k = carry
+        counts, _ = ring_neigh_count_min(xp, cut2, ids, active_,
                                          jnp.int32(mp), mesh)
-        counts = jnp.where(active, counts, -1)
+        counts = jnp.where(active_, counts, -1)
         medoid = jnp.argmax(counts).astype(jnp.int32)
         mrow = distances_sq(xp[medoid][None, :], xp)[0]
-        members = ((mrow <= cut2) | (ids == medoid)) & active
-        labels = jnp.where(members, cid, labels)
-        medoids = medoids.at[cid].set(medoid)
-        return active & ~members, labels, medoids, cid + 1
+        members = ((mrow <= cut2) | (ids == medoid)) & active_
+        labels_ = jnp.where(members, cid_, labels_)
+        medoids_ = medoids_.at[cid_].set(medoid)
+        return active_ & ~members, labels_, medoids_, cid_ + 1, k + 1
 
+    active, labels, medoids, cid, _ = lax.while_loop(
+        lambda c: jnp.any(c[0]) & (c[4] < max_new), body,
+        (active, labels, medoids, cid, jnp.int32(0)))
+    return active, labels, medoids, cid
+
+
+def _daura_fit_ring(xp, shape, cutoff, n_atoms, mesh):
+    """One unbounded call of the ring extraction kernel."""
+    m, _ = shape
+    mp = xp.shape[0]
+    valid = jnp.arange(mp, dtype=jnp.int32) < m
     labels0 = jnp.full((mp,), -1, jnp.int32)
     medoids0 = jnp.full((mp,), -1, jnp.int32)
-    _, labels, medoids, _ = lax.while_loop(
-        lambda c: jnp.any(c[0]), body, (valid, labels0, medoids0, jnp.int32(0)))
+    _, labels, medoids, _ = _daura_extract_ring(
+        xp, cutoff, n_atoms, mesh, valid, labels0, medoids0,
+        jnp.int32(0), max_new=1 << 30)
     return labels, medoids

@@ -86,14 +86,16 @@ class DBSCAN(BaseEstimator):
 
     def fit(self, x: Array, y=None, checkpoint=None):
         """Fit.  With ``checkpoint=FitCheckpoint(path, every=k)`` the label
-        vector snapshots every k propagation rounds on the tiled tier (the
-        per-pass boundary — SURVEY §6 checkpoint/resume); a re-run resumes
-        the propagation from the snapshot and lands on the uninterrupted
+        vector snapshots every k propagation rounds (the per-pass boundary
+        — SURVEY §6 checkpoint/resume) on whichever streamed tier the
+        plain fit would pick — ring on a multi-row mesh, tiled otherwise,
+        so scale-out and fault tolerance compose.  A re-run resumes the
+        propagation from the snapshot and lands on the uninterrupted
         run's clustering (min-label propagation is monotone in the label
         vector, so resuming from any intermediate state is exact)."""
         mesh = _mesh.get_mesh()
         if checkpoint is not None:
-            raw, core = self._fit_tiled_checkpointed(x, checkpoint)
+            raw, core = self._fit_checkpointed(x, checkpoint, mesh)
         elif ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
             raw, core = _dbscan_fit_ring(x._data, x.shape, float(self.eps),
                                          int(self.min_samples), mesh)
@@ -125,13 +127,47 @@ class DBSCAN(BaseEstimator):
         return Array._from_logical_padded(_repad(lab, (x.shape[0], 1)),
                                           (x.shape[0], 1))
 
-    def _fit_tiled_checkpointed(self, x: Array, checkpoint):
-        """Chunked tiled fit: `every` propagation rounds per dispatch, the
-        (label, core) state snapshotted between chunks.  Runs the tiled
-        tier at any size (the chunk boundary is what checkpointing needs)."""
+    def _fit_checkpointed(self, x: Array, checkpoint, mesh):
+        """Chunked fit: `every` propagation rounds per dispatch, the
+        (label, core) state snapshotted between chunks.  The ring tier is
+        picked by the same policy as the plain fit (scale-out and fault
+        tolerance compose); otherwise the tiled tier runs at any size
+        (the chunk boundary is what checkpointing needs).  The snapshot
+        format is tier-independent except for the pad width, which the
+        fingerprint pins (a resume on a different mesh/tier refuses
+        rather than mixing label paddings)."""
         from dislib_tpu.utils.checkpoint import data_digest, validate_snapshot
         eps, ms = float(self.eps), int(self.min_samples)
-        fp = np.asarray([x.shape[0], x.shape[1], eps, ms], np.float64)
+        if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
+            mp = x._data.shape[0]
+
+            def setup():
+                return _dbscan_setup_ring(x._data, x.shape, eps, ms, mesh)
+
+            def propagate(lab, core):
+                return _dbscan_propagate_ring(
+                    x._data, eps, lab, core, mesh,
+                    max_rounds=checkpoint.every)
+
+            def finalize(lab, core):
+                return _dbscan_finalize_ring(x._data, x.shape, eps, lab,
+                                             core, mesh)
+        else:
+            mp = -(-x._data.shape[0] // _tiled.TILE) * _tiled.TILE
+
+            def setup():
+                return _dbscan_setup_tiled(x._data, x.shape, eps, ms,
+                                           _tiled.TILE)
+
+            def propagate(lab, core):
+                return _dbscan_propagate_tiled(
+                    x._data, x.shape, eps, lab, core, _tiled.TILE,
+                    max_rounds=checkpoint.every)
+
+            def finalize(lab, core):
+                return _dbscan_finalize_tiled(x._data, x.shape, eps, lab,
+                                              core, _tiled.TILE)
+        fp = np.asarray([x.shape[0], x.shape[1], eps, ms, mp], np.float64)
         digest = data_digest(x._data)
         snap = checkpoint.load()
         if snap is not None:
@@ -139,20 +175,15 @@ class DBSCAN(BaseEstimator):
             label = jnp.asarray(snap["label"])
             core = jnp.asarray(snap["core"])
         else:
-            core, label = _dbscan_setup_tiled(x._data, x.shape, eps, ms,
-                                              _tiled.TILE)
+            core, label = setup()
         while True:
-            label, changed = _dbscan_propagate_tiled(
-                x._data, x.shape, eps, label, core, _tiled.TILE,
-                max_rounds=checkpoint.every)
+            label, changed = propagate(label, core)
             checkpoint.save({"label": np.asarray(jax.device_get(label)),
                              "core": np.asarray(jax.device_get(core)),
                              "fp": fp, "digest": digest})
             if not bool(jax.device_get(changed)):
                 break
-        final = _dbscan_finalize_tiled(x._data, x.shape, eps, label, core,
-                                       _tiled.TILE)
-        return final, core
+        return finalize(label, core), core
 
 
 @partial(jax.jit, static_argnames=("shape", "min_samples"))
@@ -275,38 +306,69 @@ def _dbscan_fit_tiled(xp, shape, eps, min_samples, tile):
 
 @partial(jax.jit, static_argnames=("shape", "min_samples", "mesh"))
 @precise
-def _dbscan_fit_ring(xp, shape, eps, min_samples, mesh):
-    """Same algorithm as `_dbscan_fit_tiled`, ε-passes ring-distributed over
-    the mesh 'rows' axis (`ops/ring.ring_neigh_count_min`): each device
-    keeps only its row shard resident, label vectors stay row-sharded, and
-    the pointer-jump gather is a sharded global gather handled by SPMD."""
-    m, n = shape
+def _dbscan_setup_ring(xp, shape, eps, min_samples, mesh):
+    """Ring tier, phase 1: core mask + initial labels (one ring ε-pass)."""
+    m, _ = shape
+    mp = xp.shape[0]
+    sentinel = jnp.int32(mp)
+    eps2 = jnp.asarray(eps * eps, xp.dtype)
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
+    ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
+    counts, _ = ring_neigh_count_min(xp, eps2, ids, valid, sentinel, mesh)
+    core = (counts >= min_samples) & valid
+    return core, jnp.where(core, ids, sentinel)
+
+
+@partial(jax.jit, static_argnames=("mesh", "max_rounds"))
+@precise
+def _dbscan_propagate_ring(xp, eps, label, core, mesh, max_rounds):
+    """Ring tier, phase 2: ≤ max_rounds propagation rounds (checkpoint
+    chunk boundary, same contract as the tiled variant).  Needs no
+    logical shape: validity is already encoded in `core`, and the ring
+    pass relies on the zero-pad invariant for feature columns."""
     mp = xp.shape[0]
     sentinel = jnp.int32(mp)
     eps2 = jnp.asarray(eps * eps, xp.dtype)
 
-    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
-    ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
-
-    counts, _ = ring_neigh_count_min(xp, eps2, ids, valid, sentinel, mesh)
-    core = (counts >= min_samples) & valid
-
-    label0 = jnp.where(core, ids, sentinel)
-
     def body(carry):
-        label, _ = carry
-        _, neigh_min = ring_neigh_count_min(xp, eps2, label, core, sentinel,
+        lab, _, it = carry
+        _, neigh_min = ring_neigh_count_min(xp, eps2, lab, core, sentinel,
                                             mesh)
-        new = jnp.where(core, jnp.minimum(label, neigh_min), sentinel)
+        new = jnp.where(core, jnp.minimum(lab, neigh_min), sentinel)
         jumped = jnp.where(new < sentinel, new[jnp.minimum(new, mp - 1)],
                            sentinel)
         new = jnp.minimum(new, jumped)
-        return new, jnp.any(new != label)
+        return new, jnp.any(new != lab), it + 1
 
-    label, _ = lax.while_loop(lambda c: c[1], body, (label0, jnp.bool_(True)))
+    label, changed, _ = lax.while_loop(
+        lambda c: c[1] & (c[2] < max_rounds), body,
+        (label, jnp.bool_(True), jnp.int32(0)))
+    return label, changed
 
+
+@partial(jax.jit, static_argnames=("shape", "mesh"))
+@precise
+def _dbscan_finalize_ring(xp, shape, eps, label, core, mesh):
+    """Ring tier, phase 3: border labels + compact -1 noise encoding."""
+    m, _ = shape
+    mp = xp.shape[0]
+    sentinel = jnp.int32(mp)
+    eps2 = jnp.asarray(eps * eps, xp.dtype)
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
     _, border_label = ring_neigh_count_min(xp, eps2, label, core, sentinel,
                                            mesh)
     final = jnp.where(core, label, jnp.where(valid, border_label, sentinel))
-    final = jnp.where(final < sentinel, final, -1)
-    return final, core
+    return jnp.where(final < sentinel, final, -1)
+
+
+def _dbscan_fit_ring(xp, shape, eps, min_samples, mesh):
+    """Same algorithm as `_dbscan_fit_tiled`, ε-passes ring-distributed over
+    the mesh 'rows' axis (`ops/ring.ring_neigh_count_min`): each device
+    keeps only its row shard resident, label vectors stay row-sharded, and
+    the pointer-jump gather is a sharded global gather handled by SPMD.
+    Expressed as setup → propagate(unbounded) → finalize, the same three
+    programs the checkpointed ring fit runs in bounded chunks."""
+    core, label0 = _dbscan_setup_ring(xp, shape, eps, min_samples, mesh)
+    label, _ = _dbscan_propagate_ring(xp, eps, label0, core, mesh,
+                                      max_rounds=1 << 30)
+    return _dbscan_finalize_ring(xp, shape, eps, label, core, mesh), core

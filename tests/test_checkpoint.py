@@ -361,3 +361,49 @@ class TestTiledPassCheckpoint:
         with pytest.raises(ValueError, match="stale or foreign"):
             DBSCAN(eps=1.0, min_samples=4).fit(
                 x, checkpoint=FitCheckpoint(path, every=1))
+
+    def test_dbscan_ring_tier_kill_resume(self, rng, tmp_path, monkeypatch):
+        """Checkpointing composes with the ring (multi-device) tier: the
+        chunked fit follows the same tier policy as the plain fit."""
+        from dislib_tpu.cluster import DBSCAN
+        from dislib_tpu.cluster import dbscan as dbscan_mod
+        monkeypatch.setattr(dbscan_mod, "_RING", True)
+        x = ds.array(self._blobs3(rng))
+        plain = DBSCAN(eps=1.0, min_samples=4).fit(x)
+        path = str(tmp_path / "dbr.npz")
+        with pytest.raises(KeyboardInterrupt):
+            DBSCAN(eps=1.0, min_samples=4).fit(
+                x, checkpoint=_KillAfter(path, every=1, kill_after=1))
+        res = DBSCAN(eps=1.0, min_samples=4).fit(
+            x, checkpoint=FitCheckpoint(path, every=1))
+        np.testing.assert_array_equal(res.labels_, plain.labels_)
+        assert res.n_clusters_ == plain.n_clusters_ == 3
+
+    def test_daura_ring_tier_kill_resume(self, rng, tmp_path, monkeypatch):
+        from dislib_tpu.cluster import Daura
+        from dislib_tpu.cluster import daura as daura_mod
+        monkeypatch.setattr(daura_mod, "_RING", True)
+        xx = ds.array(np.hstack([self._blobs3(rng, n=60)] * 3))
+        plain = Daura(cutoff=2.0).fit(xx)
+        path = str(tmp_path / "dar.npz")
+        with pytest.raises(KeyboardInterrupt):
+            Daura(cutoff=2.0).fit(
+                xx, checkpoint=_KillAfter(path, every=1, kill_after=1))
+        res = Daura(cutoff=2.0).fit(
+            xx, checkpoint=FitCheckpoint(path, every=1))
+        np.testing.assert_array_equal(res.labels_, plain.labels_)
+
+    def test_tier_mismatch_refuses(self, rng, tmp_path, monkeypatch):
+        """A snapshot written on one tier must refuse to resume on the
+        other (pad widths differ — pinned via the fingerprint)."""
+        from dislib_tpu.cluster import DBSCAN
+        from dislib_tpu.cluster import dbscan as dbscan_mod
+        x = ds.array(self._blobs3(rng))
+        path = str(tmp_path / "dbt.npz")
+        with pytest.raises(KeyboardInterrupt):
+            DBSCAN(eps=1.0, min_samples=4).fit(     # tiled-tier snapshot
+                x, checkpoint=_KillAfter(path, every=1, kill_after=1))
+        monkeypatch.setattr(dbscan_mod, "_RING", True)
+        with pytest.raises(ValueError, match="stale or foreign"):
+            DBSCAN(eps=1.0, min_samples=4).fit(     # ring-tier resume
+                x, checkpoint=FitCheckpoint(path, every=1))
