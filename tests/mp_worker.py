@@ -150,6 +150,24 @@ def main():
     shuffle_ok = sorted(map(tuple, xsh.tolist())) == \
         sorted(map(tuple, xs_host.tolist()))
 
+    # sparse tier crosses the process boundary too (round 4): row-sharded
+    # BCOO KMeans (shard_map segment-sum E-step + psum over the DCN axis)
+    # vs the dense path on the same matrix, and the sharded sparse-fit
+    # kNN stream with dense queries
+    import scipy.sparse as sp
+    from dislib_tpu.data.sparse import SparseArray
+    xsp_host = xs_host.copy()
+    xsp_host[xsp_host < 0.5] = 0.0
+    s_arr = SparseArray.from_scipy(sp.csr_matrix(xsp_host))
+    km_sp = KMeans(n_clusters=3, init=xsp_host[:3].copy(), max_iter=3,
+                   tol=0.0).fit(s_arr)
+    km_dn = KMeans(n_clusters=3, init=xsp_host[:3].copy(), max_iter=3,
+                   tol=0.0).fit(ds.array(xsp_host, block_size=(16, 5)))
+    sparse_centers_close = bool(np.allclose(km_sp.centers_, km_dn.centers_,
+                                            rtol=1e-3, atol=1e-3))
+    d_sp, _ = NearestNeighbors(n_neighbors=3).fit(s_arr).kneighbors(x)
+    sparse_knn_sum = float(np.asarray(d_sp.collect()).sum())
+
     # SPMD discipline: EVERY rank runs the same collectives in the same
     # order (collect() is a process_allgather) — only the file write is
     # rank-conditional
@@ -163,7 +181,9 @@ def main():
                        "gram_trace": gram_trace,
                        "qr_err": qr_err,
                        "shuffle_ok": bool(shuffle_ok),
-                       "ring_d_sum": float(ring_d.sum())}, f)
+                       "ring_d_sum": float(ring_d.sum()),
+                       "sparse_centers_close": sparse_centers_close,
+                       "sparse_knn_sum": sparse_knn_sum}, f)
     print(f"worker {rank} done", flush=True)
 
 

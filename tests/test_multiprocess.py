@@ -94,6 +94,17 @@ def test_two_process_kmeans_matches_single(tmp_path):
     k3 = np.sqrt(np.maximum(np.sort(dd, axis=1)[:, :3], 0.0))
     np.testing.assert_allclose(got["ring_d_sum"], k3.sum(), rtol=1e-3)
 
+    # sparse tier across the process boundary: BCOO KMeans matched the
+    # dense path in-worker, and the sharded sparse-fit kNN stream matches
+    # the host oracle
+    assert got["sparse_centers_close"], \
+        "multi-host sparse KMeans diverged from the dense path"
+    xsp = parsed.copy()
+    xsp[xsp < 0.5] = 0.0
+    dsp = ((parsed[:, None, :] - xsp[None]) ** 2).sum(-1)
+    k3s = np.sqrt(np.maximum(np.sort(dsp, axis=1)[:, :3], 0.0))
+    np.testing.assert_allclose(got["sparse_knn_sum"], k3s.sum(), rtol=1e-3)
+
 
 def _run_crashfit(tmp_path, csv, tag, crash_after):
     out = str(tmp_path / f"{tag}.json")

@@ -155,3 +155,63 @@ def test_tsqr_invariants(seed, n, mult):
     np.testing.assert_allclose(qc @ rc, x, atol=5e-4 * max(1, np.abs(x).max()))
     np.testing.assert_allclose(qc.T @ qc, np.eye(n), atol=5e-4)
     assert np.allclose(rc, np.triu(rc))
+
+
+@given(st.integers(0, 2**16), st.integers(1, 60), st.integers(1, 12),
+       st.floats(0.05, 0.9))
+@_settings
+def test_ell_invariants(seed, m, n, density):
+    """ELL buffers densify back to the exact matrix (round-4 CSVM staging
+    representation), padding entries contribute nothing, and the budget
+    guard trips exactly on the padded byte size."""
+    import scipy.sparse as sp
+    from dislib_tpu.data.sparse import SparseArray
+    from dislib_tpu.classification.csvm import _ell_rows_dense
+    import jax.numpy as jnp
+    mat = sp.random(m, n, density=density, random_state=seed,
+                    dtype=np.float32).tocsr()
+    sa = SparseArray.from_scipy(mat)
+    ell = sa.ell()
+    assert ell is not None
+    ev, ec = ell
+    assert ev.shape == ec.shape and ev.shape[0] == m
+    dense = np.asarray(_ell_rows_dense(ev, ec, jnp.arange(m), n))
+    np.testing.assert_allclose(dense, mat.toarray(), rtol=1e-6, atol=1e-7)
+    # row-nnz bound: r is exactly the max row nnz (no silent inflation)
+    row_nnz = np.diff(mat.indptr)
+    assert ev.shape[1] == max(1, int(row_nnz.max(initial=1)))
+    # budget guard: one byte below the need → fallback (fresh object: the
+    # cache also re-checks, but this pins the fresh-build path)
+    need = m * ev.shape[1] * 8
+    sa2 = SparseArray.from_scipy(mat)
+    assert sa2.ell(budget=need - 1) is None
+    assert sa2.ell(budget=need) is not None
+
+
+@given(st.integers(0, 2**16), st.integers(1, 60), st.integers(1, 12),
+       st.floats(0.05, 0.9))
+@_settings
+def test_sharded_rows_invariants(seed, m, n, density):
+    """The rectangular row-sharded representation reconstructs the exact
+    matrix: every nonzero lands in its shard's bucket at its local row,
+    padding entries are zero-valued, and rowsq matches per-row ‖·‖²."""
+    import scipy.sparse as sp
+    from dislib_tpu.data.sparse import SparseArray
+    from dislib_tpu.parallel import mesh as _mesh
+    mat = sp.random(m, n, density=density, random_state=seed,
+                    dtype=np.float32).tocsr()
+    sa = SparseArray.from_scipy(mat)
+    mesh = _mesh.get_mesh()
+    p = mesh.shape[_mesh.ROWS]
+    data, lrows, cols, rowsq = (np.asarray(a) for a in sa.sharded_rows())
+    m_local = -(-m // p)
+    rebuilt = np.zeros((p * m_local, n), np.float32)
+    for s in range(p):
+        np.add.at(rebuilt, (s * m_local + lrows[s], cols[s]), data[s])
+    np.testing.assert_allclose(rebuilt[:m], mat.toarray(), rtol=1e-6,
+                               atol=1e-7)
+    assert not rebuilt[m:].any(), "padding rows carry mass"
+    dense = mat.toarray()
+    np.testing.assert_allclose(
+        rowsq.reshape(-1)[: m], (dense * dense).sum(1), rtol=1e-5,
+        atol=1e-6)
