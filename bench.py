@@ -656,7 +656,10 @@ def _configs():
              lambda: bench_kmeans(4000, 20, 4, 5, "smoke_star")),
         ]
     return [
-        ("dispatch_rtt", bench_rtt),
+        # full-mode config names MATCH each metric's first token, so a
+        # failure/timeout record (emitted under the config name) lands on
+        # the same BASELINE.md row as a success would (fill_baseline.py)
+        ("dispatch_rtt_trivial_op_ms", bench_rtt),
         # amortize/chain sizes pick sustained regions ≥ 10× the ~69 ms RTT
         # (per-unit costs measured in round 3: kmeans-cfg1 ~0.46 ms/iter,
         # kmeans-1M ~1.25 ms/iter, 4096³ f32 ~19 ms, 16384³ f32 ~290 ms,
@@ -672,9 +675,9 @@ def _configs():
         ("svd_4096x512_wall_s", lambda: bench_svd(4096, 512)),
         ("gmm_1000000x50_k16_5it_wall_s",
          lambda: bench_gmm(1_000_000, 50, 16, 5)),
-        ("csvm_20000x20_fit_wall_s",
+        ("csvm_20000x20_rbf_3it_fit_wall_s",
          lambda: bench_csvm(20_000, 20, "20000x20")),
-        ("gridsearch_kmeans_200000x20_wall_s",
+        ("gridsearch_kmeans_200000x20_3x3fits_wall_s",
          lambda: bench_gridsearch(200_000, 20, (4, 8, 12), 3, 10,
                                   "200000x20")),
         ("matmul_16384_f32_gflops_per_chip",

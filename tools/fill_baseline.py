@@ -21,22 +21,40 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# bench metric prefix → (BASELINE.md row name, config text, is_matmul)
+# EXACT bench metric first token → (BASELINE.md row name, config text,
+# is_matmul).  Exact keys, not prefixes: matmul_16384_f32 would otherwise
+# swallow matmul_16384_f32x3.
 ROWS = [
-    ("dispatch_rtt", "Dispatch RTT (informational)",
+    ("dispatch_rtt_trivial_op_ms", "Dispatch RTT (informational)",
      "8×8 jitted add + 1-elt fetch", False),
-    ("kmeans_10000x100_k8", "KMeans", "k=8, 10000×100 ds-array", False),
-    ("matmul_4096", "Blocked matmul (f32)", "4096×4096 @ 4096×4096", True),
-    ("tsqr_65536x256", "tsQR", "65536×256 tall-skinny", False),
-    ("randomsvd_32768x1024", "RandomizedSVD", "32768×1024, nsv=64", False),
-    ("gmm_1000000x50", "GaussianMixture EM", "1M×50, k=16, 5 iter", False),
-    ("matmul_16384_f32", "Matmul north star ★ (f32)", "16384×16384", True),
-    ("matmul_16384_bf16", "Matmul north star ★ (bf16)", "16384×16384", True),
-    ("kmeans_1Mx100_k10_sustained", "KMeans ★ sustained (500 it/dispatch)",
+    ("kmeans_10000x100_k8_iter_per_sec", "KMeans",
+     "k=8, 10000×100 ds-array", False),
+    ("matmul_4096_f32_gflops_per_chip", "Blocked matmul (f32)",
+     "4096×4096 @ 4096×4096", True),
+    ("tsqr_65536x256_wall_s", "tsQR", "65536×256 tall-skinny", False),
+    ("randomsvd_32768x1024_nsv64_wall_s", "RandomizedSVD",
+     "32768×1024, nsv=64", False),
+    ("svd_4096x512_wall_s", "SVD (block Jacobi, informational)",
+     "4096×512", False),
+    ("gmm_1000000x50_k16_5it_wall_s", "GaussianMixture EM",
+     "1M×50, k=16, 5 iter", False),
+    ("csvm_20000x20_rbf_3it_fit_wall_s", "CascadeSVM (irregular tier)",
+     "20000×20 rbf, 3 global iters", False),
+    ("gridsearch_kmeans_200000x20_3x3fits_wall_s",
+     "GridSearchCV (async trials)", "KMeans 200k×20, 3 cand × 3 folds",
+     False),
+    ("matmul_16384_f32_gflops_per_chip", "Matmul north star ★ (f32)",
+     "16384×16384", True),
+    ("matmul_16384_bf16_gflops_per_chip", "Matmul north star ★ (bf16)",
+     "16384×16384", True),
+    ("matmul_16384_f32x3_gflops_per_chip",
+     "Matmul (f32x3 3-pass, informational)", "16384×16384", True),
+    ("kmeans_1Mx100_k10_sustained_iter_per_sec",
+     "KMeans ★ sustained (500 it/dispatch)", "1M×100, k=10", False),
+    ("kmeans_1Mx100_k10_fastdist_iter_per_sec",
+     "KMeans ★ (bf16 assignment)", "1M×100, k=10", False),
+    ("kmeans_1Mx100_k10_iter_per_sec", "KMeans north star ★",
      "1M×100, k=10", False),
-    ("kmeans_1Mx100_k10_fastdist", "KMeans ★ (bf16 assignment)",
-     "1M×100, k=10", False),
-    ("kmeans_1Mx100_k10_iter", "KMeans north star ★", "1M×100, k=10", False),
 ]
 
 
@@ -53,27 +71,34 @@ def main():
             rec = json.loads(line)
             results[rec["metric"].split(" ")[0]] = rec
 
-    out_rows = [f"| Workload | Config | Measured | Unit | vs NumPy proxy | "
-                f"MFU (vs {peak_tflops:.0f} TF/s peak) | Hardware |",
-                "|---|---|---|---|---|---|---|"]
-    for prefix, name, cfg, is_matmul in ROWS:
-        rec = next((r for k, r in results.items() if k.startswith(prefix)),
-                   None)
+    out_rows = [f"| Workload | Config | Measured | Unit | raw (1 RTT/disp) "
+                f"| vs NumPy proxy | MFU (vs {peak_tflops:.0f} TF/s peak) "
+                f"| Hardware |",
+                "|---|---|---|---|---|---|---|---|"]
+    for key, name, cfg, is_matmul in ROWS:
+        # exact first, then bidirectional-prefix fallback so an old-style
+        # error record (keyed by a shorter config name) still lands on its
+        # row instead of silently reading "(not run)"
+        rec = results.get(key) or next(
+            (r for k, r in results.items()
+             if key.startswith(k) or k.startswith(key)), None)
         if rec is None:
-            out_rows.append(f"| {name} | {cfg} | (not run) | — | — | — "
+            out_rows.append(f"| {name} | {cfg} | (not run) | — | — | — | — "
                             f"| {hw} |")
         elif rec.get("error"):
             out_rows.append(f"| {name} | {cfg} | ERROR: "
-                            f"{rec['error'][:60]} | — | — | — | {hw} |")
+                            f"{rec['error'][:60]} | — | — | — | — | {hw} |")
         else:
             mfu = "—"
             if is_matmul:
                 mfu = f"{100.0 * rec['value'] / (peak_tflops * 1000):.1f}%"
             vsb = "—" if rec.get("vs_baseline") is None \
                 else f"{rec['vs_baseline']}×"
+            raw = rec.get("raw_value")
+            raw = "—" if raw is None else f"{raw}"
             out_rows.append(
                 f"| {name} | {cfg} | {rec['value']} | {rec['unit']} | "
-                f"{vsb} | {mfu} | {hw} |")
+                f"{raw} | {vsb} | {mfu} | {hw} |")
 
     path = os.path.join(ROOT, "BASELINE.md")
     text = open(path).read()
