@@ -39,3 +39,10 @@ def _reset_mesh():
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+def skip_unless_devices(n):
+    """Skip on rigs with fewer than n devices — the single-chip TPU suite
+    run can't host the multi-device mesh-shape tests."""
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices (single-chip TPU suite run)")

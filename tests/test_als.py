@@ -85,6 +85,8 @@ class TestALS:
 
     def test_irregular_blocks_and_mesh(self, rng):
         """Irregular logical shape (prime dims) exercises padding masks."""
+        from conftest import skip_unless_devices
+        skip_unless_devices(8)
         r, _, mask = _ratings(rng, m=37, n=23)
         ds.init((4, 2))
         als = ALS(n_f=2, lambda_=0.05, max_iter=40, random_state=0)

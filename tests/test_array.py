@@ -200,6 +200,8 @@ class TestApplyAlongAxis:
 
 class TestMeshes:
     def test_2d_mesh(self, rng):
+        from conftest import skip_unless_devices
+        skip_unless_devices(8)
         ds.init((4, 2))
         a, x = _mk(rng, (19, 23), (5, 5))
         np.testing.assert_allclose(a.collect(), x)

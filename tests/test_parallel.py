@@ -29,6 +29,8 @@ class TestMesh:
         assert r * c == len(jax.devices())
 
     def test_explicit_shape_and_quantum(self):
+        from conftest import skip_unless_devices
+        skip_unless_devices(8)
         ds.init((2, 4))
         assert parallel.mesh_shape() == (2, 4)
         assert parallel.pad_quantum() == 4
@@ -36,6 +38,8 @@ class TestMesh:
         assert parallel.pad_quantum() == 4
 
     def test_env_mesh(self, monkeypatch):
+        from conftest import skip_unless_devices
+        skip_unless_devices(4)
         monkeypatch.setenv("DSLIB_MESH", "2,2")
         ds.init()
         assert parallel.mesh_shape() == (2, 2)

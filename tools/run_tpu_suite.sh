@@ -22,8 +22,11 @@ for f in tests/test_*.py; do
     continue
   fi
   echo "=== $f ==="
-  DSLIB_TEST_TPU=1 timeout "$TMO" python -m pytest "$f" -q --no-header 2>&1 \
-    | tail -3
+  # -k: a wedged device claim can leave python unkillable by TERM; KILL
+  # 30s later so `timeout` itself can never hang (rc 137 = KILL path,
+  # counted as a timeout below alongside 124)
+  DSLIB_TEST_TPU=1 timeout -k 30 "$TMO" python -m pytest "$f" -q --no-header \
+    2>&1 | tail -3
   rc=${PIPESTATUS[0]}
   grep -v " $f$" "$LOG" > "$LOG.tmp" || true   # one line per file
   mv "$LOG.tmp" "$LOG"
@@ -37,7 +40,7 @@ for f in tests/test_*.py; do
     # tunnel-wedge signature (rounds 2-3): every later file would burn the
     # full timeout too.  Abort; the log keeps the greens, so a re-run
     # after recovery resumes where this one died.
-    if [ "$rc" -eq 124 ]; then
+    if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
       consec_tmo=$((consec_tmo + 1))
       if [ "$consec_tmo" -ge 2 ]; then
         echo "=== two consecutive per-file timeouts — tunnel wedged, aborting (resumable) ==="
