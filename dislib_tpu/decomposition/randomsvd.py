@@ -27,7 +27,8 @@ from jax import lax
 
 from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.math import matmul
-from dislib_tpu.decomposition.tsqr import tsqr, _tsqr_shardmap
+from dislib_tpu.decomposition.tsqr import (tsqr, _tsqr_shardmap,
+                                           _use_cholqr)
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
 
@@ -54,7 +55,7 @@ def random_svd(a: Array, iters: int = 2, epsilon: float | None = None,
         p = mesh.shape[_mesh.ROWS]
         u_log, s, vt = _random_svd_fused(
             a._data, jax.random.PRNGKey(seed), a.shape, iters, sketch,
-            nsv, mesh, p)
+            nsv, mesh, p, cholqr=_use_cholqr())
         u = Array._from_logical_padded(_repad(u_log, (m, nsv)), (m, nsv))
         v = Array._from_logical(vt.T[:, :nsv])
         return u, Array._from_logical(s[:nsv].reshape(1, -1)), v
@@ -80,9 +81,11 @@ def random_svd(a: Array, iters: int = 2, epsilon: float | None = None,
 
 
 @partial(jax.jit, static_argnames=("a_shape", "iters", "sketch", "nsv",
+                                   "cholqr",
                                    "mesh", "p"))
 @precise
-def _random_svd_fused(a_pad, key, a_shape, iters, sketch, nsv, mesh, p):
+def _random_svd_fused(a_pad, key, a_shape, iters, sketch, nsv, mesh, p,
+                      *, cholqr):
     """Sketch + power iterations + projection + SVD as one XLA program.
 
     Quantum-padded rows/cols of ``a_pad`` are zero, so they contribute
@@ -100,7 +103,7 @@ def _random_svd_fused(a_pad, key, a_shape, iters, sketch, nsv, mesh, p):
         if target != rows:
             y = jnp.pad(y, ((0, target - rows), (0, 0)))
         y = lax.with_sharding_constraint(y, _mesh.row_sharding())
-        q, _ = _tsqr_shardmap(y, mesh, p)
+        q, _ = _tsqr_shardmap(y, mesh, p, cholqr=cholqr)
         return q[:rows]
 
     q = ortho(av @ _omega_of(key, n, sketch))

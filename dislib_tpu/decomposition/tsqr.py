@@ -50,13 +50,77 @@ def tsqr(a: Array, mode: str = "reduced", indexes=None):
         extra = p * n - av.shape[0]
         av = jnp.pad(av, ((0, extra), (0, 0)))
         av = jax.device_put(av, _mesh.row_sharding())
-    q_pad, r = _tsqr_shardmap(av, mesh, p)
+    q_pad, r = _tsqr_shardmap(av, mesh, p, cholqr=_use_cholqr())
     if mode == "r":
         return Array._from_logical(r)
     q = Array._from_logical_padded(_col_repad(q_pad), (m, n), a._reg_shape)
     if indexes is not None:
         q = q[:, list(indexes)]
     return q, Array._from_logical(r)
+
+
+def _use_cholqr() -> bool:
+    """Policy for the CholeskyQR2 local factorisation: DSLIB_TSQR_CHOLQR
+    in {auto (default), 1, 0}.  'auto' enables it on TPU only — on the MXU
+    the 2 GEMM rounds (~3× the Householder FLOPs, but all matmul) beat a
+    column-sequential factorisation by an order of magnitude; on CPU
+    LAPACK's blocked Householder wins, so the rig keeps the tree unless a
+    test forces the path."""
+    import os
+    v = os.environ.get("DSLIB_TSQR_CHOLQR", "auto")
+    if v == "auto":
+        return jax.default_backend() == "tpu"
+    return v == "1"
+
+
+def _cholqr2(a):
+    """CholeskyQR2: two rounds of Gram → Cholesky → triangular solve.
+
+    (Lit.: 'Large Scale Distributed Linear Algebra With Tensor Processing
+    Units', arXiv:2112.09017 — QR via Cholesky of AᵀA is the TPU-native
+    tall-skinny factorisation; the second round restores orthogonality to
+    O(u) whenever the first Cholesky succeeds, i.e. cond(A) ≲ u^(-1/2).)
+
+    Returns (Q, R, ok): ``ok`` is False when the result is unusable — the
+    Gram Cholesky broke down (NaN/inf), OR the produced Q fails a DIRECT
+    orthogonality check (‖QᵀQ − I‖_max < 1e-3; one extra (n, n) Gram, a
+    small fraction of the factorisation's GEMM work).  The explicit check
+    matters because in the cond(A) band just above u^(-1/2) the Cholesky
+    can stay finite while orthogonality quietly degrades — finiteness
+    alone does not guarantee quality.  The caller falls back to the
+    Householder tree on ok=False, so ill-conditioned inputs lose speed,
+    never accuracy."""
+    def one_round(q):
+        g = q.T @ q
+        ell = jnp.linalg.cholesky(g)                 # G = L Lᵀ, R = Lᵀ
+        q_next = jax.scipy.linalg.solve_triangular(ell, q.T, lower=True).T
+        return q_next, ell.T
+
+    q1, r1 = one_round(a)
+    q2, r2 = one_round(q1)
+    r = r2 @ r1
+    n = a.shape[1]
+    ortho_err = jnp.max(jnp.abs(q2.T @ q2 - jnp.eye(n, dtype=q2.dtype)))
+    ok = jnp.all(jnp.isfinite(q2)) & jnp.all(jnp.isfinite(r)) \
+        & (ortho_err < 1e-3)
+    return q2, r, ok
+
+
+def _local_qr(a, cholqr):
+    """Shard-local tall-skinny QR: CholeskyQR2 when ``cholqr`` (with an
+    in-program fallback to the Householder tree on Cholesky breakdown),
+    the batched Householder reduction tree otherwise.  ``cholqr`` is a
+    trace-time static (threaded from `_use_cholqr()` through the jit cache
+    key, so flipping the env var retraces instead of being ignored)."""
+    if not cholqr:
+        return _local_tsqr(a)
+    q_c, r_c, ok = _cholqr2(a)
+    # tuple(): jnp.linalg.qr yields a QRResult NamedTuple — a different
+    # pytree type than the true branch's plain tuple
+    return lax.cond(ok,
+                    lambda op: (q_c, r_c),
+                    lambda op: tuple(_local_tsqr(op)),
+                    a)
 
 
 def _split_count(rows: int, n: int, target: int = 8) -> int:
@@ -92,16 +156,20 @@ def _local_tsqr(a):
     return q.reshape(rows, n), r
 
 
-@partial(jax.jit, static_argnames=("mesh", "p"))
+@partial(jax.jit, static_argnames=("mesh", "p", "cholqr"))
 @precise
-def _tsqr_shardmap(av, mesh, p):
+def _tsqr_shardmap(av, mesh, p, *, cholqr):
+    """``cholqr`` is REQUIRED (no default): every caller must resolve
+    `_use_cholqr()` at its own trace boundary and thread it through its
+    jit cache key, otherwise an env flip after the first trace would be
+    silently ignored."""
     n = av.shape[1]
 
     def local(a_shard):
-        q1, r1 = _local_tsqr(a_shard)                        # (m/p, n), (n, n)
+        q1, r1 = _local_qr(a_shard, cholqr)                  # (m/p, n), (n, n)
         r_stack = lax.all_gather(r1, _mesh.ROWS)             # (p, n, n) — ICI
         r_stack = r_stack.reshape(p * n, n)
-        q2, r = _local_tsqr(r_stack)                         # redundant per shard
+        q2, r = _local_qr(r_stack, cholqr)                   # redundant per shard
         idx = lax.axis_index(_mesh.ROWS)
         q2_i = lax.dynamic_slice(q2, (idx * n, 0), (n, n))
         # R is computed identically on every shard, but the static

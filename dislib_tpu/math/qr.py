@@ -39,7 +39,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from dislib_tpu.data.array import Array
-from dislib_tpu.decomposition.tsqr import _tsqr_shardmap
+from dislib_tpu.decomposition.tsqr import (_tsqr_shardmap,
+                                           _use_cholqr)
 from dislib_tpu.ops.base import precise
 from dislib_tpu.parallel import mesh as _mesh
 
@@ -68,7 +69,8 @@ def qr(a: Array, mode: str = "full", overwrite_a: bool = False):
     mp = a._data.shape[0]
     blocked_ok = m >= n and n > _PANEL and mp // p >= _PANEL and mp % p == 0
     if mode in ("economic", "r") and blocked_ok:
-        q_pad, r = _qr_blocked(a._data, (m, n), mesh, p, _PANEL)
+        q_pad, r = _qr_blocked(a._data, (m, n), mesh, p, _PANEL,
+                            cholqr=_use_cholqr())
         if mode == "r":
             return Array._from_logical(r[:n, :n])
         return (Array._from_logical_padded(q_pad, (m, n), a._reg_shape),
@@ -91,10 +93,12 @@ def _qr_full_distributed(a: Array, m, n, mesh, p):
     against Q₁ (twice) and blocked-QR-factored.  Everything row-sharded; the
     only replicated object is the (n, n) R.  Rank-deficient A carries the
     same conditioning caveat as the economic path (Gram–Schmidt panels)."""
-    q1, r = _qr_blocked(a._data, (m, n), mesh, p, _PANEL)
+    q1, r = _qr_blocked(a._data, (m, n), mesh, p, _PANEL,
+                            cholqr=_use_cholqr())
     k = m - n
     g = _qr_complement_seed(q1, (m, n), k, mesh)
-    q2, _ = _qr_blocked(g, (m, k), mesh, p, _PANEL)
+    q2, _ = _qr_blocked(g, (m, k), mesh, p, _PANEL,
+                         cholqr=_use_cholqr())
     q_full = jnp.concatenate([q1[:, :n], q2[:, :k]], axis=1)[:m]
     r_full = jnp.zeros((m, n), jnp.float32).at[:n, :n].set(r[:n, :n])
     return (Array._from_logical(q_full, a._reg_shape),
@@ -118,9 +122,10 @@ def _qr_complement_seed(q1, shape, k, mesh):
     return g
 
 
-@partial(jax.jit, static_argnames=("shape", "mesh", "p", "panel"))
+@partial(jax.jit, static_argnames=("shape", "mesh", "p", "panel",
+                                   "cholqr"))
 @precise
-def _qr_blocked(ap, shape, mesh, p, panel):
+def _qr_blocked(ap, shape, mesh, p, panel, *, cholqr):
     """Right-looking blocked QR over the row-sharded padded operand.
 
     Invariants inside the loop (panel j, offset off = j·panel):
@@ -153,7 +158,7 @@ def _qr_blocked(ap, shape, mesh, p, panel):
         r = lax.dynamic_update_slice(
             r, lax.dynamic_slice(r, (0, off), (n_pad, b)) + c, (0, off))
         # panel factorisation: shard-local QR + all_gather(R) over ICI
-        qs, rs = _tsqr_shardmap(p_blk, mesh, p)  # (mp, b), (b, b)
+        qs, rs = _tsqr_shardmap(p_blk, mesh, p, cholqr=cholqr)  # (mp, b), (b, b)
         # trailing update as sharded GEMMs: G = Qsᵀ T, T -= Qs G (cols > off+b)
         g = qs.T @ t                             # (b, n_pad)
         trailing = col >= off + b
