@@ -186,13 +186,14 @@ class CascadeSVM(BaseEstimator):
                 x_sum = float(valh.sum())
                 x_rowsum = float((valh * idxh[:, 0]).sum())
             else:
-                riota = jnp.arange(xv.shape[0], dtype=jnp.float32)
-                x_sum = float(jax.device_get(jnp.sum(xv)))
-                x_rowsum = float(jax.device_get(
-                    jnp.einsum("ij,i->", xv, riota)))
-            digest = np.asarray(
-                [x_sum, x_rowsum, float(y_pm.sum()),
-                 float(y_pm @ np.arange(m, dtype=np.float64))], np.float64)
+                # shared split-iota reduction: exact index weights past
+                # 2^24 rows (a plain f32 iota collides adjacent indices)
+                from dislib_tpu.utils.checkpoint import digest_sums
+                x_sum, x_rowsum = digest_sums(xv)
+            from dislib_tpu.utils.checkpoint import versioned_digest
+            digest = versioned_digest(
+                x_sum, x_rowsum, float(y_pm.sum()),
+                float(y_pm @ np.arange(m, dtype=np.float64)))
             snap = checkpoint.load()
             if snap is not None:
                 from dislib_tpu.utils.checkpoint import validate_snapshot

@@ -273,11 +273,14 @@ class SparseArray:
     def sharded_rows(self, mesh=None):
         """(data, local_rows, cols, rowsq) rectangular per-shard buffers,
         leading axis = shard over the mesh 'rows' axis; padding entries are
-        (v=0, row=0, col=0) so they contribute nothing.  Cached per mesh."""
+        (v=0, row=0, col=0) so they contribute nothing.  Cached per mesh
+        OBJECT (not shard count): a re-initialised mesh with the same p but
+        a different device order would otherwise be handed buffers
+        device_put with the stale mesh's NamedSharding."""
         mesh = mesh or _mesh.get_mesh()
         p = mesh.shape[_mesh.ROWS]
         cached = getattr(self, "_sharded_cache", None)
-        if cached is not None and cached[0] == p:
+        if cached is not None and cached[0] is mesh:
             return cached[1]
         m = self._shape[0]
         m_local = -(-m // p)
@@ -301,7 +304,7 @@ class SparseArray:
                                         jax.sharding.PartitionSpec(_mesh.ROWS))
         out = tuple(jax.device_put(jnp.asarray(a), sh)
                     for a in (data, lrows, cols, rowsq))
-        self._sharded_cache = (p, out)
+        self._sharded_cache = (mesh, out)
         return out
 
 

@@ -40,12 +40,16 @@ from dislib_tpu.parallel import mesh as _mesh
 class NearestNeighbors(BaseEstimator):
     """Exact brute-force kNN index over a ds-array.
 
-    ``ring`` selects the multi-device schedule: True rotates fitted shards
-    around the mesh 'rows' axis via ppermute with a running top-k (the
-    fitted set never materialises on one chip — `ops/ring.py`); False
-    forces the single-program path (direct or fitted-row-chunked GEMM);
-    None (default) auto-picks ring when the mesh has >1 row shard and the
-    fit set is large enough for scale-out to matter."""
+    ``ring`` selects the multi-device schedule for DENSE fit sets: True
+    rotates fitted shards around the mesh 'rows' axis via ppermute with a
+    running top-k (the fitted set never materialises on one chip —
+    `ops/ring.py`); False forces the single-program path (direct or
+    fitted-row-chunked GEMM); None (default) auto-picks ring when the mesh
+    has >1 row shard and the fit set is large enough for scale-out to
+    matter.  Sparse inputs ignore ``ring``: they always stream the fit
+    rows as bounded dense windows, query-row-sharded by hand (`shard_map`)
+    on a multi-row mesh, single-program otherwise — ring's
+    shard-the-FIT-set trade-off does not apply to a streamed fit set."""
 
     _private_fitted_attrs = ("_fit_data",)
 
@@ -74,9 +78,10 @@ class NearestNeighbors(BaseEstimator):
             if getattr(self, "ring", None):
                 import warnings
                 warnings.warn(
-                    "NearestNeighbors(ring=True) is not supported for "
-                    "sparse inputs; using the single-program sparse path "
-                    "(fit-set triplets replicated per device)",
+                    "NearestNeighbors(ring=True) does not apply to sparse "
+                    "inputs; using the streamed sparse schedule (bounded "
+                    "dense fit windows; query-row-sharded via shard_map on "
+                    "a multi-row mesh, single-program otherwise)",
                     UserWarning, stacklevel=2)
             d, idx = _kneighbors_sparse(x, f, k)
             d_arr = Array._from_logical_padded(
@@ -157,7 +162,9 @@ def _kneighbors_sparse(x, f, k):
     device scores its own query shard against the replicated bounded
     windows — manual SPMD, because GSPMD replicates a row-sharded operand
     to partition `top_k`, which the round-4 comm audit pins).  Sparse
-    queries stay a single-program path (BCOO buffers don't mesh-shard)."""
+    queries on a >1-row mesh shard the same way via the rectangular
+    `sharded_rows` buffers (BCOO itself doesn't mesh-shard); on a 1-row
+    mesh they take the single-program BCOO kernel."""
     from dislib_tpu.data.sparse import SparseArray
     n = f.shape[1]
     chunk = min(_CHUNK, max(1, f.shape[0]))

@@ -78,16 +78,58 @@ def data_digest(xp, stats=None):
     sums.  Best-effort (a tiny relative perturbation at very large m can
     evade a sum digest); NaN digests never match → NaN data fails closed.
     ``stats`` (host per-row stats, e.g. tree label encodings) contributes
-    the same two sums when given."""
+    the same two sums when given.  The digest array leads with a format
+    version so a snapshot written under an older formula fails validation
+    with an accurate message instead of blaming the user's data."""
+    total, wsum = digest_sums(xp)
+    extras = []
+    if stats is not None:
+        extras = [float(np.sum(stats)),
+                  float(np.arange(stats.shape[0]) @ np.sum(stats, axis=1))]
+    return versioned_digest(total, wsum, *extras)
+
+
+def versioned_digest(*vals):
+    """Assemble a digest array in the shared version-led layout
+    ``[_DIGEST_VERSION, *vals]`` — the ONE place that owns the format, so
+    estimators composing their own digest terms (e.g. CSVM's x+y sums)
+    cannot drift from it."""
+    return np.asarray([_DIGEST_VERSION, *vals], np.float64)
+
+
+# v2: index weights split into high/low f32 parts (2026-08-01).  v1 (no
+# version element) used a single f32 iota, which collides adjacent indices
+# above ~2^24 rows.
+_DIGEST_VERSION = 2.0
+
+_digest_kernel = None  # module-level so repeat fits hit the jit cache
+
+
+def digest_sums(xp):
+    """``(plain sum, index-weighted sum)`` of a device matrix as host
+    floats — the shared order-sensitive reduction for checkpoint digests
+    (also used directly by estimators that build composite digests, e.g.
+    CSVM).  The index weights are split as i = 4096*hi + lo in one fused
+    on-device program: a single f32 iota collides adjacent indices above
+    ~2^24 rows, silently weakening the documented permutation
+    sensitivity; each part stays exactly representable (lo < 4096,
+    hi < m/4096).  Built with on-device iota (no O(m) host buffers or
+    transfers); the partial sums recombine in float64 on host (f64 is
+    unavailable on device without x64 mode)."""
     import jax
     import jax.numpy as jnp
-    riota = jnp.arange(xp.shape[0], dtype=jnp.float32)
-    vals = [float(jax.device_get(jnp.sum(xp))),
-            float(jax.device_get(jnp.einsum("ij,i->", xp, riota)))]
-    if stats is not None:
-        vals += [float(np.sum(stats)),
-                 float(np.arange(stats.shape[0]) @ np.sum(stats, axis=1))]
-    return np.asarray(vals, np.float64)
+    global _digest_kernel
+    if _digest_kernel is None:
+        @jax.jit
+        def sums(x):
+            r = jnp.arange(x.shape[0], dtype=jnp.int32)
+            hi = (r // 4096).astype(jnp.float32)
+            lo = (r % 4096).astype(jnp.float32)
+            return (jnp.sum(x), jnp.einsum("ij,i->", x, hi),
+                    jnp.einsum("ij,i->", x, lo))
+        _digest_kernel = sums
+    total, shi, slo = (float(v) for v in jax.device_get(_digest_kernel(xp)))
+    return total, 4096.0 * shi + slo
 
 
 def validate_snapshot(snap, fp, digest):
@@ -99,6 +141,22 @@ def validate_snapshot(snap, fp, digest):
           and np.shape(snap["digest"]) == np.shape(digest)
           and np.allclose(snap["digest"], digest, rtol=1e-5, atol=1e-6))
     if not ok:
+        # a LENGTH mismatch from a snapshot that does NOT lead with the
+        # current version element means the formula itself changed between
+        # library versions (v1's unversioned 2/4-element digests vs v2's
+        # version-led ones).  A length mismatch WITH a current version
+        # lead is a cross-estimator snapshot (e.g. a DBSCAN checkpoint
+        # path reused for a forest fit) — that keeps the generic message,
+        # as do value mismatches at equal length.
+        old = ("digest" in snap and np.ndim(snap["digest"]) == 1
+               and np.size(snap["digest"]) != np.size(digest)
+               and not (np.size(snap["digest"]) >= 1
+                        and snap["digest"][0] == _DIGEST_VERSION))
+        if old:
+            raise ValueError(
+                "checkpoint was written by a different library version "
+                "(data-digest format changed) — delete the snapshot file "
+                "to restart the fit from scratch")
         raise ValueError(
             "checkpoint does not match this data/estimator (shape, data "
             "content or hyperparameters differ) — stale or foreign snapshot")

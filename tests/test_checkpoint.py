@@ -35,6 +35,31 @@ class TestFitCheckpoint:
         with pytest.raises(ValueError):
             FitCheckpoint(str(tmp_path / "s.npz"), every=0)
 
+    def test_digest_version_messages(self, rng):
+        """The validate_snapshot refusal message distinguishes an
+        OLD-FORMAT digest (v1: unversioned, shorter — 'different library
+        version') from a same-version mismatch ('stale or foreign'),
+        including the cross-estimator length-mismatch case which must NOT
+        claim a version change."""
+        import jax.numpy as jnp
+        from dislib_tpu.utils.checkpoint import (data_digest,
+                                                 validate_snapshot)
+        xp = jnp.asarray(rng.rand(100, 3), jnp.float32)
+        fp = np.asarray([1.0])
+        digest = data_digest(xp)               # v2: [version, sum, wsum]
+        # v1-style snapshot: same sums, no version element
+        with pytest.raises(ValueError, match="different library version"):
+            validate_snapshot({"fp": fp, "digest": digest[1:]}, fp, digest)
+        # cross-estimator: v2 with-stats (5 elts) vs v2 without (3 elts)
+        d_stats = data_digest(xp, stats=rng.rand(100, 2))
+        with pytest.raises(ValueError, match="stale or foreign"):
+            validate_snapshot({"fp": fp, "digest": digest}, fp, d_stats)
+        # empty digest array must not crash the heuristic
+        with pytest.raises(ValueError, match="different library version"):
+            validate_snapshot({"fp": fp, "digest": np.zeros(0)}, fp, digest)
+        # matching v2 snapshot passes
+        validate_snapshot({"fp": fp, "digest": digest}, fp, digest)
+
 
 class TestKillResume:
     def test_kmeans_resume_equals_full(self, rng, tmp_path):

@@ -76,12 +76,17 @@ def main():
                 f"| Hardware |",
                 "|---|---|---|---|---|---|---|---|"]
     for key, name, cfg, is_matmul in ROWS:
-        # exact first, then bidirectional-prefix fallback so an old-style
-        # error record (keyed by a shorter config name) still lands on its
-        # row instead of silently reading "(not run)"
-        rec = results.get(key) or next(
-            (r for k, r in results.items()
-             if key.startswith(k) or k.startswith(key)), None)
+        # exact first, then a one-directional legacy fallback: an old-style
+        # error record is keyed by a SHORTER config name, so only
+        # key.startswith(k) applies, the match must end at an underscore
+        # token boundary (so 'matmul_16384_f32' cannot land on the
+        # 'matmul_16384_f32x3...' row), and the longest such k wins
+        rec = results.get(key)
+        if rec is None:
+            legacy = [k for k in results
+                      if key.startswith(k) and key[len(k):len(k) + 1] == "_"]
+            if legacy:
+                rec = results[max(legacy, key=len)]
         if rec is None:
             out_rows.append(f"| {name} | {cfg} | (not run) | — | — | — | — "
                             f"| {hw} |")
