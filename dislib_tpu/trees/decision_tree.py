@@ -40,7 +40,18 @@ from jax import lax
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
 
+# Discretisation contract (documented divergence from the reference, which
+# delegates subtrees to exact sklearn trees with arbitrary thresholds):
+# split thresholds are per-feature QUANTILE bin edges, `n_bins` per feature
+# (constructor param, default N_BINS).  Distributions whose class/target
+# structure lives at finer granularity than ~1/n_bins quantile spacing need
+# a larger `n_bins` — see tests/test_trees.py::test_n_bins_contract for a
+# distribution where 32 bins provably loses and n_bins=256 recovers it.
 N_BINS = 32
+# Depth is capped: node arrays are heap-shaped (2^depth), so depth is a
+# compiled SHAPE — the cap keeps the padded arrays (and XLA programs)
+# bounded.  Requesting a finite max_depth above the cap warns loudly
+# (_effective_depth); the reference's data-bounded recursion has no cap.
 MAX_DEPTH_CAP = 12
 
 
@@ -48,29 +59,30 @@ MAX_DEPTH_CAP = 12
 # device kernels
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("shape",))
-def _quantile_bins(xp, shape):
-    """Per-feature bin edges from quantiles of the valid rows: (n, N_BINS-1)."""
+@partial(jax.jit, static_argnames=("shape", "n_bins"))
+def _quantile_bins(xp, shape, n_bins=N_BINS):
+    """Per-feature bin edges from quantiles of the valid rows: (n, n_bins-1)."""
     m, n = shape
     xv = xp[:m, :n]
-    qs = jnp.linspace(0.0, 100.0, N_BINS + 1)[1:-1]
-    return jnp.percentile(xv, qs, axis=0).T          # (n, N_BINS-1)
+    qs = jnp.linspace(0.0, 100.0, n_bins + 1)[1:-1]
+    return jnp.percentile(xv, qs, axis=0).T          # (n, n_bins-1)
 
 
 @partial(jax.jit, static_argnames=("shape",))
 def _bin_data(xp, shape, edges):
-    """Bin index of every (sample, feature): (m_pad, n) int32 in [0, N_BINS)."""
+    """Bin index of every (sample, feature): (m_pad, n) int32 in [0, n_bins),
+    with n_bins implied by the edges width (n, n_bins-1)."""
     n = shape[1]
     xv = xp[:, :n]
     # bx[i, f] = #edges below x[i, f]
     return jnp.sum(xv[:, :, None] > edges[None, :, :], axis=2).astype(jnp.int32)
 
 
-def _node_histogram(node, bx, w, stats, n_nodes):
-    """Scatter-add per-sample `stats` (m, S) into (n_nodes, n, N_BINS, S)."""
+def _node_histogram(node, bx, w, stats, n_nodes, n_bins):
+    """Scatter-add per-sample `stats` (m, S) into (n_nodes, n, n_bins, S)."""
     m, n = bx.shape
     feat = lax.broadcasted_iota(jnp.int32, (m, n), 1)
-    hist = jnp.zeros((n_nodes, n, N_BINS, stats.shape[1]), jnp.float32)
+    hist = jnp.zeros((n_nodes, n, n_bins, stats.shape[1]), jnp.float32)
     contrib = (w[:, None, None] * stats[:, None, :])          # (m, 1|n? , S)
     contrib = jnp.broadcast_to(contrib, (m, n, stats.shape[1]))
     return hist.at[node[:, None], feat, bx].add(contrib)
@@ -117,21 +129,21 @@ def _mask_features(gain, key, try_features):
 
 
 def _level_step(node, bx, w, stats, key, n_nodes, try_features, min_gain,
-                criterion):
+                criterion, n_bins):
     """Grow one level of one tree. Returns (feat, thr_bin, is_split, new_node,
     node_totals)."""
-    hist = _node_histogram(node, bx, w, stats, n_nodes)
+    hist = _node_histogram(node, bx, w, stats, n_nodes, n_bins)
     gain, totals = _gain_and_split(hist, criterion)
     gain = _mask_features(gain, key, try_features)
     flat = gain.reshape(n_nodes, -1)
     best = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-    feat = (best // N_BINS).astype(jnp.int32)
-    tbin = (best % N_BINS).astype(jnp.int32)
+    feat = (best // n_bins).astype(jnp.int32)
+    tbin = (best % n_bins).astype(jnp.int32)
     is_split = best_gain > min_gain
     # pass-through for non-splitting nodes: everything goes left
     feat = jnp.where(is_split, feat, 0)
-    tbin = jnp.where(is_split, tbin, N_BINS - 1)
+    tbin = jnp.where(is_split, tbin, n_bins - 1)
     # route samples: right iff bin(x_f) > threshold bin
     f_sel = feat[node]                                # (m,)
     b_sel = tbin[node]
@@ -142,11 +154,12 @@ def _level_step(node, bx, w, stats, key, n_nodes, try_features, min_gain,
 
 
 # one jitted step per (level-shape, config); vmapped over the whole forest
-@partial(jax.jit, static_argnames=("n_nodes", "try_features", "criterion"))
+@partial(jax.jit, static_argnames=("n_nodes", "try_features", "criterion",
+                                   "n_bins"))
 def _forest_level(node, bx, w, stats, keys, n_nodes, try_features,
-                  min_gain, criterion):
+                  min_gain, criterion, n_bins):
     step = partial(_level_step, n_nodes=n_nodes, try_features=try_features,
-                   min_gain=min_gain, criterion=criterion)
+                   min_gain=min_gain, criterion=criterion, n_bins=n_bins)
     return jax.vmap(step, in_axes=(0, None, 0, None, 0))(
         node, bx, w, stats, keys)
 
@@ -200,7 +213,23 @@ class _BaseTreeEnsemble(BaseEstimator):
         d = self.max_depth
         if d is None or np.isinf(d):
             d = MAX_DEPTH_CAP
+        elif d > MAX_DEPTH_CAP:
+            import warnings
+            warnings.warn(
+                f"max_depth={d} exceeds the depth cap {MAX_DEPTH_CAP}: tree "
+                f"node arrays are heap-shaped (2^depth is a compiled XLA "
+                f"shape), so growth is capped at {MAX_DEPTH_CAP} levels — "
+                "unlike the reference's data-bounded recursion. Deep "
+                "fine-structure beyond the cap will not be modelled.",
+                UserWarning, stacklevel=3)
         return int(max(1, min(d, MAX_DEPTH_CAP, int(np.ceil(np.log2(max(m, 2)))))))
+
+    def _n_bins(self):
+        nb = getattr(self, "n_bins", None)   # None: pre-n_bins snapshot load
+        nb = N_BINS if nb is None else int(nb)
+        if not 2 <= nb <= 1024:
+            raise ValueError(f"n_bins must be in [2, 1024], got {nb}")
+        return nb
 
     def _try_features_count(self, n):
         tf = getattr(self, "try_features", None)
@@ -238,10 +267,18 @@ class _BaseTreeEnsemble(BaseEstimator):
             fp = np.asarray([m, n, n_trees, depth, int(bootstrap),
                              float(("gini", "mse").index(self._criterion)),
                              -1.0 if tf is None else float(tf),
-                             -1.0 if rs is None else float(rs)], np.float64)
+                             -1.0 if rs is None else float(rs),
+                             float(self._n_bins())], np.float64)
             digest = data_digest(x._data, stats=stats_host)
             snap = checkpoint.load()
             if snap is not None:
+                if "fp" in snap and np.size(snap["fp"]) == len(fp) - 1:
+                    # pre-n_bins forest snapshot (8-knob fp): the grown
+                    # state depends on a knob the old fp never recorded
+                    raise ValueError(
+                        "checkpoint was written by a different library "
+                        "version (forest fingerprint predates n_bins) — "
+                        "delete the snapshot file to restart the fit")
                 validate_snapshot(snap, fp, digest)
         if snap is not None:
             seed = int(snap["seed"])
@@ -250,7 +287,8 @@ class _BaseTreeEnsemble(BaseEstimator):
                 np.random.randint(0, 2**31 - 1)
         key = jax.random.PRNGKey(int(seed))
 
-        edges = _quantile_bins(x._data, x.shape)
+        n_bins = self._n_bins()
+        edges = _quantile_bins(x._data, x.shape, n_bins)
         bx = _bin_data(x._data, x.shape, edges)
         mp = x._data.shape[0]
         valid = (np.arange(mp) < m).astype(np.float32)
@@ -283,7 +321,7 @@ class _BaseTreeEnsemble(BaseEstimator):
             keys = jax.random.split(k_lvl, n_trees)
             feat, tbin, is_split, node, _ = _forest_level(
                 node, bx, w, stats, keys, 2 ** lvl, try_features,
-                0.0, self._criterion)
+                0.0, self._criterion, n_bins)
             feats.append(feat)
             tbins.append(tbin)
             if checkpoint is not None and (lvl + 1 - start_lvl) \

@@ -152,15 +152,20 @@ class RandomForestClassifier(_ClassifierMixin, _BaseTreeEnsemble):
     ----------
     n_estimators : int, default 10
     try_features : 'sqrt' (default), 'third', int, or None (all features)
-    max_depth : int or np.inf — clamped to 12 (padded-array level cap).
+    max_depth : int or np.inf — clamped to 12 (padded-array level cap; a
+        finite request above the cap warns).
     hard_vote : bool, default False — majority of per-tree votes instead of
         averaged probabilities.
     random_state : int or None
+    n_bins : int, default 32 — split thresholds per feature are quantile
+        bin edges (histogram trees; the reference's sklearn delegation
+        searches exact thresholds instead). Raise for data whose class
+        structure is finer than ~1/n_bins quantile spacing.
     """
 
     def __init__(self, n_estimators=10, try_features="sqrt", max_depth=np.inf,
                  distr_depth="auto", sklearn_max=1e8, hard_vote=False,
-                 random_state=None):
+                 random_state=None, n_bins=32):
         self.n_estimators = n_estimators
         self.try_features = try_features
         self.max_depth = max_depth
@@ -168,6 +173,7 @@ class RandomForestClassifier(_ClassifierMixin, _BaseTreeEnsemble):
         self.sklearn_max = sklearn_max
         self.hard_vote = hard_vote
         self.random_state = random_state
+        self.n_bins = n_bins
 
     def _fit_spec(self):
         return self.n_estimators, True
@@ -180,13 +186,15 @@ class RandomForestRegressor(_RegressorMixin, _BaseTreeEnsemble):
     """
 
     def __init__(self, n_estimators=10, try_features="sqrt", max_depth=np.inf,
-                 distr_depth="auto", sklearn_max=1e8, random_state=None):
+                 distr_depth="auto", sklearn_max=1e8, random_state=None,
+                 n_bins=32):
         self.n_estimators = n_estimators
         self.try_features = try_features
         self.max_depth = max_depth
         self.distr_depth = distr_depth
         self.sklearn_max = sklearn_max
         self.random_state = random_state
+        self.n_bins = n_bins
 
     def _fit_spec(self):
         return self.n_estimators, True
@@ -195,10 +203,12 @@ class RandomForestRegressor(_RegressorMixin, _BaseTreeEnsemble):
 class DecisionTreeClassifier(_ClassifierMixin, _BaseTreeEnsemble):
     """Single histogram decision tree (no bootstrap, all features)."""
 
-    def __init__(self, max_depth=np.inf, try_features=None, random_state=None):
+    def __init__(self, max_depth=np.inf, try_features=None, random_state=None,
+                 n_bins=32):
         self.max_depth = max_depth
         self.try_features = try_features
         self.random_state = random_state
+        self.n_bins = n_bins
 
     def _fit_spec(self):
         return 1, False
@@ -207,10 +217,12 @@ class DecisionTreeClassifier(_ClassifierMixin, _BaseTreeEnsemble):
 class DecisionTreeRegressor(_RegressorMixin, _BaseTreeEnsemble):
     """Single histogram regression tree (no bootstrap, all features)."""
 
-    def __init__(self, max_depth=np.inf, try_features=None, random_state=None):
+    def __init__(self, max_depth=np.inf, try_features=None, random_state=None,
+                 n_bins=32):
         self.max_depth = max_depth
         self.try_features = try_features
         self.random_state = random_state
+        self.n_bins = n_bins
 
     def _fit_spec(self):
         return 1, False

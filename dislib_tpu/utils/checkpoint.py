@@ -148,6 +148,11 @@ def validate_snapshot(snap, fp, digest):
         # lead is a cross-estimator snapshot (e.g. a DBSCAN checkpoint
         # path reused for a forest fit) — that keeps the generic message,
         # as do value mismatches at equal length.
+        # (fp length is NOT used here: fp widths legitimately differ
+        # ACROSS estimators, so a length mismatch can't distinguish a
+        # version change from cross-estimator path reuse — an estimator
+        # that widens its own fp raises the version error at its call
+        # site, where its fp history is known; see trees._grow_forest)
         old = ("digest" in snap and np.ndim(snap["digest"]) == 1
                and np.size(snap["digest"]) != np.size(digest)
                and not (np.size(snap["digest"]) >= 1

@@ -108,3 +108,73 @@ class TestDecisionTree:
         dt = DecisionTreeClassifier(max_depth=2, random_state=0)
         dt.fit(ds.array(x), ds.array(y[:, None]))
         assert dt._depth == 2
+
+
+class TestNBinsContract:
+    """The discretisation contract (decision_tree.py module docstring):
+    quantile-histogram splits at n_bins granularity, with n_bins a
+    constructor knob — including a distribution where the default 32 bins
+    provably lose the minority structure and n_bins=256 recovers it."""
+
+    def _fine_boundary(self):
+        # 1% minority class below x=0.01 on a uniform feature: 32 quantile
+        # bins put the first edge at the ~3.1% quantile, so bin 0 mixes
+        # the whole minority with twice as many majority rows — majority
+        # vote erases the minority. 256 bins resolve it.
+        x = np.linspace(0.0, 1.0, 10_000, dtype=np.float32)[:, None]
+        y = (x[:, 0] < 0.01).astype(np.float32)[:, None]
+        return x, y
+
+    def _minority_recall(self, clf, x, y):
+        pred = np.asarray(
+            clf.predict(ds.array(x)).collect()).ravel()
+        mask = y.ravel() == 1.0
+        return float((pred[mask] == 1.0).mean())
+
+    def test_n_bins_contract(self):
+        x, y = self._fine_boundary()
+        lose = DecisionTreeClassifier(max_depth=6, random_state=0)
+        lose.fit(ds.array(x), ds.array(y))
+        win = DecisionTreeClassifier(max_depth=6, random_state=0, n_bins=256)
+        win.fit(ds.array(x), ds.array(y))
+        assert self._minority_recall(lose, x, y) < 0.2   # 32 bins: erased
+        assert self._minority_recall(win, x, y) > 0.7    # 256 bins: found
+
+    def test_n_bins_forest_and_validation(self, rng):
+        from dislib_tpu.trees import RandomForestClassifier
+        x = rng.rand(200, 4).astype(np.float32)
+        y = (x[:, 0] > 0.5).astype(np.float32)[:, None]
+        rf = RandomForestClassifier(n_estimators=4, random_state=0, n_bins=64)
+        rf.fit(ds.array(x), ds.array(y))
+        assert rf.score(ds.array(x), ds.array(y)) > 0.9
+        with pytest.raises(ValueError, match="n_bins"):
+            DecisionTreeClassifier(n_bins=1).fit(ds.array(x), ds.array(y))
+        with pytest.raises(ValueError, match="n_bins"):
+            DecisionTreeClassifier(n_bins=0).fit(ds.array(x), ds.array(y))
+
+    def test_depth_cap_warns(self, rng):
+        x, y = _class_data(rng, n=100, d=3, k=2)
+        dt = DecisionTreeClassifier(max_depth=40, random_state=0)
+        with pytest.warns(UserWarning, match="depth cap"):
+            dt.fit(ds.array(x), ds.array(y[:, None]))
+        assert dt._depth <= 12
+
+    def test_pre_n_bins_snapshot_refused_as_version_change(self, rng,
+                                                           tmp_path):
+        # a checkpoint written before n_bins joined the fingerprint (8
+        # elements vs 9) must be refused with the version message, not the
+        # data-mismatch one
+        from dislib_tpu.utils import FitCheckpoint
+        from dislib_tpu.trees import RandomForestClassifier
+        x, y = _class_data(rng, n=120, d=4, k=2)
+        path = str(tmp_path / "rf.npz")
+        rf = RandomForestClassifier(n_estimators=2, random_state=0)
+        rf.fit(ds.array(x), ds.array(y[:, None]),
+               checkpoint=FitCheckpoint(path, every=1))
+        snap = dict(np.load(path, allow_pickle=False))
+        snap["fp"] = snap["fp"][:-1]            # simulate the old 8-knob fp
+        np.savez(path, **snap)
+        with pytest.raises(ValueError, match="different library version"):
+            RandomForestClassifier(n_estimators=2, random_state=0).fit(
+                ds.array(x), ds.array(y[:, None]),
+                checkpoint=FitCheckpoint(path, every=1))
