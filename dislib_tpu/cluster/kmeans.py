@@ -37,7 +37,7 @@ from jax import lax
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
-from dislib_tpu.data.sparse import SparseArray, _spmm, _spmm_t
+from dislib_tpu.data.sparse import SparseArray, _spmm
 from dislib_tpu.ops import distances_sq as _distances_sq
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
@@ -102,10 +102,19 @@ class KMeans(BaseEstimator):
         # sample k distinct rows — the reference inits from data rows too
         idx = rng.choice(x.shape[0], size=min(k, x.shape[0]), replace=False)
         if isinstance(x, SparseArray):
-            # gather rows as a selection product: (xᵀ @ selᵀ)ᵀ, one spmm
-            sel = np.zeros((len(idx), x.shape[0]), np.float32)
-            sel[np.arange(len(idx)), np.sort(idx)] = 1.0
-            rows = _spmm_t(x._bcoo, jnp.asarray(sel.T)).T
+            # BCOO row gather: filter the host triplets for the k chosen
+            # rows and scatter into a (k, n) dense block — O(nnz) filter +
+            # O(k·n) result, never an O(k·m) selection operand (the
+            # sharded-rows fit path fetches these same triplets anyway)
+            sidx = np.sort(idx)
+            ind = np.asarray(jax.device_get(x._bcoo.indices))
+            val = np.asarray(jax.device_get(x._bcoo.data), np.float32)
+            pos = np.searchsorted(sidx, ind[:, 0])
+            pos = np.minimum(pos, len(sidx) - 1)
+            hit = sidx[pos] == ind[:, 0]
+            rows_np = np.zeros((len(sidx), n), np.float32)
+            np.add.at(rows_np, (pos[hit], ind[hit, 1]), val[hit])
+            rows = jnp.asarray(rows_np)
         else:
             rows = x[np.sort(idx), :]._data[: len(idx), : n]
         if len(idx) < k:  # fewer samples than clusters: top up with jitter

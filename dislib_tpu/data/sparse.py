@@ -407,11 +407,3 @@ class SparseArray:
 def _spmm(bcoo, rhs):
     return jsparse.bcoo_dot_general(
         bcoo, rhs, dimension_numbers=(([1], [0]), ([], [])))
-
-
-@jax.jit
-@precise
-def _spmm_t(bcoo, rhs):
-    """xᵀ @ rhs for a BCOO x: contract over the row dimension → (n, k)."""
-    return jsparse.bcoo_dot_general(
-        bcoo, rhs, dimension_numbers=(([0], [0]), ([], [])))

@@ -155,6 +155,25 @@ class TestLayoutOps:
         assert b.block_size == (8, 2)
         np.testing.assert_allclose(b.collect(), x)
 
+    def test_iterator_after_rechunk(self, rng):
+        """Pins the documented rechunk contract (migration.md): rechunk is
+        metadata-only, but the ITERATOR honours the new stripe size both
+        row- and col-wise — the observable behavior the reference's
+        data-movement rechunk produced, without the movement."""
+        a, x = _mk(rng, (16, 12), (4, 12))
+        b = a.rechunk((8, 3))
+        rows = list(b.iterator(axis=0))
+        assert [blk.shape for blk in rows] == [(8, 12), (8, 12)]
+        np.testing.assert_allclose(
+            np.vstack([blk.collect() for blk in rows]), x)
+        cols = list(b.iterator(axis=1))
+        assert [blk.shape for blk in cols] == [(16, 3)] * 4
+        np.testing.assert_allclose(
+            np.hstack([blk.collect() for blk in cols]), x)
+        # uneven trailing stripe after rechunk
+        c = a.rechunk((5, 12))
+        assert [blk.shape[0] for blk in c.iterator(axis=0)] == [5, 5, 5, 1]
+
     def test_astype_copy(self, rng):
         a, x = _mk(rng, (6, 6))
         assert a.astype(np.float32).dtype == np.float32
