@@ -20,7 +20,14 @@ On a single process (or under a TPU runtime that auto-detects, e.g. GKE
 with megascale env vars) every argument may be omitted.  Keep reductions
 hierarchical by putting the host-spanning dimension on the mesh 'rows'
 axis — `init()`'s device order already groups each host's local devices
-contiguously, so a (n_hosts·local, 1) mesh reduces ICI-first, DCN-second.
+contiguously, so a (n_hosts·local, 1) mesh reduces ICI-first, DCN-second;
+a (n_hosts, local) 2-D PROCESS mesh gives each host exactly one mesh row
+(rows collectives are pure-DCN, cols pure-intra-host).
+
+Exercised for real by `tests/test_multiprocess.py`: 2-process × 4-device
+jobs on the (n·local, 1) layout, and a 4-process × 2-device job on the
+(4, 2) 2-D process mesh (KMeans, collect, all_to_all shuffle, and
+kill+resume all crossing the gloo process boundary).
 """
 
 from __future__ import annotations

@@ -106,7 +106,9 @@ def test_two_process_kmeans_matches_single(tmp_path):
     np.testing.assert_allclose(got["sparse_knn_sum"], k3s.sum(), rtol=1e-3)
 
 
-def _run_crashfit(tmp_path, csv, tag, crash_after):
+def _run_ckfit(tmp_path, csv, tag, crash_after, mode, nprocs):
+    """Launch one checkpointed-fit job: ``mode`` 'crashfit' (flat
+    (n·4, 1) mesh) or 'grid' (2-D (nprocs, 2) process mesh)."""
     out = str(tmp_path / f"{tag}.json")
     ck = str(tmp_path / f"{tag}.ck.npz")
     port = _free_port()
@@ -118,10 +120,10 @@ def _run_crashfit(tmp_path, csv, tag, crash_after):
     else:
         env.pop("DSLIB_TEST_CRASH_AFTER_SAVES", None)
     procs = [subprocess.Popen(
-        [sys.executable, os.path.join(_HERE, "mp_worker.py"), "crashfit",
-         str(r), "2", str(port), csv, ck, out],
+        [sys.executable, os.path.join(_HERE, "mp_worker.py"), mode,
+         str(r), str(nprocs), str(port), csv, ck, out],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for r in range(2)]
+        for r in range(nprocs)]
     rcs, outs = [], []
     for p in procs:
         try:
@@ -133,6 +135,65 @@ def _run_crashfit(tmp_path, csv, tag, crash_after):
         rcs.append(p.returncode)
         outs.append(stdout.decode())
     return rcs, outs, out, ck
+
+
+def _run_grid(tmp_path, csv, tag, crash_after, nprocs=4):
+    return _run_ckfit(tmp_path, csv, tag, crash_after, "grid", nprocs)
+
+
+def test_four_process_grid_mesh_and_resume(tmp_path):
+    """Round-5 (SURVEY §3.7 cross-slice row, §6 fault tolerance): 4 real
+    processes on a 2-D PROCESS mesh (4 rows × 2 cols, one mesh row per
+    process).  KMeans + collect + checkpoint-resume + all_to_all shuffle
+    all cross the 4-way process boundary; centers oracle'd against an
+    in-process NumPy Lloyd run, and the kill+resume run must land on the
+    uninterrupted run's centers exactly."""
+    rng = np.random.RandomState(2)
+    data = rng.rand(96, 5).astype(np.float32)
+    csv = str(tmp_path / "data.csv")
+    np.savetxt(csv, data, delimiter=",", fmt="%.6f")
+    parsed = np.loadtxt(csv, delimiter=",", dtype=np.float32, ndmin=2)
+
+    # uninterrupted run
+    rcs, outs, out_ok, _ = _run_grid(tmp_path, csv, "ok", crash_after=0)
+    assert rcs == [0, 0, 0, 0], outs
+    with open(out_ok) as f:
+        oracle = json.load(f)
+    assert oracle["n_iter"] == 12
+    assert oracle["shape"] == [96, 5]
+    assert oracle["shuffle_ok"], "4-way all_to_all shuffle lost rows"
+    np.testing.assert_allclose(oracle["checksum"], parsed.sum(), rtol=1e-5)
+
+    # NumPy Lloyd oracle (same init = first 3 rows, 12 iterations)
+    centers = np.asarray(parsed[:3], np.float64)
+    for _ in range(12):
+        d = ((parsed[:, None, :] - centers[None]) ** 2).sum(-1)
+        lab = d.argmin(1)
+        centers = np.stack([
+            parsed[lab == j].mean(0) if (lab == j).any() else centers[j]
+            for j in range(3)])
+    np.testing.assert_allclose(np.asarray(oracle["centers"]), centers,
+                               rtol=2e-3, atol=2e-3)
+
+    # whole-job death after the 2nd durable snapshot (6 of 12 iters)
+    rcs, outs, out_crash, ck = _run_grid(tmp_path, csv, "crash",
+                                         crash_after=2)
+    assert rcs == [17, 17, 17, 17], outs
+    assert os.path.exists(ck) and not os.path.exists(out_crash)
+
+    # resume across all 4 processes → identical final centers
+    rcs, outs, out_res, _ = _run_grid(tmp_path, csv, "crash", crash_after=0)
+    assert rcs == [0, 0, 0, 0], outs
+    with open(out_res) as f:
+        resumed = json.load(f)
+    assert resumed["n_iter"] == 12
+    np.testing.assert_allclose(np.asarray(resumed["centers"]),
+                               np.asarray(oracle["centers"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def _run_crashfit(tmp_path, csv, tag, crash_after):
+    return _run_ckfit(tmp_path, csv, tag, crash_after, "crashfit", 2)
 
 
 def test_kill_and_resume_equivalence(tmp_path):
