@@ -654,6 +654,373 @@ def bench_gridsearch(m, n, cands, folds, kmeans_iters, tag):
             "vs_baseline": round(cpu_wall / t, 2)}
 
 
+# --- round-5 rows: the estimator tier (VERDICT r4 missing #3) --------------
+
+def _blobs(m, n, k, seed=0, std=0.08):
+    """k well-separated gaussian blobs on the unit cube — shared synthetic
+    for the estimator-tier rows (labels = blob id)."""
+    rng = np.random.RandomState(seed)
+    centers = rng.rand(k, n).astype(np.float32)
+    lab = rng.randint(0, k, m)
+    x = centers[lab] + std * rng.standard_normal((m, n)).astype(np.float32)
+    return x.astype(np.float32), lab.astype(np.int64)
+
+
+def _numpy_dbscan(x, eps, min_samples, chunk=4096):
+    """Same-algorithm DBSCAN: chunked ε-graph, connected components of the
+    core-core graph, border points joined to their first core neighbor.
+    Returns (labels, eps_wall) — the ε-pass wall is the O(m²) part and is
+    reported separately so the caller can scale it quadratically and the
+    graph/relabel tail sub-quadratically."""
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import connected_components
+    m = x.shape[0]
+    eps2 = eps * eps
+    xsq = (x * x).sum(1)
+    t_eps = time.perf_counter()
+    counts = np.zeros(m, np.int64)
+    for s in range(0, m, chunk):
+        d = xsq[s:s + chunk, None] - 2.0 * (x[s:s + chunk] @ x.T) + xsq[None]
+        counts[s:s + chunk] = (d <= eps2).sum(1)
+    core = counts >= min_samples
+    rows, cols = [], []
+    border_to = np.full(m, -1, np.int64)
+    for s in range(0, m, chunk):
+        d = xsq[s:s + chunk, None] - 2.0 * (x[s:s + chunk] @ x.T) + xsq[None]
+        adj = d <= eps2
+        cc = adj & core[None, :]
+        r, c = np.nonzero(cc & core[s:s + chunk, None])
+        rows.append(r + s)
+        cols.append(c)
+        has = cc.any(1)
+        border_to[s:s + chunk][has] = np.argmax(cc[has], axis=1)
+    eps_wall = time.perf_counter() - t_eps
+    g = sp.csr_matrix(
+        (np.ones(sum(len(r) for r in rows), np.int8),
+         (np.concatenate(rows), np.concatenate(cols))), shape=(m, m))
+    n_comp, comp = connected_components(g, directed=False)
+    labels = np.full(m, -1, np.int64)
+    labels[core] = comp[core]
+    join = (~core) & (border_to >= 0)
+    labels[join] = comp[border_to[join]]
+    # renumber compactly over the labels that survived (vectorised)
+    used, inv = np.unique(labels[labels >= 0], return_inverse=True)
+    labels[labels >= 0] = inv
+    return labels, eps_wall
+
+
+def _same_partition_on_core(lab_a, lab_b, core_mask):
+    """True iff the two labelings induce the SAME partition of the core
+    points (bijective label correspondence — border ties may legally
+    differ between schedules)."""
+    a, b = lab_a[core_mask], lab_b[core_mask]
+    if (a < 0).any() or (b < 0).any():
+        return False
+    pairs = set(zip(a.tolist(), b.tolist()))
+    return len(pairs) == len(set(p[0] for p in pairs)) == \
+        len(set(p[1] for p in pairs))
+
+
+def bench_dbscan(m, n, tag, proxy_m=None):
+    """DBSCAN on the tiled-streamed tier (m > dense-max on a 1-row mesh).
+    Proxy: same-algorithm NumPy at ``proxy_m`` rows (the matmul proxy_dim
+    precedent): its ε-pass wall scales by (m/proxy)², the graph/label tail
+    by (m/proxy) — a conservative under-statement of the true baseline.
+    Gate: device labels at the proxy shape induce the proxy's exact core
+    partition."""
+    import dislib_tpu as ds
+    from dislib_tpu.cluster import DBSCAN
+
+    proxy_m = proxy_m or m
+    eps, min_samples = 0.35, 5
+    xp_host, _ = _blobs(proxy_m, n, k=16, seed=3)
+    t0 = time.perf_counter()
+    lab_proxy, eps_wall = _numpy_dbscan(xp_host, eps, min_samples)
+    total_wall = time.perf_counter() - t0
+    ratio = m / proxy_m
+    # only the ε-pass is O(m²); the graph/label tail scales with the edge
+    # count — super-linear for fixed eps but below m², so scaling it by
+    # ratio (not ratio²) UNDER-states the proxy and keeps vs_baseline
+    # conservative
+    cpu_wall = eps_wall * ratio ** 2 + (total_wall - eps_wall) * ratio
+
+    # correctness gate at the proxy shape
+    fit_small = DBSCAN(eps=eps, min_samples=min_samples) \
+        .fit(ds.array(xp_host, block_size=(4096, n)))
+    core_mask = np.zeros(proxy_m, bool)
+    core_mask[fit_small.core_sample_indices_] = True
+    assert _same_partition_on_core(fit_small.labels_, lab_proxy, core_mask), \
+        "dbscan gate: device core partition != numpy proxy"
+    noise_dev = int((fit_small.labels_ < 0).sum())
+    noise_prx = int((lab_proxy < 0).sum())
+    assert abs(noise_dev - noise_prx) <= max(5, 0.01 * proxy_m), \
+        f"dbscan gate: noise count {noise_dev} vs proxy {noise_prx}"
+
+    x_host, _ = _blobs(m, n, k=16, seed=4)
+    a = ds.array(x_host, block_size=(8192, n))
+    DBSCAN(eps=eps, min_samples=min_samples).fit(a)     # warmup/compile
+    t = _median_time(lambda: DBSCAN(eps=eps, min_samples=min_samples).fit(a))
+    return {"metric": f"dbscan_{tag}_wall_s (baseline: numpy same-algorithm "
+                      f"proxy at {proxy_m} rows x (m/proxy)^2)",
+            "value": round(t, 4), "unit": "s",
+            "vs_baseline": round(cpu_wall / t, 2)}
+
+
+def _numpy_hist_tree_level(bx, node, w, y_onehot, n_nodes, n_bins):
+    """One level of the same histogram-tree algorithm (gini), NumPy."""
+    m, n = bx.shape
+    k = y_onehot.shape[1]
+    hist = np.zeros((n_nodes, n, n_bins, k), np.float32)
+    np.add.at(hist, (node[:, None], np.arange(n)[None, :], bx),
+              (w[:, None] * y_onehot)[:, None, :])
+    left = np.cumsum(hist, axis=2)
+    total = left[:, :, -1:, :]
+    right = total - left
+
+    def gini(s):
+        wts = s.sum(-1)
+        p = s / np.maximum(wts[..., None], 1e-12)
+        return wts * (1.0 - (p * p).sum(-1))
+
+    gain = gini(total) - gini(left) - gini(right)
+    gain[:, :, -1] = -np.inf
+    wl, wr = left.sum(-1), right.sum(-1)
+    gain[~((wl > 0) & (wr > 0))] = -np.inf
+    flat = gain.reshape(n_nodes, -1)
+    best = flat.argmax(1)
+    feat = (best // n_bins).astype(np.int64)
+    tbin = best % n_bins
+    is_split = flat[np.arange(n_nodes), best] > 0.0
+    feat[~is_split] = 0
+    tbin[~is_split] = n_bins - 1
+    go_right = (bx[np.arange(m), feat[node]] > tbin[node]) & is_split[node]
+    return node * 2 + go_right.astype(node.dtype)
+
+
+def bench_forest(m, n, n_trees, tag, depth=8):
+    """RandomForest fit + predict.  Proxy: the same histogram-tree
+    algorithm in NumPy, ONE tree's growth × n_trees (per-tree scaling —
+    the trees are independent).  Gate: device train accuracy ≥ 0.95 on
+    separable blobs AND ≥ proxy-tree accuracy − 5 pts."""
+    import dislib_tpu as ds
+    from dislib_tpu.trees import RandomForestClassifier
+
+    n_bins = 32
+    x_host, lab = _blobs(m, n, k=8, seed=5)
+    y_host = (lab % 2).astype(np.float32)[:, None]
+
+    # numpy proxy: one bootstrap tree, same binning + level loop
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    qs = np.linspace(0, 100, n_bins + 1)[1:-1]
+    edges = np.percentile(x_host, qs, axis=0).T
+    bx = (x_host[:, :, None] > edges[None]).sum(2)
+    w = rng.poisson(1.0, m).astype(np.float32)
+    y1 = np.zeros((m, 2), np.float32)
+    y1[np.arange(m), y_host.ravel().astype(np.int64)] = 1.0
+    node = np.zeros(m, np.int64)
+    for lvl in range(depth):
+        node = _numpy_hist_tree_level(bx, node, w, y1, 2 ** lvl, n_bins)
+    leaf_stats = np.zeros((2 ** depth, 2), np.float32)
+    np.add.at(leaf_stats, node, w[:, None] * y1)
+    proxy_tree_wall = time.perf_counter() - t0
+    cpu_wall = proxy_tree_wall * n_trees
+    pred_proxy = leaf_stats.argmax(1)[node]
+    proxy_acc = float((pred_proxy == y_host.ravel()).mean())
+
+    a = ds.array(x_host, block_size=(8192, n))
+    yb = ds.array(y_host, block_size=(8192, 1))
+
+    def fit_predict():
+        rf = RandomForestClassifier(n_estimators=n_trees, max_depth=depth,
+                                    random_state=0)
+        rf.fit(a, yb)
+        return rf, np.asarray(rf.predict(a).collect()).ravel()
+
+    rf0, pred0 = fit_predict()                          # warmup/compile
+    acc = float((pred0 == y_host.ravel()).mean())
+    assert acc >= 0.95 and acc >= proxy_acc - 0.05, \
+        f"forest gate: device {acc} vs proxy tree {proxy_acc}"
+    t = _median_time(lambda: fit_predict())
+    return {"metric": f"forest_{tag}_{n_trees}t_fit_predict_wall_s "
+                      "(baseline: numpy same-algorithm histogram tree "
+                      "x n_trees)",
+            "value": round(t, 4), "unit": "s",
+            "vs_baseline": round(cpu_wall / t, 2),
+            "device_train_acc": round(acc, 4),
+            "proxy_train_acc": round(proxy_acc, 4)}
+
+
+def bench_knn(m_fit, n, mq, k, tag):
+    """kNN query throughput over a streamed (chunked) fit set.  Proxy:
+    chunked NumPy brute force, same algorithm.  Gate: device distances ==
+    NumPy on a query subset."""
+    import dislib_tpu as ds
+    from dislib_tpu.neighbors import NearestNeighbors
+
+    rng = np.random.RandomState(1)
+    fit_host = rng.rand(m_fit, n).astype(np.float32)
+    q_host = rng.rand(mq, n).astype(np.float32)
+
+    def numpy_knn(q):
+        out = np.empty((len(q), k), np.float32)
+        fsq = (fit_host * fit_host).sum(1)
+        for s in range(0, len(q), 1024):
+            d = ((q[s:s + 1024] ** 2).sum(1)[:, None]
+                 - 2.0 * q[s:s + 1024] @ fit_host.T + fsq[None])
+            # partition, not sort: O(m) top-k is what any reasonable
+            # brute-force baseline does (review: a full row sort would
+            # inflate the proxy wall several-fold)
+            top = np.partition(d, k - 1, axis=1)[:, :k]
+            out[s:s + 1024] = np.sort(top, axis=1)
+        return np.sqrt(np.maximum(out, 0.0))
+
+    t0 = time.perf_counter()
+    d_proxy = numpy_knn(q_host)
+    cpu_wall = time.perf_counter() - t0
+
+    nn = NearestNeighbors(n_neighbors=k).fit(
+        ds.array(fit_host, block_size=(8192, n)))
+    qa = ds.array(q_host, block_size=(8192, n))
+    d_dev, _ = nn.kneighbors(qa)                        # warmup/compile
+    d_dev_h = np.asarray(d_dev.collect())
+    gate = np.abs(np.sort(d_dev_h, 1) - np.sort(d_proxy[: mq], 1)).max()
+    assert gate < 1e-2, f"knn gate: max distance error {gate}"
+
+    def run():
+        d, i = nn.kneighbors(qa)
+        _sync(d, i)
+    t = _median_time(run)
+    return {"metric": f"knn_{tag}_k{k}_queries_per_sec "
+                      "(baseline: numpy chunked brute force)",
+            "value": round(mq / t, 1), "unit": "queries/s",
+            "vs_baseline": round(cpu_wall / t, 2),
+            "wall_s": round(t, 4)}
+
+
+def bench_als_sparse(n_users, n_items, nnz_per_user, tag, n_f=16, iters=3):
+    """Sparse ALS (BCOO segment-sum path).  Proxy: same-algorithm NumPy —
+    batched per-user/item normal equations from the triplets, ONE
+    iteration × iters.  Gate: device training RMSE ≤ 1.3×proxy + 0.05
+    (see the inline note on independent-init spread)."""
+    import scipy.sparse as sp
+
+    import dislib_tpu as ds  # noqa: F401  (package init = mesh init)
+    from dislib_tpu.data.sparse import SparseArray
+    from dislib_tpu.recommendation import ALS
+
+    rng = np.random.RandomState(2)
+    rows = np.repeat(np.arange(n_users), nnz_per_user)
+    cols = rng.randint(0, n_items, rows.shape[0])
+    u0 = rng.standard_normal((n_users, n_f)).astype(np.float32)
+    v0 = rng.standard_normal((n_items, n_f)).astype(np.float32)
+    vals = (u0[rows] * v0[cols]).sum(1) + \
+        0.1 * rng.standard_normal(rows.shape[0]).astype(np.float32)
+    csr = sp.csr_matrix((vals, (rows, cols)), shape=(n_users, n_items),
+                        dtype=np.float32)
+    lam = 0.065
+
+    def numpy_als_half(fixed, rows_ix, cols_ix, v):
+        """Solve one side's normal equations from the triplets (batched)."""
+        nn_ = fixed.shape[1]
+        g = np.zeros((int(rows_ix.max()) + 1, nn_, nn_), np.float32)
+        b = np.zeros((int(rows_ix.max()) + 1, nn_), np.float32)
+        f = fixed[cols_ix]
+        np.add.at(g, rows_ix, f[:, :, None] * f[:, None, :])
+        np.add.at(b, rows_ix, f * v[:, None])
+        cnt = np.bincount(rows_ix, minlength=g.shape[0]).astype(np.float32)
+        g += lam * np.maximum(cnt, 1.0)[:, None, None] * \
+            np.eye(nn_, dtype=np.float32)[None]
+        return np.linalg.solve(g, b[..., None])[..., 0]
+
+    # proxy init is a FRESH random draw (not the generating factors u0/v0
+    # — that would hand the proxy a converged start the device never gets)
+    rng_p = np.random.RandomState(7)
+    v_p = rng_p.standard_normal((n_items, n_f)).astype(np.float32)
+    t0 = time.perf_counter()
+    u_np = numpy_als_half(v_p, rows, cols, vals)
+    _ = numpy_als_half(u_np, cols, rows, vals)
+    cpu_wall = (time.perf_counter() - t0) * iters
+
+    s_arr = SparseArray.from_scipy(csr)
+    als = ALS(n_f=n_f, lambda_=lam, max_iter=iters, tol=0.0, random_state=0)
+    als.fit(s_arr)                                      # warmup/compile
+    pred = (als.users_[rows] * als.items_[cols]).sum(1)
+    rmse_dev = float(np.sqrt(np.mean((pred - vals) ** 2)))
+    # proxy RMSE after the same number of alternations from its random init
+    for _ in range(iters):
+        u_p = numpy_als_half(v_p, rows, cols, vals)
+        v_p = numpy_als_half(u_p, cols, rows, vals)
+    rmse_prx = float(np.sqrt(np.mean(
+        ((u_p[rows] * v_p[cols]).sum(1) - vals) ** 2)))
+    # gate width: device and proxy descend from INDEPENDENT random inits,
+    # so after few iterations they sit in different basins — 1.3x + 0.05
+    # catches a broken solver (rmse ~ O(1) garbage) without flaking on
+    # legitimate init-to-init spread; both values are emitted for audit
+    assert rmse_dev <= rmse_prx * 1.3 + 0.05, \
+        f"als gate: device rmse {rmse_dev} vs proxy {rmse_prx}"
+
+    t = _median_time(lambda: ALS(n_f=n_f, lambda_=lam, max_iter=iters,
+                                 tol=0.0, random_state=0).fit(s_arr))
+    return {"metric": f"als_sparse_{tag}_f{n_f}_{iters}it_wall_s "
+                      "(baseline: numpy same-algorithm batched normal "
+                      "equations x iters)",
+            "value": round(t, 4), "unit": "s",
+            "vs_baseline": round(cpu_wall / t, 2),
+            "device_rmse": round(rmse_dev, 4),
+            "proxy_rmse": round(rmse_prx, 4)}
+
+
+def bench_shuffle(m, n, tag, chain=8):
+    """Global all_to_all shuffle throughput.  Proxy: NumPy permutation
+    take of the same matrix.  Gate: the row multiset is preserved.
+    ``chain`` shuffles per timed region amortize the dispatch RTT."""
+    import dislib_tpu as ds
+    from dislib_tpu.utils import shuffle
+
+    rng = np.random.RandomState(3)
+    x_host = rng.rand(m, n).astype(np.float32)
+    perm = rng.permutation(m)
+    t0 = time.perf_counter()
+    _ = x_host[perm]
+    cpu_wall = time.perf_counter() - t0
+
+    a = ds.array(x_host, block_size=(8192, n))
+    out = shuffle(a, random_state=0)                    # warmup/compile
+    small = ds.array(x_host[:2048], block_size=(512, n))
+    sm = np.asarray(shuffle(small, random_state=1).collect())
+    assert sorted(map(tuple, sm.tolist())) == \
+        sorted(map(tuple, x_host[:2048].tolist())), \
+        "shuffle gate: row multiset not preserved"
+
+    rtt = _measure_rtt()
+
+    def run():
+        y = a
+        for i in range(chain):
+            y = shuffle(y, random_state=i)
+        _sync(y._data)
+    run()                                               # chain warmup
+    t = _median_time(run)
+    gb = m * n * 4 / 1e9
+    raw_gbps = gb * chain / t
+    # the correction is only meaningful when the RTTs are a MINORITY of
+    # the wall; when t ≲ chain·rtt the subtraction degenerates (divide by
+    # ~0 → absurd GB/s), so emit null rather than poison the artifact
+    corr = t - chain * rtt
+    corr_gbps = round(gb * chain / corr, 2) if corr > 0.2 * t else None
+    return {"metric": f"shuffle_{tag}_gb_per_sec (baseline: numpy "
+                      "permutation take)",
+            "value": round(raw_gbps, 2), "unit": "GB/s",
+            "vs_baseline": round((cpu_wall * chain) / t, 2),
+            "rtt_ms": round(rtt * 1e3, 2),
+            "rtt_corrected_value": corr_gbps,
+            "shuffles_per_region": chain,
+            "note": "each chained shuffle pays one host-planning RTT; "
+                    "rtt_corrected_value subtracts them (null when RTT "
+                    "dominates the region)"}
+
+
 def _configs():
     """Ordered (name, thunk) list.  BENCH_SMOKE=1: every config at ~1/100
     scale — validates the whole harness (gates, proxies, JSON, watchdog
@@ -680,6 +1047,15 @@ def _configs():
             ("gridsearch_smoke",
              lambda: bench_gridsearch(2000, 8, (2, 3), 2, 4, "smoke")),
             ("gmm_smoke", lambda: bench_gmm(2000, 8, 3, 2)),
+            ("dbscan_smoke", lambda: bench_dbscan(3000, 6, "smoke",
+                                                  proxy_m=1500)),
+            ("forest_smoke", lambda: bench_forest(2000, 8, 4, "smoke",
+                                                  depth=5)),
+            ("knn_smoke", lambda: bench_knn(4000, 8, 512, 5, "smoke")),
+            ("als_smoke", lambda: bench_als_sparse(1000, 400, 10, "smoke",
+                                                   n_f=8, iters=2)),
+            ("shuffle_smoke", lambda: bench_shuffle(4096, 16, "smoke",
+                                                    chain=3)),
             ("kmeans_smoke_star",
              lambda: bench_kmeans(4000, 20, 4, 5, "smoke_star")),
         ]
@@ -708,6 +1084,20 @@ def _configs():
         ("gridsearch_kmeans_200000x20_3x3fits_wall_s",
          lambda: bench_gridsearch(200_000, 20, (4, 8, 12), 3, 10,
                                   "200000x20")),
+        # round-5: the estimator tier (r4 VERDICT missing #3) — DBSCAN on
+        # the tiled-streamed tier, forest fit+predict, kNN streamed query
+        # throughput, sparse ALS, and the all_to_all shuffle
+        ("dbscan_200000x10_wall_s",
+         lambda: bench_dbscan(200_000, 10, "200000x10", proxy_m=20_000)),
+        ("forest_100000x20_16t_fit_predict_wall_s",
+         lambda: bench_forest(100_000, 20, 16, "100000x20")),
+        ("knn_1000000x10_q10000_k10_queries_per_sec",
+         lambda: bench_knn(1_000_000, 10, 10_000, 10, "1000000x10_q10000")),
+        ("als_sparse_100000x10000_nnz100_f16_3it_wall_s",
+         lambda: bench_als_sparse(100_000, 10_000, 100,
+                                  "100000x10000_nnz100")),
+        ("shuffle_2097152x64_gb_per_sec",
+         lambda: bench_shuffle(2_097_152, 64, "2097152x64")),
         ("matmul_16384_f32_gflops_per_chip",
          lambda: bench_matmul(16384, "16384", proxy_dim=8192, chain=6)),
         # informational variants — headline ★ stays the full-precision path
@@ -757,6 +1147,36 @@ def _run_one(name):
     _guard(name, fn)
 
 
+def _emit_stale_fallback():
+    """On a wedged/failed device probe, re-emit the most recent green
+    local capture (BENCH_local_r*.jsonl) with ``stale: true`` on every row
+    — rc stays non-zero for the driver, but the artifact remains
+    monotonically informative instead of one error line (round-4 VERDICT
+    weak #8: the round-4 wedge cost the round its entire measurement
+    record)."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    captures = sorted(glob.glob(os.path.join(here, "BENCH_local_r*.jsonl")))
+    for path in reversed(captures):
+        rows = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line.startswith("{"):
+                        rec = json.loads(line)
+                        if not rec.get("error"):
+                            rows.append(rec)
+        except (OSError, ValueError):
+            continue
+        if rows:
+            for rec in rows:
+                rec["stale"] = True
+                rec["stale_source"] = os.path.basename(path)
+                _emit(rec)
+            return
+
+
 def main():
     # persistent compilation cache for all config children: repeat runs (and
     # the f32/bf16 siblings of a config) skip the 20-40 s TPU compiles, so
@@ -794,12 +1214,14 @@ def main():
                "vs_baseline": None,
                "error": f"device probe hung past {_PROBE_TIMEOUT_S}s "
                         "(wedged tunnel?)"})
+        _emit_stale_fallback()
         sys.exit(2)
     except subprocess.CalledProcessError as e:
         _emit({"metric": "backend_init", "value": None, "unit": None,
                "vs_baseline": None,
                "error": f"device probe failed (rc={e.returncode})",
                "stderr_tail": (e.stderr or "")[-400:]})
+        _emit_stale_fallback()
         sys.exit(2)
 
     consecutive_timeouts = 0

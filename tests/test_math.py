@@ -1,6 +1,8 @@
 """Blocked-math tests (reference: test_matmul/test_kron/test_svd/test_qr/
 test_tsqr/test_randomsvd/test_lanczos/test_pca — SURVEY.md §5 oracle pattern)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -378,6 +380,40 @@ class TestCholQR2:
         np.testing.assert_allclose(qh @ rh, x, rtol=1e-3, atol=1e-3)
         # orthogonality of the RANGE part still holds to tree-QR quality
         assert np.abs(qh.T @ qh - np.eye(16)).max() < 1e-2
+
+    @pytest.mark.skipif(os.environ.get("DSLIB_TEST_TPU") != "1",
+                        reason="breakdown band is an MXU-rounding property "
+                               "— meaningful on the real chip only")
+    def test_cholqr_breakdown_band_on_chip(self, rng, monkeypatch):
+        """Round-5 (VERDICT #3): probe the cond(A) band around u^(-1/2)
+        under the actual MXU rounding the `precise`-scoped Gram gets on
+        chip.  Sweep cond 1e2 → 1e8 with forced cholqr: the quality gate's
+        `ok` must hold at benign cond, the fallback MUST fire by 1e6, and
+        end-to-end orthogonality stays < 1e-3 at every cond (lose speed,
+        never accuracy)."""
+        self._force(monkeypatch)
+        import jax
+        from dislib_tpu.decomposition.tsqr import _cholqr2
+        from dislib_tpu.ops.base import precise
+        m, n = 4096, 128
+        u0, _ = np.linalg.qr(rng.standard_normal((m, n)))
+        v0, _ = np.linalg.qr(rng.standard_normal((n, n)))
+        gate = jax.jit(precise(_cholqr2))
+        oks = {}
+        for cond in (1e2, 1e4, 1e6, 1e8):
+            spec = np.logspace(0, -np.log10(cond), n).astype(np.float32)
+            x = ((u0 * spec) @ v0.T).astype(np.float32)
+            _, _, ok = gate(x)
+            oks[cond] = bool(ok)
+            q, r = ds.tsqr(ds.array(x, block_size=(512, n)))
+            qh, rh = np.asarray(q.collect()), np.asarray(r.collect())
+            ortho = np.abs(qh.T @ qh - np.eye(n)).max()
+            assert ortho < 1e-3, f"cond={cond:g}: orthogonality {ortho}"
+            assert np.abs(qh @ rh - x).max() < 1e-3 * spec[0], \
+                f"cond={cond:g}: reconstruction"
+        assert oks[1e2], f"quality gate refused a benign matrix: {oks}"
+        assert not oks[1e6] and not oks[1e8], \
+            f"fallback did not fire in the breakdown band: {oks}"
 
     def test_randomsvd_and_blocked_qr_with_cholqr(self, rng, monkeypatch):
         self._force(monkeypatch)

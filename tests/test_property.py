@@ -11,7 +11,15 @@ from hypothesis import given, settings, strategies as st
 
 import dislib_tpu as ds
 
-_settings = settings(max_examples=25, deadline=None)
+# On the real chip every example pays the ~69 ms tunnel dispatch RTT, so
+# 25 examples x ~10 dispatches x 9 properties blows the suite-runner's
+# 900 s per-file budget (round-5: rc 124 on-chip).  The TPU run keeps the
+# same properties at sample size 5 — the hardware-rounding check — while
+# the CPU rig keeps the full search.
+import os
+
+_N = 5 if os.environ.get("DSLIB_TEST_TPU") == "1" else 25
+_settings = settings(max_examples=_N, deadline=None)
 
 
 @st.composite

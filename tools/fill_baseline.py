@@ -43,6 +43,16 @@ ROWS = [
     ("gridsearch_kmeans_200000x20_3x3fits_wall_s",
      "GridSearchCV (async trials)", "KMeans 200k×20, 3 cand × 3 folds",
      False),
+    ("dbscan_200000x10_wall_s", "DBSCAN (tiled tier)",
+     "200k×10, ε-stream + label propagation", False),
+    ("forest_100000x20_16t_fit_predict_wall_s", "RandomForest (vmapped)",
+     "100k×20, 16 trees, fit+predict", False),
+    ("knn_1000000x10_q10000_k10_queries_per_sec", "kNN query throughput",
+     "1M fit rows, 10k queries, k=10", False),
+    ("als_sparse_100000x10000_nnz100_f16_3it_wall_s", "ALS (sparse BCOO)",
+     "100k×10k, 100 nnz/user, f=16, 3 iter", False),
+    ("shuffle_2097152x64_gb_per_sec", "Shuffle (all_to_all)",
+     "2M×64 f32 (512 MB)", False),
     ("matmul_16384_f32_gflops_per_chip", "Matmul north star ★ (f32)",
      "16384×16384", True),
     ("matmul_16384_bf16_gflops_per_chip", "Matmul north star ★ (bf16)",
@@ -69,6 +79,11 @@ def main():
             if not line.startswith("{"):
                 continue
             rec = json.loads(line)
+            if rec.get("stale"):
+                # stale-fallback rows are a wedge-day courtesy copy of an
+                # older capture — never let them overwrite the table as if
+                # they were this run's measurements
+                continue
             results[rec["metric"].split(" ")[0]] = rec
 
     out_rows = [f"| Workload | Config | Measured | Unit | raw (1 RTT/disp) "
