@@ -63,23 +63,24 @@ class TestBenchOrchestrator:
                    for l in stale)
         assert all(not l.get("error") for l in stale)
         # ...and fill_baseline must REFUSE to treat stale rows as measured
-        import tempfile
-        with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
-                                         delete=False) as f:
-            for l in lines:
-                f.write(json.dumps(l) + "\n")
-            name = f.name
+        # — run against a COPY of BASELINE.md (FILL_BASELINE_PATH hook):
+        # mutating the checked-in file would risk wiping it if this test
+        # process is SIGKILLed before a restore
+        import re
         import shutil
-        bak = name + ".md"
-        shutil.copy(os.path.join(REPO, "BASELINE.md"), bak)
-        try:
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            name = os.path.join(td, "rows.jsonl")
+            with open(name, "w") as f:
+                for l in lines:
+                    f.write(json.dumps(l) + "\n")
+            md = os.path.join(td, "BASELINE.md")
+            shutil.copy(os.path.join(REPO, "BASELINE.md"), md)
             out = subprocess.run(
                 [sys.executable, os.path.join(REPO, "tools",
                                               "fill_baseline.py"), name],
-                capture_output=True, text=True, cwd=REPO)
-            import re
+                capture_output=True, text=True, cwd=REPO,
+                env={**os.environ, "FILL_BASELINE_PATH": md})
             m = re.search(r"updated with (\d+) measured rows", out.stdout)
             assert m, f"fill_baseline failed: {out.stdout} {out.stderr}"
             assert m.group(1) == "0", out.stdout
-        finally:
-            shutil.copy(bak, os.path.join(REPO, "BASELINE.md"))

@@ -120,7 +120,11 @@ def main():
                 f"| {name} | {cfg} | {rec['value']} | {rec['unit']} | "
                 f"{raw} | {vsb} | {mfu} | {hw} |")
 
-    path = os.path.join(ROOT, "BASELINE.md")
+    # FILL_BASELINE_PATH: test hook — point at a COPY so harness tests
+    # never rewrite the checked-in file (a SIGKILL mid-test would leave it
+    # wiped with no restore)
+    path = os.environ.get("FILL_BASELINE_PATH") \
+        or os.path.join(ROOT, "BASELINE.md")
     text = open(path).read()
     table = "\n".join(out_rows)
     block = ("## Measured results\n\n"

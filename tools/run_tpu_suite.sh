@@ -13,6 +13,10 @@ set -u
 LOG="${1:-/tmp/tpu_suite_results.log}"
 TMO="${2:-900}"
 cd "$(dirname "$0")/.."
+# persistent compile cache (same one bench.py uses): dispatch-heavy files
+# (ring, property) otherwise burn their whole budget on repeated 20-40 s
+# TPU compiles of tiny shapes — round-5 rc-124 post-mortem
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
 touch "$LOG"
 overall=0
 consec_tmo=0
@@ -25,9 +29,14 @@ for f in tests/test_*.py; do
   # -k: a wedged device claim can leave python unkillable by TERM; KILL
   # 30s later so `timeout` itself can never hang (rc 137 = KILL path,
   # counted as a timeout below alongside 124)
+  tmpout=$(mktemp)
   DSLIB_TEST_TPU=1 timeout -k 30 "$TMO" python -m pytest "$f" -q --no-header \
-    2>&1 | tail -3
-  rc=${PIPESTATUS[0]}
+    > "$tmpout" 2>&1
+  rc=$?
+  # greens stay terse; failures keep enough context to diagnose without a
+  # re-run (round-5: the GMM loglik delta was lost to tail -3)
+  if [ "$rc" -eq 0 ]; then tail -3 "$tmpout"; else tail -40 "$tmpout"; fi
+  rm -f "$tmpout"
   grep -v " $f$" "$LOG" > "$LOG.tmp" || true   # one line per file
   mv "$LOG.tmp" "$LOG"
   if [ "$rc" -eq 0 ]; then
