@@ -13,7 +13,10 @@ TPU-native redesign — no sklearn, no ragged SV sets:
   scalar, i.e. hostile to the MXU.  What remains is box-constrained
   projected gradient ascent: ``α ← clip(α + η(1 − Qα), 0, C)`` — one GEMV
   per step inside a `lax.while_loop`, step size from the Gershgorin bound
-  ``η = 1/max_row_sum(|Q|)``.
+  ``η = 1/max_row_sum(|Q|)``.  ``DSLIB_CSVM_SOLVER=fista`` switches to
+  accelerated PG with adaptive restart (same fixed point + stopping
+  rule, fewer sequential steps — the cascade's TPU latency driver; the
+  bench row A/Bs both, see `_use_fista`).
 - The reference's *growing* SV sets become **fixed-capacity index buffers
   with masking** (SURVEY §8 "hard parts" #1): a cascade node is a padded
   vector of sample indices; padded slots get ``C = 0`` so their α is pinned
@@ -118,6 +121,10 @@ class CascadeSVM(BaseEstimator):
         y_pm = np.where(y_host == classes[1], 1.0, -1.0).astype(np.float32)
 
         gamma = self._gamma_value(n)
+        # resolved ONCE per fit and threaded as a trace-time static (the
+        # _use_cholqr pattern: flipping the env var retraces, never
+        # silently ignored)
+        solver = "fista" if _use_fista() else "pg"
         # SPARSE-NATIVE path (SURVEY §8 hard part 2): the matrix is never
         # densified.  A host CSR copy (O(nnz), the same layout the
         # reference's per-partition SVC tasks consume on CPU workers)
@@ -226,7 +233,7 @@ class CascadeSVM(BaseEstimator):
                                                     float(self.c), n,
                                                     self.kernel, gamma,
                                                     k_of=k_of, y_host=y_pm,
-                                                    ell=ell)
+                                                    ell=ell, solver=solver)
                 if nodes.shape[0] == 1:
                     break
                 nodes = self._merge_level(nodes, np.asarray(alphas))
@@ -379,7 +386,7 @@ def _host_gram(csr, rowsq, kernel, gamma):
 
 
 def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma,
-                         k_of=None, y_host=None, ell=None):
+                         k_of=None, y_host=None, ell=None, solver="pg"):
     """One cascade level in node batches bounded by a byte budget.
 
     A level's vmapped solve holds ~3 (cap, cap) f32 buffers per node
@@ -404,17 +411,17 @@ def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma,
     def solve_chunk(chunk):
         if ell is not None:
             return _solve_level_ell(ell[0], ell[1], yv, jnp.asarray(chunk),
-                                    c, n_feat, kernel, gamma)
+                                    c, n_feat, kernel, gamma, solver)
         if k_of is None:
             return _solve_level(xv, yv, jnp.asarray(chunk), c, n_feat,
-                                kernel, gamma)
+                                kernel, gamma, solver)
         valid = chunk >= 0
         k_sub = k_of(chunk)
         y_sub = np.where(valid, y_host[np.maximum(chunk, 0)], 0.0) \
             .astype(np.float32)
         c_vec = np.where(valid, c, 0.0).astype(np.float32)
         return _solve_level_k(jnp.asarray(k_sub), jnp.asarray(y_sub),
-                              jnp.asarray(c_vec))
+                              jnp.asarray(c_vec), solver)
 
     if n_nodes <= batch and k_of is None:
         return solve_chunk(nodes)
@@ -453,34 +460,77 @@ def _gram(a, b, kernel, gamma):
     return a @ b.T
 
 
-def _dual_ascent(q, c_vec):
-    """Box-constrained projected gradient ascent on one node's dual
-    (shared by the gathered-rows and precomputed-K solvers)."""
+def _use_fista() -> bool:
+    """Solver policy: DSLIB_CSVM_SOLVER in {auto (default), pg, fista}.
+    'fista' is accelerated projected gradient with adaptive restart —
+    same fixed point, same stopping rule, typically several-fold fewer
+    sequential while_loop steps, which is exactly the latency driver of
+    the cascade on TPU (each step is one small GEMV).  'auto' currently
+    keeps plain PG: flipping the default waits for the on-chip A/B the
+    bench row now emits (the CholeskyQR2 precedent — policy changes ride
+    measurements, not expectations)."""
+    import os
+    v = os.environ.get("DSLIB_CSVM_SOLVER", "auto")
+    if v not in ("auto", "pg", "fista"):
+        raise ValueError(
+            f"DSLIB_CSVM_SOLVER={v!r} — expected auto, pg or fista")
+    return v == "fista"
+
+
+def _dual_ascent(q, c_vec, solver="pg"):
+    """Box-constrained dual maximization on one node (shared by the
+    gathered-rows and precomputed-K solvers).  ``solver``: 'pg' = plain
+    projected gradient ascent; 'fista' = accelerated (Nesterov momentum,
+    gradient-scheme adaptive restart so the momentum can never drive the
+    objective backwards for long).  Identical stopping rule and step cap,
+    so the two differ only in sequential-step count."""
     eta = 1.0 / jnp.maximum(jnp.max(jnp.sum(jnp.abs(q), axis=1)), 1e-12)
-
-    def body(carry):
-        alpha, i, _ = carry
-        grad = 1.0 - q @ alpha
-        new = jnp.clip(alpha + eta * grad, 0.0, c_vec)
-        delta = jnp.max(jnp.abs(new - alpha))
-        return new, i + 1, delta
-
-    def cond(carry):
-        _, i, delta = carry
-        return (i < 500) & (delta > 1e-6)
-
     alpha0 = jnp.zeros_like(c_vec)
-    alpha, _, _ = lax.while_loop(cond, body, (alpha0, jnp.int32(0),
-                                              jnp.float32(jnp.inf)))
+
+    if solver == "fista":
+        def body(carry):
+            alpha, z, t, i, _ = carry
+            grad = 1.0 - q @ z
+            new = jnp.clip(z + eta * grad, 0.0, c_vec)
+            # restart when the update opposes the momentum direction
+            restart = jnp.sum((z - new) * (new - alpha)) > 0.0
+            t_next = jnp.where(
+                restart, 1.0, (1.0 + jnp.sqrt(1.0 + 4.0 * t * t)) / 2.0)
+            beta = jnp.where(restart, 0.0, (t - 1.0) / t_next)
+            z_next = new + beta * (new - alpha)
+            delta = jnp.max(jnp.abs(new - alpha))
+            return new, z_next, t_next, i + 1, delta
+
+        def cond(carry):
+            _, _, _, i, delta = carry
+            return (i < 500) & (delta > 1e-6)
+
+        alpha, _, _, _, _ = lax.while_loop(
+            cond, body, (alpha0, alpha0, jnp.float32(1.0), jnp.int32(0),
+                         jnp.float32(jnp.inf)))
+    else:
+        def body(carry):
+            alpha, i, _ = carry
+            grad = 1.0 - q @ alpha
+            new = jnp.clip(alpha + eta * grad, 0.0, c_vec)
+            delta = jnp.max(jnp.abs(new - alpha))
+            return new, i + 1, delta
+
+        def cond(carry):
+            _, i, delta = carry
+            return (i < 500) & (delta > 1e-6)
+
+        alpha, _, _ = lax.while_loop(cond, body, (alpha0, jnp.int32(0),
+                                                  jnp.float32(jnp.inf)))
     # dual objective on the Q this solve already holds — callers read
     # the top node's value for the convergence check
     obj = jnp.sum(alpha) - 0.5 * alpha @ (q @ alpha)
     return alpha, obj
 
 
-@partial(jax.jit, static_argnames=("n_feat", "kernel"))
+@partial(jax.jit, static_argnames=("n_feat", "kernel", "solver"))
 @precise
-def _solve_level(xv, yv, nodes, c, n_feat, kernel, gamma):
+def _solve_level(xv, yv, nodes, c, n_feat, kernel, gamma, solver):
     """Solve the boxed dual on every node of a cascade level (vmap).  Each
     node's (cap, cap) sub-Gram is built from its gathered rows — the m×m
     Gram is never materialised."""
@@ -493,7 +543,7 @@ def _solve_level(xv, yv, nodes, c, n_feat, kernel, gamma):
         y_sub = yv[safe]
         q = k_sub * (y_sub[:, None] * y_sub[None, :])
         c_vec = jnp.where(valid, c, 0.0)            # padded slots pinned at 0
-        return _dual_ascent(q, c_vec)
+        return _dual_ascent(q, c_vec, solver)
 
     return jax.vmap(solve_one)(nodes)
 
@@ -510,9 +560,9 @@ def _ell_rows_dense(ev, ec, idx, n_feat):
     return jnp.zeros((cap, n_feat), ev.dtype).at[rows, cc].add(v)
 
 
-@partial(jax.jit, static_argnames=("n_feat", "kernel"))
+@partial(jax.jit, static_argnames=("n_feat", "kernel", "solver"))
 @precise
-def _solve_level_ell(ev, ec, yv, nodes, c, n_feat, kernel, gamma):
+def _solve_level_ell(ev, ec, yv, nodes, c, n_feat, kernel, gamma, solver):
     """Boxed-dual solves with device-resident sparse staging: each node
     gathers its rows from the ELL buffers, densifies its (cap, n) block by
     scatter, and computes its (cap, cap) sub-Gram on device — the whole
@@ -527,18 +577,18 @@ def _solve_level_ell(ev, ec, yv, nodes, c, n_feat, kernel, gamma):
         y_sub = yv[safe]
         q = k_sub * (y_sub[:, None] * y_sub[None, :])
         c_vec = jnp.where(valid, c, 0.0)
-        return _dual_ascent(q, c_vec)
+        return _dual_ascent(q, c_vec, solver)
 
     return jax.vmap(solve_one)(nodes)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("solver",))
 @precise
-def _solve_level_k(k_sub, y_sub, c_vec):
+def _solve_level_k(k_sub, y_sub, c_vec, solver):
     """Same dual solves on host-staged kernel blocks (the sparse path)."""
     def solve_one(k1, y1, cv):
         q = (k1 + 1.0) * (y1[:, None] * y1[None, :])
-        return _dual_ascent(q, cv)
+        return _dual_ascent(q, cv, solver)
     return jax.vmap(solve_one)(k_sub, y_sub, c_vec)
 
 
