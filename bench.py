@@ -801,6 +801,74 @@ def bench_dbscan(m, n, tag, proxy_m=None):
             "vs_baseline": round(cpu_wall / t, 2)}
 
 
+def _numpy_daura(x, cutoff, chunk=2048):
+    """Same-algorithm greedy GROMOS clustering: RMSD ε-adjacency
+    (RMSD² = ‖xi − xj‖²/n_atoms, rows are 3·n_atoms coords), then repeat
+    {pick the active frame with the most active neighbors, extract it and
+    its neighbors as one cluster}."""
+    m = x.shape[0]
+    eps2 = cutoff * cutoff * (x.shape[1] // 3)
+    xsq = (x * x).sum(1)
+    adj = np.zeros((m, m), bool)
+    for s in range(0, m, chunk):
+        d = xsq[s:s + chunk, None] - 2.0 * (x[s:s + chunk] @ x.T) + xsq[None]
+        adj[s:s + chunk] = d <= eps2
+    active = np.ones(m, bool)
+    labels = np.full(m, -1, np.int64)
+    cid = 0
+    while active.any():
+        counts = (adj & active[None, :]).sum(1)
+        counts[~active] = -1
+        medoid = int(np.argmax(counts))
+        members = active & adj[medoid]
+        members[medoid] = True
+        labels[members] = cid
+        active &= ~members
+        cid += 1
+    return labels
+
+
+def bench_daura(m, n, tag, proxy_m=None):
+    """Daura (greedy GROMOS) on the tiled tier.  Proxy: same-algorithm
+    NumPy at ``proxy_m`` rows scaled by (m/proxy)² — BOTH phases (ε-pass
+    and per-cluster neighbor recounts) are quadratic.  Gate: identical
+    partition at the proxy shape (well-separated blobs → the greedy order
+    is unambiguous)."""
+    import dislib_tpu as ds
+    from dislib_tpu.cluster import Daura
+
+    proxy_m = proxy_m or m
+    cutoff = 0.3
+    xp_host, _ = _blobs(proxy_m, n, k=12, seed=6, std=0.05)
+    t0 = time.perf_counter()
+    lab_proxy = _numpy_daura(xp_host, cutoff)
+    cpu_wall = (time.perf_counter() - t0) * (m / proxy_m) ** 2
+
+    # the gate must exercise the SAME tier the timed run takes (the
+    # dbscan precedent): full-mode proxy_m sits above daura's dense-max
+    # (16384) so both gate and timed fit stream tiles; smoke stays dense
+    fit_small = Daura(cutoff=cutoff).fit(ds.array(xp_host,
+                                                  block_size=(4096, n)))
+    assert fit_small.labels_.min() >= 0
+    assert _same_partition_on_core(fit_small.labels_, lab_proxy,
+                                   np.ones(proxy_m, bool)), \
+        "daura gate: device partition != numpy greedy proxy"
+
+    x_host, _ = _blobs(m, n, k=12, seed=7, std=0.05)
+    a = ds.array(x_host, block_size=(8192, n))
+    warm = Daura(cutoff=cutoff).fit(a)                  # warmup/compile
+    # sanity on the RESULT being timed, not just the gate shape
+    n_clusters = int(warm.labels_.max()) + 1
+    assert 1 < n_clusters < m // 10, \
+        f"daura full-size result degenerate: {n_clusters} clusters"
+    t = _median_time(lambda: Daura(cutoff=cutoff).fit(a))
+    return {"metric": f"daura_{tag}_wall_s (baseline: numpy same-algorithm "
+                      f"greedy proxy at {proxy_m} rows x (m/proxy)^2)",
+            "value": round(t, 4), "unit": "s",
+            "vs_baseline": round(cpu_wall / t, 2),
+            "n_clusters": n_clusters}
+
+
 def _numpy_hist_tree_level(bx, node, w, y_onehot, n_nodes, n_bins):
     """One level of the same histogram-tree algorithm (gini), NumPy."""
     m, n = bx.shape
@@ -1084,6 +1152,8 @@ def _configs():
             ("gmm_smoke", lambda: bench_gmm(2000, 8, 3, 2)),
             ("dbscan_smoke", lambda: bench_dbscan(3000, 6, "smoke",
                                                   proxy_m=1500)),
+            ("daura_smoke", lambda: bench_daura(2000, 6, "smoke",
+                                                proxy_m=1000)),
             ("forest_smoke", lambda: bench_forest(2000, 8, 4, "smoke",
                                                   depth=5)),
             ("knn_smoke", lambda: bench_knn(4000, 8, 512, 5, "smoke")),
@@ -1124,6 +1194,8 @@ def _configs():
         # throughput, sparse ALS, and the all_to_all shuffle
         ("dbscan_200000x10_wall_s",
          lambda: bench_dbscan(200_000, 10, "200000x10", proxy_m=20_000)),
+        ("daura_50000x15_wall_s",
+         lambda: bench_daura(50_000, 15, "50000x15", proxy_m=20_000)),
         ("forest_100000x20_16t_fit_predict_wall_s",
          lambda: bench_forest(100_000, 20, 16, "100000x20")),
         ("knn_1000000x10_q10000_k10_queries_per_sec",

@@ -45,6 +45,8 @@ ROWS = [
      False),
     ("dbscan_200000x10_wall_s", "DBSCAN (tiled tier)",
      "200k×10, ε-stream + label propagation", False),
+    ("daura_50000x15_wall_s", "Daura (greedy GROMOS, tiled)",
+     "50k×15 (5 atoms), RMSD ε-graph + greedy extraction", False),
     ("forest_100000x20_16t_fit_predict_wall_s", "RandomForest (vmapped)",
      "100k×20, 16 trees, fit+predict", False),
     ("knn_1000000x10_q10000_k10_queries_per_sec", "kNN query throughput",

@@ -34,6 +34,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
         wedged=0
         consec=0
         for row in dbscan_200000x10_wall_s \
+                   daura_50000x15_wall_s \
                    forest_100000x20_16t_fit_predict_wall_s \
                    knn_1000000x10_q10000_k10_queries_per_sec \
                    als_sparse_100000x10000_nnz100_f16_3it_wall_s \
