@@ -161,6 +161,13 @@ def grid_main():
 
     km = _ck_fit(x, xs_host, ck_path)
 
+    # 2-D collective mix: the Gram GEMM partitions over BOTH axes — cols
+    # collectives stay intra-process, the rows reduction crosses all 4
+    gram_trace = float(np.trace(np.asarray(
+        ds.matmul(x, x, transpose_b=True).collect())))
+    assert abs(gram_trace - float((xs_host * xs_host).sum())) \
+        <= 1e-4 * max(1.0, abs(gram_trace)), f"rank {rank}: gram trace"
+
     from dislib_tpu.utils import shuffle
     xsh = np.asarray(shuffle(x, random_state=7).collect())
     # asserted on EVERY rank (nonzero exit), not just recorded by rank 0:
