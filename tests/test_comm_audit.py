@@ -231,11 +231,15 @@ class TestSparseStagingCommAudit:
         yv = jnp.asarray(np.where(rng.rand(m) > 0.5, 1.0, -1.0)
                          .astype(np.float32))
         nodes = jnp.asarray(np.arange(m).reshape(4, m // 4))
-        hlo = _solve_level_ell.lower(ev, ec, yv, nodes, 1.0, n, "rbf",
-                                     1.0 / n).compile().as_text()
-        _assert_no_operand_gather(hlo, m * n)
-        for elems in _collective_sizes(hlo, "all-reduce"):
-            assert elems < m * n
+        # audit BOTH solver policies — the fista trace adds momentum
+        # carries that must stay node-local too
+        for solver in ("pg", "fista"):
+            hlo = _solve_level_ell.lower(ev, ec, yv, nodes, 1.0, n, "rbf",
+                                         1.0 / n, solver) \
+                .compile().as_text()
+            _assert_no_operand_gather(hlo, m * n)
+            for elems in _collective_sizes(hlo, "all-reduce"):
+                assert elems < m * n
 
     def test_sparse_knn_no_query_gather(self, rng):
         """Dense queries over a sparse fit stream: the query operand and
