@@ -59,8 +59,8 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
         grep "^PASS " "$SUITE_LOG" > "$SUITE_LOG.tmp" || true
         mv "$SUITE_LOG.tmp" "$SUITE_LOG"
         timeout -k 30 14400 bash tools/run_tpu_suite.sh "$SUITE_LOG" 1500 \
-            > tools/TPU_SUITE_watch.txt 2>&1
-        log "suite rc=$?"
+            > tools/TPU_SUITE_watch_r05.txt 2>&1
+        log "suite rc=$?"; cp "$SUITE_LOG" tools/tpu_suite_r05_results.log
         DSLIB_TEST_TPU=1 timeout -k 30 1500 python -m pytest \
             "tests/test_math.py::TestCholQR2::test_cholqr_breakdown_band_on_chip" \
             -q > tools/CHOLQR_BAND_r05.txt 2>&1
