@@ -33,15 +33,21 @@ def _cpu_destined() -> bool:
 # stalls (the warn log stays early).  Must be in XLA_FLAGS before the
 # backend initialises, hence at import — and only for cpu-destined
 # processes, so a TPU job's (or an embedding application's) environment
-# is never mutated behind its back.
+# is never mutated behind its back.  The injection itself lives in
+# runtime.xla_flags (the one site allowed to mutate XLA_FLAGS) and is
+# GATED on jaxlib version: builds that predate the flags treat them as
+# fatal unknown flags and abort at first backend init.
+from dislib_tpu.runtime import xla_flags as _xla_flags
+
 if _cpu_destined():
-    for _flag, _default in (
-            ("xla_cpu_collective_call_terminate_timeout_seconds", 600),
-            ("xla_cpu_collective_call_warn_stuck_timeout_seconds", 60)):
-        if _flag not in _os.environ.get("XLA_FLAGS", ""):
-            _os.environ["XLA_FLAGS"] = (
-                _os.environ.get("XLA_FLAGS", "")
-                + f" --{_flag}={_default}").strip()
+    _xla_flags.inject_cpu_collective_timeouts()
+
+# API-drift shims (jax.shard_map alias on older jaxlibs) — a preempted job
+# may resume on a host imaged with a different toolchain, so importability
+# across jax versions is part of the resilience contract
+from dislib_tpu.runtime.compat import ensure_jax_compat as _ensure_jax_compat
+
+_ensure_jax_compat()
 
 from dislib_tpu.parallel.mesh import init, get_mesh, set_mesh
 from dislib_tpu.data.array import (
@@ -57,10 +63,11 @@ from dislib_tpu.decomposition import tsqr, random_svd, lanczos_svd, PCA
 from dislib_tpu.utils.base import shuffle, train_test_split
 from dislib_tpu.utils.saving import save_model, load_model
 
-# subpackages (sklearn-style namespaces, reference parity)
+# subpackages (sklearn-style namespaces, reference parity; `runtime` is
+# the preemption/retry/elastic resilience layer)
 from dislib_tpu import cluster, classification, regression, neighbors, \
     preprocessing, optimization, model_selection, recommendation, \
-    trees  # noqa: E402,F401
+    trees, runtime  # noqa: E402,F401
 
 # estimator classes re-exported at top level so every name in the SURVEY §8
 # parity contract is importable from `dislib_tpu` directly (their canonical
@@ -98,4 +105,5 @@ __all__ = [
     "NearestNeighbors", "LinearRegression", "Lasso", "ADMM", "ALS",
     "StandardScaler", "MinMaxScaler",
     "KFold", "GridSearchCV", "RandomizedSearchCV",
+    "runtime",
 ]

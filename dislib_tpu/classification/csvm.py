@@ -53,6 +53,9 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
+from dislib_tpu.runtime import fetch as _fetch, \
+    preemption_requested as _preemption_requested, \
+    raise_if_preempted as _raise_if_preempted
 
 
 class CascadeSVM(BaseEstimator):
@@ -272,9 +275,20 @@ class CascadeSVM(BaseEstimator):
                         _snap()
                     break
             last_w = w
-            if checkpoint is not None and \
-                    (it - start_it) % checkpoint.every == 0:
-                _snap()
+            if checkpoint is not None:
+                if (it - start_it) % checkpoint.every == 0:
+                    _snap()
+                    if it < self.max_iter:
+                        _raise_if_preempted(checkpoint)
+                elif it < self.max_iter and _preemption_requested():
+                    # preemption notice with iterations left: snapshot
+                    # THIS iteration's state (off the `every` boundary)
+                    # and raise cleanly between cascade iterations, never
+                    # mid-solve — the if/elif keeps a boundary+preempt
+                    # iteration from snapshotting twice and rotating the
+                    # distinct previous generation away
+                    _snap()
+                    _raise_if_preempted(checkpoint)
 
         self.iterations_n = self.n_iter_ = it
         self._sv_idx = sv_idx
@@ -282,13 +296,12 @@ class CascadeSVM(BaseEstimator):
         # CSR on the sparse path, on device for dense inputs
         if sparse_in:
             if ell is not None:
-                self._sv_x = np.asarray(jax.device_get(_ell_rows_dense(
-                    ell[0], ell[1], jnp.asarray(sv_idx), n)))
+                self._sv_x = _fetch(_ell_rows_dense(
+                    ell[0], ell[1], jnp.asarray(sv_idx), n))
             else:
                 self._sv_x = np.asarray(x_csr[sv_idx].toarray(), np.float32)
         else:
-            self._sv_x = np.asarray(jax.device_get(
-                x._data[jnp.asarray(sv_idx), : n]))
+            self._sv_x = _fetch(x._data[jnp.asarray(sv_idx), : n])
         self._sv_y = y_pm[sv_idx]
         self._gamma_fit = gamma
         self.support_vectors_count_ = len(sv_idx)

@@ -32,6 +32,8 @@ from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
 from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.runtime import fetch as _fetch, \
+    raise_if_preempted as _raise_if_preempted
 
 # padded frame counts above this stream the RMSD adjacency in tiles
 # (module-level so tests can force the path)
@@ -145,13 +147,14 @@ class Daura(BaseEstimator):
             active, labels, medoids, cid = extract(active, labels, medoids,
                                                    cid)
             done = not bool(jax.device_get(jnp.any(active)))
-            checkpoint.save({"active": np.asarray(jax.device_get(active)),
-                             "labels": np.asarray(jax.device_get(labels)),
-                             "medoids": np.asarray(jax.device_get(medoids)),
+            checkpoint.save({"active": _fetch(active),
+                             "labels": _fetch(labels),
+                             "medoids": _fetch(medoids),
                              "cid": int(jax.device_get(cid)),
                              "fp": fp, "digest": digest})
             if done:
                 break
+            _raise_if_preempted(checkpoint)
         return labels, medoids
 
 

@@ -48,6 +48,8 @@ from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
 from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.runtime import fetch as _fetch, \
+    raise_if_preempted as _raise_if_preempted
 
 # padded row counts above this stream the adjacency in tiles instead of
 # materialising the m×m matrix (module-level so tests can force the path)
@@ -178,11 +180,11 @@ class DBSCAN(BaseEstimator):
             core, label = setup()
         while True:
             label, changed = propagate(label, core)
-            checkpoint.save({"label": np.asarray(jax.device_get(label)),
-                             "core": np.asarray(jax.device_get(core)),
+            checkpoint.save({"label": _fetch(label), "core": _fetch(core),
                              "fp": fp, "digest": digest})
             if not bool(jax.device_get(changed)):
                 break
+            _raise_if_preempted(checkpoint)
         return finalize(label, core), core
 
 

@@ -28,6 +28,8 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
+from dislib_tpu.runtime import fetch as _fetch, \
+    raise_if_preempted as _raise_if_preempted
 from dislib_tpu.utils.dlog import verbose_logger
 
 _LOG2PI = float(np.log(2.0 * np.pi))
@@ -150,10 +152,12 @@ class GaussianMixture(BaseEstimator):
             overrides = (weights, means, covs)
             if checkpoint is not None:
                 checkpoint.save({
-                    "weights": np.asarray(jax.device_get(weights)),
-                    "means": np.asarray(jax.device_get(means)),
-                    "covariances": np.asarray(jax.device_get(covs)),
+                    "weights": _fetch(weights),
+                    "means": _fetch(means),
+                    "covariances": _fetch(covs),
                     "lower_bound": lb, "n_iter": it, "converged": converged})
+                if not converged and it < self.max_iter:  # work left only
+                    _raise_if_preempted(checkpoint)
             if checkpoint is None:
                 break
         weights, means, covs = overrides

@@ -41,6 +41,8 @@ from dislib_tpu.data.sparse import SparseArray, _spmm
 from dislib_tpu.ops import distances_sq as _distances_sq
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
+from dislib_tpu.runtime import fetch as _fetch, \
+    raise_if_preempted as _raise_if_preempted
 from dislib_tpu.utils.dlog import verbose_logger
 
 
@@ -126,7 +128,11 @@ class KMeans(BaseEstimator):
         """Fit on `x`.  With ``checkpoint=FitCheckpoint(path, every=k)`` the
         device loop runs in k-iteration chunks, snapshotting (centers,
         n_iter) after each; a re-run resumes from the snapshot (SURVEY §6
-        checkpoint/resume — TPU preemption recovery)."""
+        checkpoint/resume — TPU preemption recovery).  Between chunks the
+        loop honours the preemption flag (`dislib_tpu.runtime`): snapshot
+        first, then a clean ``Preempted`` instead of dying mid-collective.
+        Centers are host-side logical state, so a snapshot restores onto a
+        different mesh/device count unchanged (elastic resume)."""
         it = 0
         done = False
         state = checkpoint.load() if checkpoint is not None else None
@@ -165,8 +171,10 @@ class KMeans(BaseEstimator):
             log.info("iter %d: inertia=%.6g shift=%.3g", it,
                      float(inertia), float(shift))
             if checkpoint is not None:
-                checkpoint.save({"centers": np.asarray(jax.device_get(centers)),
+                checkpoint.save({"centers": _fetch(centers),
                                  "n_iter": it, "converged": done})
+                if not done and it < self.max_iter:  # work left: allow a
+                    _raise_if_preempted(checkpoint)  # clean preempt here
             if checkpoint is None:
                 break
         self.centers_ = np.asarray(jax.device_get(centers))

@@ -18,6 +18,7 @@ inside tasks.
 
 from __future__ import annotations
 
+import functools
 import io as _io
 import os
 
@@ -26,6 +27,25 @@ import numpy as np
 from dislib_tpu.data.array import (Array as _Array, array as _ds_array,
                                    _padded_shape)
 from dislib_tpu.parallel import mesh as _mesh
+
+
+def _retrying_loader(fn):
+    """Retry a whole loader under the env-tunable transient-failure policy
+    (``dislib_tpu.runtime.Retry``): a flaky shared filesystem (EIO,
+    connection reset, stale NFS handle) re-reads; parse errors and missing
+    files classify fatal and raise immediately.  Loaders are pure (parse →
+    device_put), so a re-run is safe.  Multi-process jobs run a SINGLE
+    attempt: the sharded ingest paths contain collectives, and one host
+    retrying alone would desync the job — resubmit the whole job instead."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        import jax
+        if jax.process_count() > 1:
+            return fn(*args, **kwargs)
+        from dislib_tpu.runtime import Retry
+        return Retry.from_env(attempts=3, backoff=0.25).call(
+            fn, *args, **kwargs)
+    return wrapped
 
 
 def _native_parse(parser_name, path):
@@ -152,6 +172,7 @@ def _from_local_rows(local, lo, shape, block_size, dtype):
     return _Array(garr, (m, n), reg_shape=block_size)
 
 
+@_retrying_loader
 def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32):
     """Load a delimited text file into a ds-array (reference: load_txt_file).
 
@@ -198,6 +219,7 @@ def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32):
     return _from_local_rows(local, rlo, (m, n), block_size, dtype)
 
 
+@_retrying_loader
 def load_npy_file(path, block_size=None, dtype=None):
     """Load a .npy file into a ds-array (reference: load_npy_file).
 
@@ -292,6 +314,7 @@ def _load_svmlight_sharded(path, block_size, n_features):
     return x, y
 
 
+@_retrying_loader
 def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True):
     """Load a svmlight/libsvm file -> (x, y) ds-arrays (reference parity).
 
@@ -339,6 +362,7 @@ def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True
     return x, y
 
 
+@_retrying_loader
 def load_mdcrd_file(path, block_size=None, n_atoms=None, copy_first=False):
     """Load an AMBER .mdcrd trajectory: one row per frame, 3*n_atoms coords
     (reference: load_mdcrd_file for the Daura/MD pipeline)."""

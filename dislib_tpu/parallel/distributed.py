@@ -58,10 +58,18 @@ def initialize(coordinator_address: str | None = None,
         process_id = int(os.environ["DSLIB_PROC_ID"])
     if coordinator_address is None and num_processes is None:
         return  # single-process job: nothing to join
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id,
-                               local_device_ids=local_device_ids)
+    # the coordinator races worker bring-up (head pod scheduled last, DNS
+    # not yet propagated, ...) — joining is the textbook transient failure,
+    # so the gRPC connect retries under the env-tunable Retry policy
+    # (DSLIB_RETRY_* overrides); config errors classify fatal and raise
+    # immediately (SURVEY §6 failure-detection row)
+    from dislib_tpu.runtime import Retry
+    Retry.from_env(attempts=5, backoff=1.0, max_backoff=15.0).call(
+        jax.distributed.initialize,
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
     _initialized = True
 
 

@@ -42,6 +42,8 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
+from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows, \
+    raise_if_preempted as _raise_if_preempted
 from dislib_tpu.utils.dlog import verbose_logger
 
 
@@ -90,7 +92,12 @@ class ALS(BaseEstimator):
         the training ratings, as in the reference.
         ``checkpoint`` — optional ``FitCheckpoint``: run in `every`-iteration
         chunks, snapshot (users, items, rmse, n_iter) after each, resume from
-        the snapshot on re-run (SURVEY §6 checkpoint/resume).
+        the snapshot on re-run (SURVEY §6 checkpoint/resume).  Between
+        chunks the loop honours the preemption flag (`dislib_tpu.runtime`):
+        snapshot first, then a clean ``Preempted``.  Snapshots record the
+        LOGICAL factor dims, so a checkpoint written on one mesh resumes on
+        a different device count (the factors are re-padded on restore —
+        elastic resume).
         """
         if self.max_iter < 1:
             raise ValueError("max_iter must be >= 1")
@@ -122,14 +129,25 @@ class ALS(BaseEstimator):
         if checkpoint is not None:
             snap = checkpoint.load()
             if snap is not None:
-                want = ((x.shape[0] if sparse_in else x._data.shape[0]),
-                        int(self.n_f))
-                if snap["users"].shape != want:
+                # snapshots carry the LOGICAL factor dims (m, n); the stored
+                # factor arrays may be padded for a different mesh — elastic
+                # resume re-pads them for this mesh (runtime.repad_rows)
+                if "m" not in snap or "users" not in snap:
                     raise ValueError(
-                        f"checkpoint users shape {snap['users'].shape} does "
-                        f"not match this estimator/data {want} — stale or "
-                        "foreign snapshot")
-                state = (jnp.asarray(snap["users"]), jnp.asarray(snap["items"]),
+                        "checkpoint is missing the ALS factor state — stale "
+                        "or foreign snapshot")
+                sm, sn = int(snap["m"]), int(snap["n"])
+                if (sm, sn) != tuple(x.shape) or \
+                        snap["users"].shape[1:] != (int(self.n_f),):
+                    raise ValueError(
+                        f"checkpoint factors (users {snap['users'].shape} "
+                        f"over ratings {(sm, sn)}) do not match this "
+                        f"estimator/data (ratings {tuple(x.shape)}, "
+                        f"n_f={self.n_f}) — stale or foreign snapshot")
+                tu = x.shape[0] if sparse_in else x._data.shape[0]
+                tv = x.shape[1] if sparse_in else x._data.shape[1]
+                state = (jnp.asarray(_repad_rows(snap["users"], sm, tu)),
+                         jnp.asarray(_repad_rows(snap["items"], sn, tv)),
                          float(snap["rmse"]))
                 rmse = float(snap["rmse"])
                 it = int(snap["n_iter"])
@@ -158,10 +176,12 @@ class ALS(BaseEstimator):
             log.info("iter %d: rmse=%.6g", it, rmse)
             state = (u, v, rmse)
             if checkpoint is not None:
-                checkpoint.save({"users": np.asarray(jax.device_get(u)),
-                                 "items": np.asarray(jax.device_get(v)),
+                checkpoint.save({"users": _fetch(u), "items": _fetch(v),
+                                 "m": x.shape[0], "n": x.shape[1],
                                  "rmse": rmse, "n_iter": it,
                                  "converged": conv})
+                if not conv and it < self.max_iter:  # work left only
+                    _raise_if_preempted(checkpoint)
             if checkpoint is None:
                 break
         u, v, _ = state

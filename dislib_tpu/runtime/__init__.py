@@ -1,0 +1,38 @@
+"""dislib_tpu.runtime — the preemption-safe elastic runtime layer.
+
+The reference's fault tolerance is runtime-level (COMPSs resubmits failed
+tasks); on TPU a preemption or chip failure kills the whole SPMD job, so
+the survival story is built from four pieces that compose (SURVEY §6
+"Failure detection / elastic recovery"):
+
+- **preemption** — SIGTERM/sentinel-file watcher + the
+  :class:`Preempted` contract checkpointed fits honour at chunk
+  boundaries (``preemption.py``);
+- **retry** — transient-vs-fatal classified retries with backoff for the
+  coordinator join, ingest IO, and host↔device transfers (``retry.py``);
+- **elastic** — restore snapshots onto a different device count/mesh
+  shape by re-padding host-side logical state (``elastic.py``);
+- **xla_flags** — the single guarded site allowed to mutate ``XLA_FLAGS``
+  (version-gated XLA:CPU collective-timeout mitigation; ``xla_flags.py``).
+
+Crash-consistent rotating snapshots live with the checkpoint format in
+``dislib_tpu.utils.checkpoint``; the deterministic fault-injection harness
+driving ``tests/test_resilience.py`` is ``dislib_tpu.utils.faults``.
+"""
+
+from dislib_tpu.runtime import xla_flags  # noqa: F401
+from dislib_tpu.runtime.elastic import fetch, repad_rows
+from dislib_tpu.runtime.preemption import (
+    Preempted, PreemptionWatcher, clear_preemption, last_signal,
+    preemption_requested, raise_if_preempted, request_preemption,
+)
+from dislib_tpu.runtime.retry import Retry, is_transient_error, retry_call
+
+__all__ = [
+    "Preempted", "PreemptionWatcher", "preemption_requested",
+    "request_preemption", "clear_preemption", "last_signal",
+    "raise_if_preempted",
+    "Retry", "retry_call", "is_transient_error",
+    "repad_rows", "fetch",
+    "xla_flags",
+]

@@ -1,0 +1,63 @@
+"""Environment-drift shims — keep the library importable and runnable
+across the jax versions the fleet actually carries.
+
+A resilience layer that only works on one exact jax build defeats its own
+purpose: a preempted job frequently restarts on a machine imaged with a
+different toolchain.  The one shim currently needed: ``jax.shard_map``
+graduated from ``jax.experimental.shard_map`` (and its replication-check
+kwarg was renamed ``check_rep`` → ``check_vma``) — on older jaxlibs the
+top-level name is missing and every shard_map call site would die with
+``AttributeError``.  :func:`ensure_jax_compat` installs a translating
+alias ONLY when the top-level name is absent; on current jax it touches
+nothing.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ensure_jax_compat"]
+
+
+def ensure_jax_compat() -> None:
+    """Install missing-API aliases on the imported ``jax`` module.
+    Idempotent; a no-op on jax versions that already export the names."""
+    import jax
+
+    try:
+        has_shard_map = hasattr(jax, "shard_map")
+    except Exception:  # noqa: BLE001 — deprecation getattr can raise
+        has_shard_map = False
+    if not has_shard_map:
+        from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+        def _shard_map(f, /, *args, **kwargs):
+            # check_vma's predecessor (check_rep) cannot express these
+            # programs — it has no replication rule for while_loop, which
+            # every convergence kernel here carries — so the replication
+            # SANITIZER is off on legacy jax; current jax still runs it
+            # (this shim only installs when jax.shard_map is absent)
+            kwargs.pop("check_vma", None)
+            kwargs["check_rep"] = False
+            return _legacy_shard_map(f, *args, **kwargs)
+
+        jax.shard_map = _shard_map
+
+    # lax.pcast belongs to the same varying-axes (vma) machinery: on new
+    # jax it marks a replicated value as varying for the replication
+    # checker; computationally it is the identity.  Old shard_map's
+    # check_rep tracks replication without explicit casts, so identity is
+    # the faithful translation.
+    from jax import lax
+    if not hasattr(lax, "pcast"):
+        def _pcast(x, axes, to=None):  # noqa: ARG001 — checker-only args
+            return x
+        lax.pcast = _pcast
+
+    # jax.enable_x64 (context-manager form) graduated from
+    # jax.experimental.enable_x64 — alias it where missing
+    try:
+        has_x64 = hasattr(jax, "enable_x64")
+    except Exception:  # noqa: BLE001 — deprecation getattr can raise
+        has_x64 = False
+    if not has_x64:
+        from jax.experimental import enable_x64 as _enable_x64
+        jax.enable_x64 = _enable_x64

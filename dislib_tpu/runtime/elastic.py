@@ -1,0 +1,58 @@
+"""Elastic-resume helpers: restore a snapshot onto a DIFFERENT mesh.
+
+Snapshots keep recovery state as host-side logical arrays (the host/device
+split of arXiv:2112.09017): small replicated results (centers, mixture
+parameters, SV sets) are mesh-independent as stored, and the only
+mesh-dependent artifact is the pad width of row-padded state (ds-arrays
+pad every dimension to the mesh quantum).  Resharding on restore
+(arXiv:2112.01075 discipline) therefore reduces to :func:`repad_rows` —
+crop the writing mesh's pad rows (zero by the pad-and-mask invariant) and
+zero-fill to the restoring mesh's quantum — after which the normal
+``device_put`` of the fit path lays the state out for the new topology.
+An 8-device snapshot restores onto a 4-device or 2-D mesh this way.
+
+:func:`fetch` is the host↔device transfer boundary with the
+transient-failure :class:`~dislib_tpu.runtime.retry.Retry` policy applied
+— the read every snapshot goes through.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["repad_rows", "fetch"]
+
+
+def repad_rows(a, logical: int, target: int, axis: int = 0):
+    """Re-pad snapshot state along ``axis`` for the restoring mesh: keep
+    the first ``logical`` (real) slices, zero-fill out to ``target`` (the
+    restoring mesh's padded extent).  Exact because pad slices carry zeros
+    under the pad-and-mask invariant.  Raises when the snapshot holds
+    fewer than ``logical`` slices (foreign/stale state)."""
+    a = np.asarray(a)
+    if a.shape[axis] < logical:
+        raise ValueError(
+            f"snapshot state has {a.shape[axis]} rows along axis {axis} but "
+            f"the logical state needs {logical} — stale or foreign snapshot")
+    if target < logical:
+        raise ValueError(
+            f"target padded extent {target} is smaller than the logical "
+            f"extent {logical}")
+    sl = [slice(None)] * a.ndim
+    sl[axis] = slice(0, logical)
+    a = a[tuple(sl)]
+    if target == logical:
+        return a
+    pad = [(0, 0)] * a.ndim
+    pad[axis] = (0, target - logical)
+    return np.pad(a, pad)
+
+
+def fetch(x) -> np.ndarray:
+    """Device→host read (``jax.device_get`` → ndarray) with transient
+    failures retried under the env-tunable default policy — the snapshot
+    write path's half of the host↔device boundary."""
+    import jax
+
+    from dislib_tpu.runtime.retry import Retry
+    return Retry.from_env().call(lambda: np.asarray(jax.device_get(x)))

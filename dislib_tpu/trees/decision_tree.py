@@ -39,6 +39,9 @@ from jax import lax
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows, \
+    preemption_requested as _preemption_requested, \
+    raise_if_preempted as _raise_if_preempted
 
 # Discretisation contract (documented divergence from the reference, which
 # delegates subtrees to exact sklearn trees with arbitrary thresholds):
@@ -296,8 +299,12 @@ class _BaseTreeEnsemble(BaseEstimator):
         k_boot, key = jax.random.split(key)
         if snap is not None:
             start_lvl = int(snap["lvl"])
-            node = jnp.asarray(snap["node"])
-            w = jnp.asarray(snap["w"])
+            # node assignment and bootstrap weights are per-(padded-)sample:
+            # re-pad them for THIS mesh's quantum so an 8-device snapshot
+            # resumes on a 4-device (or 2-D) mesh — pad columns carry w=0,
+            # so zero-filling them is exact (elastic resume)
+            node = jnp.asarray(_repad_rows(snap["node"], m, mp, axis=1))
+            w = jnp.asarray(_repad_rows(snap["w"], m, mp, axis=1))
             feats = [jnp.asarray(snap[f"feats_{i}"]) for i in range(start_lvl)]
             tbins = [jnp.asarray(snap[f"tbins_{i}"]) for i in range(start_lvl)]
             for _ in range(start_lvl):       # replay the key chain
@@ -316,6 +323,14 @@ class _BaseTreeEnsemble(BaseEstimator):
         stats = jnp.asarray(stats_host)               # (mp, S)
         try_features = self._try_features_count(n)
 
+        def _snap(lvl_next):
+            state = {"lvl": lvl_next, "seed": seed, "fp": fp,
+                     "digest": digest, "node": _fetch(node), "w": _fetch(w)}
+            for i, (f_, t_) in enumerate(zip(feats, tbins)):
+                state[f"feats_{i}"] = _fetch(f_)
+                state[f"tbins_{i}"] = _fetch(t_)
+            checkpoint.save(state)
+
         for lvl in range(start_lvl, depth):
             key, k_lvl = jax.random.split(key)
             keys = jax.random.split(k_lvl, n_trees)
@@ -324,16 +339,16 @@ class _BaseTreeEnsemble(BaseEstimator):
                 0.0, self._criterion, n_bins)
             feats.append(feat)
             tbins.append(tbin)
-            if checkpoint is not None and (lvl + 1 - start_lvl) \
-                    % checkpoint.every == 0 and lvl + 1 < depth:
-                state = {"lvl": lvl + 1, "seed": seed, "fp": fp,
-                         "digest": digest,
-                         "node": np.asarray(jax.device_get(node)),
-                         "w": np.asarray(jax.device_get(w))}
-                for i, (f_, t_) in enumerate(zip(feats, tbins)):
-                    state[f"feats_{i}"] = np.asarray(jax.device_get(f_))
-                    state[f"tbins_{i}"] = np.asarray(jax.device_get(t_))
-                checkpoint.save(state)
+            if checkpoint is not None and lvl + 1 < depth:
+                if (lvl + 1 - start_lvl) % checkpoint.every == 0:
+                    _snap(lvl + 1)
+                    _raise_if_preempted(checkpoint)
+                elif _preemption_requested():
+                    # preemption notice between levels: snapshot NOW (off
+                    # the `every` boundary) and raise cleanly — a level
+                    # boundary is always a resumable point
+                    _snap(lvl + 1)
+                    _raise_if_preempted(checkpoint)
 
         leaves = _leaf_stats(node, w, stats, 2 ** depth)
         # feats/tbins stay as the ragged per-level device arrays: packing

@@ -14,13 +14,85 @@ fit (deterministic iterations) — asserted by the kill+resume fault-injection
 test (`tests/test_checkpoint.py`).
 
 Format: ``.npz`` written atomically (tmp file + rename), no pickle.
+Crash consistency (round-6 robustness PR): every snapshot embeds a
+checksum over its arrays, the last ``keep`` generations rotate
+(``path`` newest, ``path.1`` previous, ...), and ``load()`` detects a
+truncated/corrupt/foreign file and falls back to the newest good
+generation instead of surfacing an opaque zipfile error — a kill
+mid-write (or mid-rotation) never costs more than one generation.
 """
 
 from __future__ import annotations
 
 import os
+import warnings
+import zipfile
+import zlib
 
 import numpy as np
+
+# npz entry holding the CRC-32 of every other entry; reserved key
+_CRC_KEY = "_dslib_crc32"
+
+
+class SnapshotCorrupt(ValueError):
+    """A snapshot file that cannot be trusted: truncated/corrupt ``.npz``,
+    checksum mismatch (bit corruption), or a foreign ``.npz`` with no
+    integrity record."""
+
+
+def _state_crc(arrs: dict) -> int:
+    """CRC-32 over every entry's name, dtype, shape, and raw bytes, in
+    key order — what `save` embeds and `load` verifies."""
+    crc = 0
+    for k in sorted(arrs):
+        if k == _CRC_KEY:
+            continue
+        a = np.ascontiguousarray(arrs[k])
+        for piece in (k.encode(), a.dtype.str.encode(),
+                      np.asarray(a.shape, np.int64).tobytes()):
+            crc = zlib.crc32(piece, crc)
+        try:
+            # zlib takes the array's buffer directly — no tobytes() copy
+            # of what may be a multi-GB factor matrix
+            crc = zlib.crc32(a, crc)
+        except (TypeError, ValueError, BufferError):
+            crc = zlib.crc32(a.tobytes(), crc)  # exotic dtypes
+    return crc & 0xFFFFFFFF
+
+
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync so a rename survives power loss."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _load_verified(path: str) -> dict:
+    """Read one generation, verifying npz integrity AND the embedded
+    checksum; raises :class:`SnapshotCorrupt` on any damage."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            state = {k: z[k] for k in z.files}
+    except (zipfile.BadZipFile, EOFError, OSError, ValueError, KeyError) as e:
+        raise SnapshotCorrupt(
+            f"snapshot {path} is truncated or corrupt ({e})") from e
+    crc = state.pop(_CRC_KEY, None)
+    if crc is None:
+        raise SnapshotCorrupt(
+            f"snapshot {path} has no integrity record — foreign .npz or "
+            "written by a pre-rotation library version")
+    if int(np.asarray(crc).ravel()[0]) != _state_crc(state):
+        raise SnapshotCorrupt(
+            f"snapshot {path} failed its checksum — bit corruption on disk")
+    return state
 
 
 class FitCheckpoint:
@@ -28,47 +100,107 @@ class FitCheckpoint:
 
     Parameters
     ----------
-    path : str — target ``.npz`` file.
+    path : str — target ``.npz`` file (newest generation; older ones
+        rotate to ``path.1``, ``path.2``, ...).
     every : int, default 10 — checkpoint every `every` iterations.
+    keep : int, default 2 — generations retained; ``load()`` falls back
+        to the newest generation that verifies.
     """
 
-    def __init__(self, path: str, every: int = 10):
+    def __init__(self, path: str, every: int = 10, keep: int = 2):
         self.path = str(path)
         self.every = int(every)
+        self.keep = int(keep)
         if self.every < 1:
             raise ValueError("every must be >= 1")
+        if self.keep < 1:
+            raise ValueError("keep must be >= 1")
+
+    def _gen_path(self, i: int) -> str:
+        return self.path if i == 0 else f"{self.path}.{i}"
 
     def save(self, state: dict) -> None:
-        """Atomically persist a dict of ndarrays/scalars.
+        """Atomically persist a dict of ndarrays/scalars, embedding a
+        checksum and rotating the previous generations.
 
         A unique tmp file (mkstemp) in the target directory keeps concurrent
         fits sharing a path from clobbering each other's staging file, and
         the fsync-before-replace ensures the rename never lands ahead of the
-        data on power loss."""
+        data on power loss.  Rotation shifts oldest-first, so a crash
+        between renames leaves every file a complete snapshot of SOME
+        generation — `load()` takes the newest that verifies."""
         import tempfile
+        arrs = {k: np.asarray(v) for k, v in state.items()}
+        if _CRC_KEY in arrs:
+            raise ValueError(f"{_CRC_KEY!r} is a reserved snapshot key")
+        arrs[_CRC_KEY] = np.asarray([_state_crc(arrs)], np.uint32)
         d = os.path.dirname(os.path.abspath(self.path)) or "."
         fd, tmp = tempfile.mkstemp(suffix=".npz", dir=d)
         try:
             with os.fdopen(fd, "wb") as f:
-                np.savez(f, **state)
+                np.savez(f, **arrs)
                 f.flush()
                 os.fsync(f.fileno())
+            for i in range(self.keep - 1, 0, -1):
+                src = self._gen_path(i - 1)
+                if os.path.exists(src):
+                    os.replace(src, self._gen_path(i))
             os.replace(tmp, self.path)
+            _fsync_dir(d)
         except BaseException:
             if os.path.exists(tmp):
                 os.remove(tmp)
             raise
 
     def load(self) -> dict | None:
-        """Return the saved state, or None if no checkpoint exists."""
-        if not os.path.exists(self.path):
+        """Return the newest snapshot generation that verifies, or None if
+        no generation exists at all.  A corrupt/truncated/foreign newest
+        file falls back (with a warning) to the previous generation;
+        :class:`SnapshotCorrupt` raises only when EVERY generation on disk
+        is damaged."""
+        seen = 0
+        first_err: SnapshotCorrupt | None = None
+        bad: list[str] = []
+        for i in range(self.keep):
+            p = self._gen_path(i)
+            if not os.path.exists(p):
+                continue
+            seen += 1
+            try:
+                state = _load_verified(p)
+            except SnapshotCorrupt as e:
+                if first_err is None:
+                    first_err = e
+                bad.append(p)
+                continue
+            if first_err is not None:
+                warnings.warn(
+                    f"checkpoint {self.path}: newest snapshot unusable "
+                    f"({first_err}); falling back to generation {i}",
+                    RuntimeWarning, stacklevel=2)
+                # drop the corrupt newer generation(s) NOW: otherwise the
+                # next save() would rotate a known-corrupt file over this
+                # good one, and a crash mid-save would then leave nothing
+                # usable — exactly the >1-generation loss save() promises
+                # never to cause
+                for b in bad:
+                    try:
+                        os.remove(b)
+                    except OSError:
+                        pass
+            return state
+        if seen == 0:
             return None
-        with np.load(self.path, allow_pickle=False) as z:
-            return {k: z[k] for k in z.files}
+        raise SnapshotCorrupt(
+            f"checkpoint {self.path}: all {seen} snapshot generation(s) are "
+            "corrupt, truncated, or foreign — delete the file(s) to restart "
+            "the fit from scratch") from first_err
 
     def delete(self) -> None:
-        if os.path.exists(self.path):
-            os.remove(self.path)
+        for i in range(self.keep):
+            p = self._gen_path(i)
+            if os.path.exists(p):
+                os.remove(p)
 
 
 def data_digest(xp, stats=None):

@@ -1,0 +1,132 @@
+"""Deterministic fault-injection harness (SURVEY §6 — the kill+resume /
+chaos-drill side of "Failure detection / elastic recovery").
+
+Everything here is schedule-driven: faults fire at an exact save count, an
+exact byte position, or an exact call count — never on a timer or an RNG —
+so ``tests/test_resilience.py`` reproduces bit-identically on any rig.
+Three fault families:
+
+- **kill-at-iteration-k** — :class:`CallbackCheckpoint` /
+  :class:`SigtermAtNthSave` fire right AFTER the n-th snapshot reaches
+  disk, the state a preempted job leaves behind;
+- **snapshot damage** — :func:`corrupt_snapshot` flips a byte, truncates,
+  or replaces a snapshot with a foreign ``.npz``;
+- **flaky IO / RPC** — :class:`FlakyCall` and :class:`FlakyOpen` fail the
+  first n invocations with a transient error, exercising the
+  :class:`~dislib_tpu.runtime.retry.Retry` policy.
+"""
+
+from __future__ import annotations
+
+import builtins
+import os
+import signal as _signal
+
+import numpy as np
+
+from dislib_tpu.utils.checkpoint import FitCheckpoint
+
+__all__ = ["CallbackCheckpoint", "SigtermAtNthSave", "sigterm_self",
+           "corrupt_snapshot", "FlakyCall", "FlakyOpen"]
+
+
+class CallbackCheckpoint(FitCheckpoint):
+    """Runs ``callback()`` right AFTER the ``after``-th successful save —
+    the snapshot is on disk when the fault fires, exactly the state a
+    preempted/killed job leaves behind."""
+
+    def __init__(self, path, every: int = 1, after: int = 1, callback=None,
+                 keep: int = 2):
+        super().__init__(path, every=every, keep=keep)
+        self._left = int(after)
+        self._callback = callback
+
+    def save(self, state):
+        super().save(state)
+        self._left -= 1
+        if self._left == 0 and self._callback is not None:
+            self._callback()
+
+
+def sigterm_self() -> None:
+    """Deliver SIGTERM to this process — the real preemption notice."""
+    os.kill(os.getpid(), _signal.SIGTERM)
+
+
+class SigtermAtNthSave(CallbackCheckpoint):
+    """SIGTERM lands right after the n-th snapshot: with a
+    :class:`~dislib_tpu.runtime.preemption.PreemptionWatcher` installed the
+    fit raises ``Preempted`` at the NEXT chunk boundary."""
+
+    def __init__(self, path, every: int = 1, after: int = 1, keep: int = 2):
+        super().__init__(path, every=every, after=after,
+                         callback=sigterm_self, keep=keep)
+
+
+def corrupt_snapshot(path, mode: str = "flip", position: int | None = None):
+    """Deterministically damage a snapshot file in place.
+
+    - ``"flip"`` — XOR one byte (the middle one unless ``position``);
+    - ``"truncate"`` — keep only the first half of the file;
+    - ``"foreign"`` — replace with a plain ``np.savez`` carrying no
+      integrity record (a non-dislib ``.npz``).
+    """
+    path = str(path)
+    if mode == "foreign":
+        np.savez(path, junk=np.arange(3))
+        return
+    with open(path, "rb") as f:
+        data = bytearray(f.read())
+    if mode == "truncate":
+        data = data[: max(1, len(data) // 2)]
+    elif mode == "flip":
+        pos = len(data) // 2 if position is None else int(position)
+        data[pos] ^= 0xFF
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+
+
+class FlakyCall:
+    """Wraps a callable: the first ``failures`` invocations raise a
+    transient error (``exc_factory()``), later ones delegate.  ``calls``
+    counts every invocation — assert on it to pin the retry schedule."""
+
+    def __init__(self, fn, failures: int = 1, exc_factory=None):
+        self.fn = fn
+        self.failures = int(failures)
+        self.calls = 0
+        self.exc_factory = exc_factory or (
+            lambda: ConnectionResetError("injected transient failure"))
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc_factory()
+        return self.fn(*args, **kwargs)
+
+
+class FlakyOpen:
+    """``builtins.open`` stand-in that fails the first ``failures`` opens
+    of one specific ``path`` with a transient ``OSError`` (EIO) — flaky
+    shared-filesystem injection for the ingest retry path.  Install with
+    ``monkeypatch.setattr(builtins, "open", FlakyOpen(path, 2))``."""
+
+    def __init__(self, path, failures: int = 1, exc_factory=None):
+        self._path = os.path.abspath(str(path))
+        self._real = builtins.open
+        self.failures = int(failures)
+        self.fails = 0
+        self.exc_factory = exc_factory or (
+            lambda: OSError(5, "injected flaky read"))  # errno 5 = EIO
+
+    def __call__(self, file, *args, **kwargs):
+        try:
+            same = os.path.abspath(os.fspath(file)) == self._path
+        except TypeError:
+            same = False  # fd-based open: never injected
+        if same and self.fails < self.failures:
+            self.fails += 1
+            raise self.exc_factory()
+        return self._real(file, *args, **kwargs)

@@ -6,6 +6,11 @@ LEVEL, tiled DBSCAN/Daura snapshot per propagation-round/extraction chunk.
 A killed job re-run with the same checkpoint resumes where it died and
 lands on the uninterrupted run's model.
 
+The last leg demos the preemption-safe runtime: a SIGTERM mid-fit makes
+the chunked loop snapshot and raise a clean ``Preempted`` (instead of
+dying mid-collective), and the resume works even on a different mesh
+shape (elastic resume).
+
 Run anywhere: `python examples/fault_tolerant_fits.py` (real TPU under
 the default env; CPU with JAX_PLATFORMS=cpu).
 """
@@ -58,3 +63,29 @@ try:
         x, checkpoint=FitCheckpoint(path, every=1))
 except ValueError as e:
     print("stale checkpoint refused:", str(e)[:60], "...")
+
+# --- Preemption-safe drain: SIGTERM → snapshot → clean Preempted --------
+from dislib_tpu.runtime import Preempted, PreemptionWatcher, \
+    clear_preemption  # noqa: E402
+from dislib_tpu.utils.faults import SigtermAtNthSave  # noqa: E402
+
+path = os.path.join(workdir, "km_preempt.npz")
+with PreemptionWatcher():                    # SIGTERM sets the drain flag
+    try:
+        # the harness delivers a real SIGTERM right after snapshot #1;
+        # the fit notices at the next chunk boundary, snapshots, raises
+        KMeans(n_clusters=3, init=init, max_iter=12, tol=0.0).fit(
+            x, checkpoint=SigtermAtNthSave(path, every=2, after=1))
+    except Preempted as p:
+        print("preempted cleanly; snapshot at", p.checkpoint_path)
+clear_preemption()                           # this process carries on
+
+# elastic resume: the snapshot restores onto a DIFFERENT mesh shape —
+# here the library default mesh re-initialised fresh; on a real fleet
+# the replacement job may have half the devices
+ds.init()
+x2 = ds.array(xh[perm])
+km2 = KMeans(n_clusters=3, init=init, max_iter=12, tol=0.0).fit(
+    x2, checkpoint=FitCheckpoint(path, every=2))
+print("elastic resume finished at iter", km2.n_iter_,
+      "inertia", round(km2.inertia_, 2))

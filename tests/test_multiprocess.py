@@ -16,9 +16,24 @@ import sys
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.skipif(
-    os.environ.get("DSLIB_TEST_TPU") == "1",
-    reason="multi-process CPU rig only")
+def _mp_cpu_supported():
+    """Cross-process collectives on the CPU backend: older jaxlibs raise
+    'Multiprocess computations aren't implemented on the CPU backend', so
+    the gloo rig is version-gated (DSLIB_FORCE_MP_TESTS=1 overrides)."""
+    if os.environ.get("DSLIB_FORCE_MP_TESTS") == "1":
+        return True
+    from dislib_tpu.runtime.xla_flags import _jaxlib_version
+    v = _jaxlib_version()
+    return v is not None and v >= (0, 6, 0)
+
+
+pytestmark = [
+    pytest.mark.skipif(os.environ.get("DSLIB_TEST_TPU") == "1",
+                       reason="multi-process CPU rig only"),
+    pytest.mark.skipif(not _mp_cpu_supported(),
+                       reason="this jaxlib's CPU backend lacks "
+                              "multiprocess collectives"),
+]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 

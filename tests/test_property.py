@@ -7,9 +7,13 @@ against the NumPy oracle.  Deadlines are disabled (first jit trace of a new
 shape dominates wall time)."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
 
-import dislib_tpu as ds
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tier needs the hypothesis package")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+import dislib_tpu as ds  # noqa: E402
 
 # On the real chip every example pays the ~69 ms tunnel dispatch RTT, so
 # 25 examples x ~10 dispatches x 9 properties blows the suite-runner's

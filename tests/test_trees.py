@@ -171,9 +171,17 @@ class TestNBinsContract:
         rf = RandomForestClassifier(n_estimators=2, random_state=0)
         rf.fit(ds.array(x), ds.array(y[:, None]),
                checkpoint=FitCheckpoint(path, every=1))
+        from dislib_tpu.utils import checkpoint as ckm
         snap = dict(np.load(path, allow_pickle=False))
+        snap.pop(ckm._CRC_KEY)
         snap["fp"] = snap["fp"][:-1]            # simulate the old 8-knob fp
-        np.savez(path, **snap)
+        # rewrite through save() so the integrity checksum matches the
+        # tampered payload — otherwise load() classifies it corrupt and
+        # falls back to the rotated previous generation instead of
+        # reaching the fp version check
+        ck = FitCheckpoint(path, every=1)
+        ck.delete()                             # drop rotated generations
+        ck.save(snap)
         with pytest.raises(ValueError, match="different library version"):
             RandomForestClassifier(n_estimators=2, random_state=0).fit(
                 ds.array(x), ds.array(y[:, None]),

@@ -1,0 +1,143 @@
+"""XLA_FLAGS hygiene lint + the version gate for the collective-timeout
+flags (round-6 satellite: the class of bug where an unsupported flag is
+injected at import — XLA fatally aborts on unknown flags — must not
+recur).
+
+Policy, enforced by scanning the repo's Python sources:
+
+1. the XLA:CPU collective-timeout flag NAMES may be spelled only in
+   ``dislib_tpu/runtime/xla_flags.py`` (the one guarded, version-gated
+   injection site) — nowhere else, so nothing can reintroduce an
+   unguarded injection;
+2. ``os.environ["XLA_FLAGS"]`` mutation is allowed only in that module
+   plus a short allowlist of test/example bootstrap sites, and those
+   sites may set only the universally-supported device-count flag.
+"""
+
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the one module allowed to spell the timeout flag names
+GUARDED_SITE = "dislib_tpu/runtime/xla_flags.py"
+
+# bootstrap sites that may mutate XLA_FLAGS directly — each must touch
+# ONLY the device-count flag (asserted below); everything else routes
+# through runtime.xla_flags
+MUTATION_ALLOWLIST = {
+    GUARDED_SITE,
+    "tests/conftest.py",
+    "tests/mp_worker.py",
+    "examples/multihost_launch.py",
+}
+
+_MUTATION = re.compile(
+    r"""(environ\s*\[\s*['"]XLA_FLAGS['"]\s*\]\s*=
+         |environ\.setdefault\(\s*['"]XLA_FLAGS
+         |putenv\(\s*['"]XLA_FLAGS)""", re.VERBOSE)
+_TIMEOUT_FLAG = re.compile(r"xla_cpu_collective_call")
+
+
+def _py_files():
+    for root, dirs, files in os.walk(REPO):
+        dirs[:] = [d for d in dirs
+                   if not d.startswith(".") and d != "__pycache__"]
+        for f in files:
+            if f.endswith(".py"):
+                full = os.path.join(root, f)
+                yield os.path.relpath(full, REPO).replace(os.sep, "/"), full
+
+
+def test_timeout_flag_names_confined_to_guarded_site():
+    offenders = []
+    for rel, full in _py_files():
+        if rel in (GUARDED_SITE, "tests/test_xla_flags_policy.py"):
+            continue
+        with open(full, encoding="utf-8", errors="replace") as f:
+            if _TIMEOUT_FLAG.search(f.read()):
+                offenders.append(rel)
+    assert not offenders, (
+        "the XLA:CPU collective-timeout flags may only be injected by the "
+        f"version-gated {GUARDED_SITE} (jaxlib builds that predate them "
+        f"abort on unknown flags); found the names in: {offenders}")
+
+
+def test_xla_flags_mutation_only_at_allowed_sites():
+    offenders, allowlisted = [], []
+    for rel, full in _py_files():
+        if rel == "tests/test_xla_flags_policy.py":
+            continue  # this file quotes the forbidden pattern in asserts
+        with open(full, encoding="utf-8", errors="replace") as f:
+            src = f.read()
+        if not _MUTATION.search(src):
+            continue
+        if rel not in MUTATION_ALLOWLIST:
+            offenders.append(rel)
+        elif rel != GUARDED_SITE:
+            allowlisted.append((rel, src))
+    assert not offenders, (
+        "XLA_FLAGS mutation outside the allowed sites — route it through "
+        f"dislib_tpu.runtime.xla_flags instead: {offenders}")
+    for rel, src in allowlisted:
+        # bootstrap sites may only set the device-count flag
+        flags = set(re.findall(r"--(xla_\w+)", src))
+        assert flags <= {"xla_force_host_platform_device_count"}, (
+            f"{rel} sets XLA flags other than the device-count bootstrap "
+            f"flag ({flags}) — use dislib_tpu.runtime.xla_flags")
+
+
+class TestVersionGate:
+    def test_gate_matches_this_jaxlib(self):
+        """On the pinned CI jaxlib (0.4.x) the flags are unsupported and
+        must NOT be in this process's XLA_FLAGS; on a jaxlib past the
+        threshold the gate opens."""
+        from dislib_tpu.runtime import xla_flags as xf
+        v = xf._jaxlib_version()
+        assert v is not None
+        if os.environ.get("DSLIB_XLA_CPU_TIMEOUT_FLAGS") in ("0", "1"):
+            pytest.skip("gate explicitly forced via env")
+        expect = v >= xf._MIN_JAXLIB_FOR_TIMEOUT_FLAGS
+        assert xf.cpu_collective_timeout_flags_supported() == expect
+        if not expect:
+            assert "xla_cpu_collective_call" not in \
+                os.environ.get("XLA_FLAGS", ""), \
+                "unsupported timeout flags leaked into XLA_FLAGS"
+
+    def test_force_enable_and_disable(self, monkeypatch):
+        from dislib_tpu.runtime import xla_flags as xf
+        monkeypatch.setenv("DSLIB_XLA_CPU_TIMEOUT_FLAGS", "1")
+        monkeypatch.setenv("XLA_FLAGS", "")
+        assert xf.cpu_collective_timeout_flags_supported()
+        assert xf.inject_cpu_collective_timeouts()
+        flags = os.environ["XLA_FLAGS"]
+        assert "terminate_timeout_seconds=600" in flags
+        assert "warn_stuck_timeout_seconds=60" in flags
+        # idempotent: a second injection appends nothing
+        assert xf.inject_cpu_collective_timeouts()
+        assert os.environ["XLA_FLAGS"] == flags
+        monkeypatch.setenv("DSLIB_XLA_CPU_TIMEOUT_FLAGS", "0")
+        monkeypatch.setenv("XLA_FLAGS", "")
+        assert not xf.inject_cpu_collective_timeouts()
+        assert os.environ["XLA_FLAGS"] == ""
+
+    def test_user_value_wins(self, monkeypatch):
+        from dislib_tpu.runtime import xla_flags as xf
+        monkeypatch.setenv("DSLIB_XLA_CPU_TIMEOUT_FLAGS", "1")
+        monkeypatch.setenv(
+            "XLA_FLAGS",
+            "--xla_cpu_collective_call_terminate_timeout_seconds=99")
+        xf.inject_cpu_collective_timeouts()
+        assert "terminate_timeout_seconds=99" in os.environ["XLA_FLAGS"]
+        assert "terminate_timeout_seconds=600" not in os.environ["XLA_FLAGS"]
+
+    def test_device_count_helper(self, monkeypatch):
+        from dislib_tpu.runtime import xla_flags as xf
+        monkeypatch.setenv("XLA_FLAGS", "")
+        xf.force_host_platform_device_count(6)
+        assert os.environ["XLA_FLAGS"] == \
+            "--xla_force_host_platform_device_count=6"
+        xf.force_host_platform_device_count(8)   # existing value wins
+        assert "=6" in os.environ["XLA_FLAGS"]
