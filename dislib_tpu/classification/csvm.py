@@ -53,6 +53,7 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
 from dislib_tpu.runtime import fetch as _fetch, \
     preemption_requested as _preemption_requested, \
     raise_if_preempted as _raise_if_preempted
@@ -261,7 +262,9 @@ class CascadeSVM(BaseEstimator):
             verbose_logger("csvm", self.verbose).info(
                 "iter %d: W=%.6f, SVs=%d", it, w, len(sv_idx))
             def _snap():
-                checkpoint.save({"sv_idx": np.asarray(sv_idx, np.int64),
+                # host-side state already — the async offload moves the
+                # checksum+atomic write off the cascade's critical path
+                checkpoint.save_async({"sv_idx": np.asarray(sv_idx, np.int64),
                                  "sv_alpha": self._sv_alpha,
                                  "last_w": w, "n_iter": it, "fp": fp,
                                  "digest": digest,
@@ -290,6 +293,8 @@ class CascadeSVM(BaseEstimator):
                     _snap()
                     _raise_if_preempted(checkpoint)
 
+        if checkpoint is not None:
+            checkpoint.flush()
         self.iterations_n = self.n_iter_ = it
         self._sv_idx = sv_idx
         # gather SV rows only (n_sv × n, never the dataset): from the host
@@ -433,8 +438,17 @@ def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma,
         y_sub = np.where(valid, y_host[np.maximum(chunk, 0)], 0.0) \
             .astype(np.float32)
         c_vec = np.where(valid, c, 0.0).astype(np.float32)
-        return _solve_level_k(jnp.asarray(k_sub), jnp.asarray(y_sub),
-                              jnp.asarray(c_vec), solver)
+        import warnings
+        with warnings.catch_warnings():
+            # k_sub (the staged kernel rows, the level's dominant buffer)
+            # has no same-shape output to alias, so XLA reports it
+            # "not usable" for aliasing at lowering — donation still
+            # releases its HBM for solver temporaries mid-program, which
+            # is the point; silence exactly that advisory
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return _solve_level_k(jnp.asarray(k_sub), jnp.asarray(y_sub),
+                                  jnp.asarray(c_vec), solver)
 
     if n_nodes <= batch and k_of is None:
         return solve_chunk(nodes)
@@ -541,7 +555,8 @@ def _dual_ascent(q, c_vec, solver="pg"):
     return alpha, obj
 
 
-@partial(jax.jit, static_argnames=("n_feat", "kernel", "solver"))
+@partial(_pjit, static_argnames=("n_feat", "kernel", "solver"),
+         name="csvm_solve_level")
 @precise
 def _solve_level(xv, yv, nodes, c, n_feat, kernel, gamma, solver):
     """Solve the boxed dual on every node of a cascade level (vmap).  Each
@@ -561,7 +576,7 @@ def _solve_level(xv, yv, nodes, c, n_feat, kernel, gamma, solver):
     return jax.vmap(solve_one)(nodes)
 
 
-@partial(jax.jit, static_argnames=("n_feat",))
+@partial(_pjit, static_argnames=("n_feat",), name="csvm_ell_rows")
 def _ell_rows_dense(ev, ec, idx, n_feat):
     """Densify the rows ``idx`` of an ELL-format sparse matrix on device:
     one scatter-add per gather — the device replacement for slicing a host
@@ -573,7 +588,8 @@ def _ell_rows_dense(ev, ec, idx, n_feat):
     return jnp.zeros((cap, n_feat), ev.dtype).at[rows, cc].add(v)
 
 
-@partial(jax.jit, static_argnames=("n_feat", "kernel", "solver"))
+@partial(_pjit, static_argnames=("n_feat", "kernel", "solver"),
+         name="csvm_solve_level_ell")
 @precise
 def _solve_level_ell(ev, ec, yv, nodes, c, n_feat, kernel, gamma, solver):
     """Boxed-dual solves with device-resident sparse staging: each node
@@ -595,7 +611,11 @@ def _solve_level_ell(ev, ec, yv, nodes, c, n_feat, kernel, gamma, solver):
     return jax.vmap(solve_one)(nodes)
 
 
-@partial(jax.jit, static_argnames=("solver",))
+# k_sub (per-node kernel rows) and y_sub are DONATED: both are staged
+# fresh per call and dead afterwards; y_sub aliases the alpha output,
+# k_sub frees the level's largest buffer for solver temporaries.
+@partial(_pjit, static_argnames=("solver",),
+         donate_argnames=("k_sub", "y_sub"), name="csvm_solve_level_k")
 @precise
 def _solve_level_k(k_sub, y_sub, c_vec, solver):
     """Same dual solves on host-staged kernel blocks (the sparse path)."""
@@ -605,7 +625,7 @@ def _solve_level_k(k_sub, y_sub, c_vec, solver):
     return jax.vmap(solve_one)(k_sub, y_sub, c_vec)
 
 
-@partial(jax.jit, static_argnames=("kernel",))
+@partial(_pjit, static_argnames=("kernel",), name="csvm_decision_sparse")
 @precise
 def _decision_sparse(bcoo, rowsq, sv_x, sv_y, sv_alpha, kernel, gamma):
     """Decision values for sparse queries: cross = one spmm (m, n_sv)."""
@@ -620,7 +640,7 @@ def _decision_sparse(bcoo, rowsq, sv_x, sv_y, sv_alpha, kernel, gamma):
     return ((k + 1.0) @ (sv_alpha * sv_y))[:, None]
 
 
-@partial(jax.jit, static_argnames=("q_shape", "kernel"))
+@partial(_pjit, static_argnames=("q_shape", "kernel"), name="csvm_decision")
 @precise
 def _decision(qp, q_shape, sv_x, sv_y, sv_alpha, kernel, gamma):
     mq, n = q_shape

@@ -146,15 +146,18 @@ class Daura(BaseEstimator):
         while True:
             active, labels, medoids, cid = extract(active, labels, medoids,
                                                    cid)
-            done = not bool(jax.device_get(jnp.any(active)))
-            checkpoint.save({"active": _fetch(active),
-                             "labels": _fetch(labels),
-                             "medoids": _fetch(medoids),
-                             "cid": int(jax.device_get(cid)),
-                             "fp": fp, "digest": digest})
+            done = not bool(_fetch(jnp.any(active)))
+            # blocking fetches (the round's own sync), async file write —
+            # the checksum+atomic rename overlaps the next extract round
+            checkpoint.save_async({"active": _fetch(active),
+                                   "labels": _fetch(labels),
+                                   "medoids": _fetch(medoids),
+                                   "cid": int(_fetch(cid)),
+                                   "fp": fp, "digest": digest})
             if done:
                 break
             _raise_if_preempted(checkpoint)
+        checkpoint.flush()
         return labels, medoids
 
 

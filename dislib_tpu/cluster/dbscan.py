@@ -180,11 +180,14 @@ class DBSCAN(BaseEstimator):
             core, label = setup()
         while True:
             label, changed = propagate(label, core)
-            checkpoint.save({"label": _fetch(label), "core": _fetch(core),
-                             "fp": fp, "digest": digest})
-            if not bool(jax.device_get(changed)):
+            # blocking fetches, async file write (overlaps next propagate)
+            checkpoint.save_async({"label": _fetch(label),
+                                   "core": _fetch(core),
+                                   "fp": fp, "digest": digest})
+            if not bool(_fetch(changed)):
                 break
             _raise_if_preempted(checkpoint)
+        checkpoint.flush()
         return finalize(label, core), core
 
 

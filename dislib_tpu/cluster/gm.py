@@ -28,6 +28,7 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
 from dislib_tpu.runtime import fetch as _fetch, \
     raise_if_preempted as _raise_if_preempted
 from dislib_tpu.utils.dlog import verbose_logger
@@ -147,11 +148,15 @@ class GaussianMixture(BaseEstimator):
             it += int(n_done)
             lb = float(lb_dev)
             converged = bool(conv)
-            history.extend(np.asarray(jax.device_get(hist))[: int(n_done)])
+            history.extend(_fetch(hist)[: int(n_done)])
             log.info("iter %d: lower_bound=%.6g", it, lb)
             overrides = (weights, means, covs)
             if checkpoint is not None:
-                checkpoint.save({
+                # the EM parameters are DONATED to the next chunk's kernel
+                # (HBM reused in place), so their device->host copies are
+                # blocking; the checksum+file write still overlaps the next
+                # chunk on the snapshot worker
+                checkpoint.save_async({
                     "weights": _fetch(weights),
                     "means": _fetch(means),
                     "covariances": _fetch(covs),
@@ -160,6 +165,8 @@ class GaussianMixture(BaseEstimator):
                     _raise_if_preempted(checkpoint)
             if checkpoint is None:
                 break
+        if checkpoint is not None:
+            checkpoint.flush()
         weights, means, covs = overrides
         self.weights_ = np.asarray(jax.device_get(weights))
         self.means_ = np.asarray(jax.device_get(means))
@@ -333,7 +340,13 @@ def _estimate_covs(xv, resp, nk, means, cov_type, reg_covar, w):
     return var + reg_covar
 
 
-@partial(jax.jit, static_argnames=("shape", "cov_type", "max_iter"))
+# `overrides` (the chunked/resumed EM parameter carries) is DONATED: XLA
+# aliases weights/means/covs to their updated outputs and reuses the HBM
+# in place across chunks; the (m, k) responsibilities never leave the
+# device program at all (e_step -> m_step fuse inside the while_loop).
+# Callers never reuse a passed overrides tuple afterwards.
+@partial(_pjit, static_argnames=("shape", "cov_type", "max_iter"),
+         donate_argnames=("overrides",), name="gm_fit")
 @precise
 def _gm_fit(xp, shape, resp0, cov_type, reg_covar, tol, max_iter,
             overrides=(None, None, None), prev_lb0=None):
@@ -384,7 +397,7 @@ def _gm_fit(xp, shape, resp0, cov_type, reg_covar, tol, max_iter,
     return weights, means, covs, lb, n_iter, conv, hist
 
 
-@partial(jax.jit, static_argnames=("shape", "cov_type"))
+@partial(_pjit, static_argnames=("shape", "cov_type"), name="gm_loglik")
 @precise
 def _gm_loglik(xp, shape, weights, means, covs, cov_type):
     m, n = shape

@@ -44,6 +44,7 @@ from dislib_tpu.ops.base import precise
 from dislib_tpu.runtime import fetch as _fetch, \
     raise_if_preempted as _raise_if_preempted
 from dislib_tpu.utils.dlog import verbose_logger
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
 
 class KMeans(BaseEstimator):
@@ -166,17 +167,24 @@ class KMeans(BaseEstimator):
                     x._data, x.shape, centers, chunk, float(self.tol),
                     fast=self._fast())
             it += int(n_done)
-            history.extend(np.asarray(jax.device_get(hist))[: int(n_done)])
+            history.extend(_fetch(hist)[: int(n_done)])
             done = float(shift) < self.tol
             log.info("iter %d: inertia=%.6g shift=%.3g", it,
                      float(inertia), float(shift))
             if checkpoint is not None:
-                checkpoint.save({"centers": _fetch(centers),
-                                 "n_iter": it, "converged": done})
+                # async offload: the device->host copy starts now and the
+                # file write runs on the snapshot worker, both overlapping
+                # the next chunk's compute (centers are never donated, so
+                # the non-blocking fetch is safe)
+                checkpoint.save_async({
+                    "centers": _fetch(centers, blocking=False),
+                    "n_iter": it, "converged": done})
                 if not done and it < self.max_iter:  # work left: allow a
                     _raise_if_preempted(checkpoint)  # clean preempt here
             if checkpoint is None:
                 break
+        if checkpoint is not None:
+            checkpoint.flush()          # last snapshot lands before return
         self.centers_ = np.asarray(jax.device_get(centers))
         self.n_iter_ = it
         self.history_ = np.asarray(history, dtype=np.float64)
@@ -242,7 +250,8 @@ class KMeans(BaseEstimator):
 # device kernels
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("shape", "max_iter", "fast"))
+@partial(_pjit, static_argnames=("shape", "max_iter", "fast"),
+         name="kmeans_fit")
 @precise
 def _kmeans_fit(xp, shape, centers0, max_iter, tol, fast=False):
     m, n = shape
@@ -286,7 +295,7 @@ def _kmeans_fit(xp, shape, centers0, max_iter, tol, fast=False):
     return centers, n_iter, inertia, shift, hist
 
 
-@partial(jax.jit, static_argnames=("shape",))
+@partial(_pjit, static_argnames=("shape",), name="kmeans_predict")
 @precise
 def _kmeans_predict(xp, shape, centers):
     m, n = shape
@@ -308,7 +317,8 @@ def _sparse_distances(bcoo, rowsq, centers):
     return jnp.maximum(rowsq[:, None] - 2.0 * cross + c_sq[None, :], 0.0)
 
 
-@partial(jax.jit, static_argnames=("m", "max_iter", "mesh"))
+@partial(_pjit, static_argnames=("m", "max_iter", "mesh"),
+         name="kmeans_fit_sparse")
 def _kmeans_fit_sparse_sharded(data, lrows, cols, rowsq, centers0, m,
                                max_iter, tol, mesh):
     """Sparse-path Lloyd's on the row-sharded rectangular representation
@@ -374,7 +384,7 @@ def _kmeans_fit_sparse_sharded(data, lrows, cols, rowsq, centers0, m,
     return centers, n_iter, inertia, shift, hist
 
 
-@partial(jax.jit, static_argnames=("shape",))
+@partial(_pjit, static_argnames=("shape",), name="kmeans_score")
 @precise
 def _kmeans_score(xp, shape, centers):
     m, n = shape

@@ -25,6 +25,20 @@ TPU-native redesign — NOT a block-of-futures translation:
 - The "cheap to build, pay on sync" contract (SURVEY.md §4.6) is preserved by
   JAX's async dispatch: every method returns immediately with a live
   ``jax.Array``; ``collect()`` is the only host sync.
+- **Dispatch fusion** (round-7 perf PR): op chains don't even dispatch
+  per-op.  Elementwise ops, transpose, basic slicing, reductions,
+  ``math.matmul`` and ``ops.distances_sq`` build a small deferred
+  expression (:class:`_LazyExpr`); the first host-forcing access
+  (``collect()``, ``force()``, any internal ``_data`` read, ``float()``,
+  a snapshot fetch) compiles and runs the WHOLE chain as ONE cached XLA
+  program (``_exec_program``).  On a backend whose per-dispatch host RTT
+  is ~70 ms (BENCH_local_r05), a k-op chain costs one RTT instead of k.
+  ``DSLIB_EAGER=1`` restores per-op dispatch for debugging, and chains
+  force themselves after ``DSLIB_FUSION_CAP`` nodes (default 96) so a
+  long Python loop cannot build an unboundedly large program.  Fused and
+  eager paths share the same op bodies, so results match bit-for-bit up
+  to XLA's in-program excess-precision FMA contraction (≤ 1 ulp; see
+  ``_exec_program``) — pinned by ``tests/test_fusion.py``.
 
 Sparse support: ``_sparse=True`` arrays keep a BCOO backing for memory-honest
 storage where it pays (see `dislib_tpu/data/sparse.py`), with a dense+mask
@@ -34,6 +48,7 @@ fallback — the decision recorded per estimator as SURVEY §8 directs.
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from numbers import Number
 
@@ -42,7 +57,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from dislib_tpu.ops.base import distances_sq as _raw_distances_sq, precise
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
 __all__ = [
     "Array",
@@ -85,7 +102,8 @@ def _zero_pad(data, logical_shape):
     return jnp.where(_pad_mask(data.shape, logical_shape), data, jnp.zeros((), data.dtype))
 
 
-@partial(jax.jit, static_argnames=("padded_shape", "logical_shape"))
+@partial(_pjit, static_argnames=("padded_shape", "logical_shape"),
+         name="place")
 def _place(data, padded_shape, logical_shape):
     """Pad `data` (logical region) up to padded_shape with zeros."""
     out = jnp.zeros(padded_shape, data.dtype)
@@ -100,6 +118,265 @@ def _default_block_size(shape, mesh):
 
 
 # ---------------------------------------------------------------------------
+# dispatch fusion: the lazy expression layer
+# ---------------------------------------------------------------------------
+
+def _eager_mode() -> bool:
+    """True when DSLIB_EAGER=1 — every op dispatches its own XLA program
+    (the pre-fusion behavior; the debugging escape hatch)."""
+    return os.environ.get("DSLIB_EAGER", "0") not in ("", "0")
+
+
+def _fusion_cap() -> int:
+    """Max deferred nodes per chain before an automatic force — bounds
+    both compile time and the linearizer's recursion depth."""
+    return int(os.environ.get("DSLIB_FUSION_CAP", "96"))
+
+
+class _LazyExpr:
+    """One deferred op: ``op`` names an entry in ``_INSTRS``, ``static``
+    is its hashable config (shapes, op variants), ``args`` are child
+    ``_LazyExpr`` nodes or concrete ``jax.Array``/ndarray leaves.
+    ``pshape``/``dtype`` are the padded output shape and dtype, computed
+    at build time so ``Array`` metadata never forces the chain.
+
+    ``refs`` counts consumers (parent nodes + wrapping Arrays).  A node
+    with ``refs > 1`` is a shared prefix: the force that first reaches it
+    emits it as an extra program output and caches it in ``value``, so
+    every other consumer linearizes it as a LEAF instead of re-running
+    (and re-compiling) the whole prefix per fan-out branch."""
+
+    __slots__ = ("op", "static", "args", "pshape", "dtype", "n_ops",
+                 "refs", "value")
+
+    def __init__(self, op, static, args, pshape, dtype):
+        self.op = op
+        self.static = static
+        self.args = args
+        self.pshape = tuple(int(s) for s in pshape)
+        self.dtype = jnp.dtype(dtype)
+        self.refs = 0
+        self.value = None
+        self.n_ops = 1
+        for a in args:
+            if isinstance(a, _LazyExpr):
+                a.refs += 1
+                self.n_ops += a.n_ops
+
+
+def _linearize(root: _LazyExpr):
+    """Postorder program for one chain: ``(instrs, leaves, shared)``.
+
+    Each instruction is ``(op, static, srcs)`` with a src of
+    ``(0, leaf_idx)`` or ``(1, instr_idx)``; the program's trailing
+    element is the tuple of instr indices to RETURN alongside the root —
+    the shared (refs > 1) interior nodes, listed in ``shared`` so the
+    caller can backfill their ``value`` caches.  Shared subexpressions
+    and repeated leaves dedupe by identity, valued nodes load as leaves,
+    so diamond graphs and cross-Array fan-outs evaluate once."""
+    instrs, leaves, shared = [], [], []
+    instr_memo, leaf_memo = {}, {}
+
+    def visit(node):
+        if isinstance(node, _LazyExpr) and node.value is None:
+            slot = instr_memo.get(id(node))
+            if slot is None:
+                srcs = tuple(visit(a) for a in node.args)
+                instrs.append((node.op, node.static, srcs))
+                slot = (1, len(instrs) - 1)
+                instr_memo[id(node)] = slot
+                if node.refs > 1 and node is not root:
+                    shared.append((node, len(instrs) - 1))
+            return slot
+        if isinstance(node, _LazyExpr):
+            node = node.value           # materialised prefix → plain leaf
+        slot = leaf_memo.get(id(node))
+        if slot is None:
+            leaves.append(node)
+            slot = (0, len(leaves) - 1)
+            leaf_memo[id(node)] = slot
+        return slot
+
+    visit(root)
+    program = tuple(instrs) + (tuple(idx for _, idx in shared),)
+    return program, leaves, [node for node, _ in shared]
+
+
+def _place_region(v, pshape):
+    """Traced analog of `_repad`'s place+reshard: zero canvas, write the
+    logical region at (0, 0), constrain to the library sharding."""
+    if tuple(v.shape) != tuple(pshape):
+        canvas = jnp.zeros(pshape, v.dtype)
+        v = lax.dynamic_update_slice(canvas, v, (0, 0))
+    return lax.with_sharding_constraint(v, _mesh.data_sharding())
+
+
+def _matmul_body(a, b, ta, tb):
+    """The ONE GEMM body shared by the eager `math.matmul` kernel and the
+    fused "matmul" instruction (zero padding ⇒ padded == logical dot)."""
+    if ta:
+        a = a.T
+    if tb:
+        b = b.T
+    out = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    return lax.with_sharding_constraint(out, _mesh.data_sharding())
+
+
+def _instr_ew2(static, a, b):
+    op, a_shape, b_shape, out_shape = static
+    return _ew_array_body(a, b, a_shape, b_shape, out_shape, op)
+
+
+def _instr_ew1(static, a, scalar):
+    op, shape = static
+    return _ew_scalar_body(a, scalar, shape, op)
+
+
+def _instr_transpose(static, a):
+    del static
+    return lax.with_sharding_constraint(a.T, _mesh.data_sharding())
+
+
+def _instr_slice(static, a):
+    r0, r1, rs, c0, c1, cs, out_shape, out_pshape = static
+    del out_shape
+    return _place_region(a[r0:r1:rs, c0:c1:cs], out_pshape)
+
+
+def _instr_reduce(static, a):
+    kind, axis, in_shape, out_shape, out_pshape = static
+    red = _reduce_body(a, in_shape, kind, axis)
+    return _place_region(red[: out_shape[0], : out_shape[1]], out_pshape)
+
+
+def _instr_matmul(static, a, b):
+    ta, tb = static
+    inner_a = a.shape[0] if ta else a.shape[1]
+    inner_b = b.shape[1] if tb else b.shape[0]
+    pad_to = max(inner_a, inner_b)
+    if inner_a < pad_to:                 # quantum mismatch: grow the pad
+        grow = pad_to - inner_a
+        a = jnp.pad(a, ((0, grow), (0, 0)) if ta else ((0, 0), (0, grow)))
+    if inner_b < pad_to:
+        grow = pad_to - inner_b
+        b = jnp.pad(b, ((0, 0), (0, grow)) if tb else ((0, grow), (0, 0)))
+    return _matmul_body(a, b, ta, tb)
+
+
+def _instr_dist(static, a, b):
+    a_shape, b_shape, out_pshape, prec = static
+    (m, n), (k, _) = a_shape, b_shape
+    d = _raw_distances_sq(a[:m, :n], b[:k, :n], precision=prec)
+    return _place_region(d, out_pshape)
+
+
+_INSTRS = {
+    "ew2": _instr_ew2,
+    "ew1": _instr_ew1,
+    "transpose": _instr_transpose,
+    "slice": _instr_slice,
+    "reduce": _instr_reduce,
+    "matmul": _instr_matmul,
+    "dist": _instr_dist,
+}
+
+
+@partial(_pjit, static_argnames=("program",), name="fused_chain")
+@precise
+def _exec_program(program, *operands):
+    """Interpret one linearized chain while tracing — the whole program
+    compiles (and caches) as ONE XLA executable keyed on (program,
+    operand shapes/dtypes).
+
+    Numerics vs the eager path: instruction bodies are shared verbatim,
+    so every individual op rounds identically.  The ONE divergence XLA
+    is permitted is excess-precision contraction WITHIN the fused
+    program (a multiply feeding an add on the same element may become a
+    single FMA — ≤ 1 ulp, and strictly more accurate).  Neither
+    `optimization_barrier` nor an f32→f32 `reduce_precision` stops the
+    backend's fp-contract inside one fused kernel (measured on XLA:CPU,
+    jaxlib 0.4.36), and the global `--xla_allow_excess_precision=false`
+    escape would mutate user-scope flags — so the contract is: bit-equal
+    except mul→add contraction, bounded by 1 ulp
+    (`tests/test_fusion.py::test_fma_contraction_is_the_only_divergence`)."""
+    *instrs, shared_out = program
+    vals = []
+    for op, static, srcs in instrs:
+        args = [operands[i] if kind == 0 else vals[i] for kind, i in srcs]
+        vals.append(_INSTRS[op](static, *args))
+    # root first, then each shared interior node (cached by the caller so
+    # other fan-out consumers load it as a leaf instead of re-running it)
+    return (vals[-1],) + tuple(vals[i] for i in shared_out)
+
+
+def _unique_ops(expr: _LazyExpr) -> int:
+    """Exact deferred-node count of a DAG (``n_ops`` overcounts shared
+    subexpressions — exponentially so for diamond towers)."""
+    seen, stack = set(), [expr]
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.extend(a for a in node.args if isinstance(a, _LazyExpr))
+    return len(seen)
+
+
+def _lazy_array(expr, shape, reg_shape, sparse):
+    """Wrap a deferred node; force automatically past the fusion cap.
+    ``n_ops`` is a cheap upper bound — only when it crosses the cap is
+    the exact (deduped) count walked, so shared-subexpression DAGs are
+    not forced early by the overcount."""
+    arr = Array(expr, shape, reg_shape=reg_shape, sparse=sparse)
+    if expr.n_ops >= _fusion_cap() and _unique_ops(expr) >= _fusion_cap():
+        arr.force()
+    return arr
+
+
+def _ew_dtype(op, da, db):
+    """Result dtype of a deferred binary op (metadata only — the traced
+    body performs the real promotion; this mirrors it)."""
+    dt = jnp.promote_types(da, db)
+    # true division / exp / sqrt of integer operands float their result
+    if op in ("div", "rdiv", "exp_", "sqrt_") \
+            and jnp.issubdtype(dt, jnp.integer):
+        dt = jnp.dtype(jnp.float64 if jax.config.jax_enable_x64
+                       else jnp.float32)
+    return dt
+
+
+def _reduce_dtype(kind, dtype):
+    if kind in ("mean", "norm"):
+        return jnp.promote_types(dtype, jnp.float32)
+    return jnp.dtype(dtype)
+
+
+def _array_distances(a: "Array", b: "Array", precision=None) -> "Array":
+    """ds-array pairwise squared distances — a fusable graph node (or one
+    eager kernel under DSLIB_EAGER); see `ops.base.distances_sq`."""
+    if a.shape[1] != b.shape[1]:
+        raise ValueError(f"distances_sq: feature dims differ "
+                         f"({a.shape[1]} vs {b.shape[1]})")
+    out_shape = (a.shape[0], b.shape[0])
+    out_pshape = _padded_shape(out_shape, _mesh.pad_quantum())
+    dtype = jnp.promote_types(a.dtype, b.dtype)
+    if _eager_mode():
+        data = _distances_op(a._data, b._data, a._shape, b._shape,
+                             out_pshape, precision)
+        return Array(data, out_shape, None, False)
+    expr = _LazyExpr("dist", (a._shape, b._shape, out_pshape, precision),
+                     (a._node(), b._node()), out_pshape, dtype)
+    return _lazy_array(expr, out_shape, None, False)
+
+
+@partial(_pjit, static_argnames=("a_shape", "b_shape", "out_pshape", "prec"),
+         name="distances")
+@precise
+def _distances_op(a, b, a_shape, b_shape, out_pshape, prec):
+    return _instr_dist((a_shape, b_shape, out_pshape, prec), a, b)
+
+
+# ---------------------------------------------------------------------------
 # the Array
 # ---------------------------------------------------------------------------
 
@@ -111,14 +388,69 @@ class Array:
     results of dislib_tpu operations.
     """
 
-    def __init__(self, data: jax.Array, shape, reg_shape=None, sparse=False,
+    def __init__(self, data, shape, reg_shape=None, sparse=False,
                  _skip_zero_check=True):
-        self._data = data                       # padded, zero-outside-logical
+        # data: padded, zero-outside-logical — either a concrete jax.Array
+        # or a deferred _LazyExpr (the fusion layer)
+        if isinstance(data, _LazyExpr):
+            data.refs += 1              # this wrapper is a consumer too
+            self._lazy = data
+            self._concrete = None
+        else:
+            self._concrete = data
+            self._lazy = None
         self._shape = (int(shape[0]), int(shape[1]))
         if reg_shape is None:
             reg_shape = _default_block_size(self._shape, None)
         self._reg_shape = (int(reg_shape[0]), int(reg_shape[1]))
         self._sparse = bool(sparse)
+
+    # -- fusion plumbing -----------------------------------------------------
+
+    @property
+    def _data(self):
+        """The padded device backing.  Reading it is a FORCE point: any
+        deferred op chain compiles and runs as one program first."""
+        if self._concrete is None:
+            expr = self._lazy
+            if expr.value is not None:   # prefix already materialised by
+                self._concrete = expr.value  # another consumer's force
+            else:
+                program, leaves, shared = _linearize(expr)
+                root, *shared_vals = _exec_program(program, *leaves)
+                for node, val in zip(shared, shared_vals):
+                    node.value = val
+                    node.args = ()      # edges are dead once cached —
+                expr.value = root       # don't pin the leaf buffers
+                expr.args = ()
+                self._concrete = root
+            self._lazy = None
+        return self._concrete
+
+    def _node(self):
+        """This array as a fusion-graph operand: its deferred expression
+        if one is pending, else the concrete backing as a leaf."""
+        return self._lazy if self._lazy is not None else self._concrete
+
+    @property
+    def _pshape(self) -> tuple[int, int]:
+        """Padded backing shape — available without forcing."""
+        if self._lazy is not None:
+            return self._lazy.pshape
+        return tuple(self._concrete.shape)
+
+    @property
+    def is_lazy(self) -> bool:
+        """True while this array is an unforced deferred op chain."""
+        return self._concrete is None
+
+    def force(self) -> "Array":
+        """Materialise any deferred op chain as ONE compiled dispatch and
+        return self.  A no-op on an already-concrete array.  `collect()`,
+        `float()`, snapshot fetches, and every internal `_data` read
+        force implicitly; call this to place the sync point explicitly."""
+        self._data  # noqa: B018 — property access runs the fused program
+        return self
 
     # -- construction helpers ------------------------------------------------
 
@@ -141,7 +473,9 @@ class Array:
 
     @property
     def dtype(self):
-        return self._data.dtype
+        if self._lazy is not None:       # metadata — must not force
+            return self._lazy.dtype
+        return self._concrete.dtype
 
     @property
     def _n_blocks(self) -> tuple[int, int]:
@@ -181,6 +515,17 @@ class Array:
         self._data.block_until_ready()
         return self
 
+    def __float__(self) -> float:
+        """Host scalar of a (1, 1) array — a force point (the deferred
+        chain runs as one program first)."""
+        if self._shape != (1, 1):
+            raise TypeError(
+                f"only a (1, 1) ds-array converts to float, got {self._shape}")
+        # read the backing directly: collect() of a sparse-flagged array
+        # wraps the scalar in a csr_matrix, which float() rejects
+        return float(np.asarray(jax.device_get(self._data[0:1, 0:1]))
+                     .reshape(()))
+
     # -- layout --------------------------------------------------------------
 
     def rechunk(self, block_size) -> "Array":
@@ -198,10 +543,15 @@ class Array:
     # -- transpose -----------------------------------------------------------
 
     def transpose(self) -> "Array":
-        data = _transpose_op(self._data, self._shape)
-        return Array._from_logical_padded(
-            data, (self._shape[1], self._shape[0]),
-            (self._reg_shape[1], self._reg_shape[0]), self._sparse)
+        shape = (self._shape[1], self._shape[0])
+        reg = (self._reg_shape[1], self._reg_shape[0])
+        if _eager_mode():
+            data = _transpose_op(self._data, self._shape)
+            return Array._from_logical_padded(data, shape, reg, self._sparse)
+        pshape = self._pshape
+        expr = _LazyExpr("transpose", (self._shape,), (self._node(),),
+                         (pshape[1], pshape[0]), self.dtype)
+        return _lazy_array(expr, shape, reg, self._sparse)
 
     @property
     def T(self) -> "Array":
@@ -232,17 +582,42 @@ class Array:
             return NotImplemented
         if isinstance(other, Array):
             out_shape = _broadcast_shape(self._shape, other._shape)
-            data = _ew_array_op(self._data, other._data, self._shape, other._shape,
-                                out_shape, op)
-            return Array(data, out_shape, self._reg_shape,
-                         self._sparse and other._sparse)
-        data = _ew_scalar_op(self._data, float(other) if not isinstance(other, bool) else other,
-                             self._shape, op)
-        # scalar mul/div/pow map zeros to zeros; add/sub of a nonzero
-        # scalar destroys sparsity (the flag is metadata — data is dense)
-        preserves = op in ("mul", "div", "pow") or float(other) == 0.0
-        return Array(data, self._shape, self._reg_shape,
-                     self._sparse and preserves)
+            sparse = self._sparse and other._sparse
+            if _eager_mode():
+                data = _ew_array_op(self._data, other._data, self._shape,
+                                    other._shape, out_shape, op)
+                return Array(data, out_shape, self._reg_shape, sparse)
+            pa, pb = self._pshape, other._pshape
+            expr = _LazyExpr(
+                "ew2", (op, self._shape, other._shape, out_shape),
+                (self._node(), other._node()),
+                (max(pa[0], pb[0]), max(pa[1], pb[1])),
+                _ew_dtype(op, self.dtype, other.dtype))
+            return _lazy_array(expr, out_shape, self._reg_shape, sparse)
+        scalar = float(other) if not isinstance(other, bool) else other
+        # scalar mul/div/pow and the zero-preserving unaries map zeros to
+        # zeros; add/sub of a nonzero scalar destroys sparsity (the flag
+        # is metadata — data is dense).  exp is NOT zero-preserving
+        # (exp(0)=1 densifies) — its dummy 0.0 operand must not slip it
+        # through the ==0.0 clause.
+        if op == "exp_":
+            preserves = False
+        else:
+            preserves = op in ("mul", "div", "pow", "abs_", "sqrt_") \
+                or float(other) == 0.0
+        sparse = self._sparse and preserves
+        if _eager_mode():
+            data = _ew_scalar_op(self._data, scalar, self._shape, op)
+            return Array(data, self._shape, self._reg_shape, sparse)
+        # the scalar rides as a traced leaf (pre-rounded to this array's
+        # dtype, as the eager kernel does) so new values never retrace;
+        # the metadata dtype mirrors the body's promotion on SAME-dtype
+        # operands (int/scalar true-division still floats, e.g.)
+        leaf = np.asarray(scalar, np.dtype(self.dtype))
+        expr = _LazyExpr("ew1", (op, self._shape),
+                         (self._node(), leaf), self._pshape,
+                         _ew_dtype(op, self.dtype, self.dtype))
+        return _lazy_array(expr, self._shape, self._reg_shape, sparse)
 
     def __add__(self, o):  return self._ew(o, "add")
     def __radd__(self, o): return self._ew(o, "add")
@@ -256,11 +631,10 @@ class Array:
     def __neg__(self):     return self._ew(-1.0, "mul")
 
     def __abs__(self):
-        return Array(jnp.abs(self._data), self._shape, self._reg_shape, self._sparse)
+        return self._ew(0.0, "abs_")
 
     def sqrt(self) -> "Array":
-        return Array(_zero_pad(jnp.sqrt(self._data), self._shape),
-                     self._shape, self._reg_shape, self._sparse)
+        return self._ew(0.0, "sqrt_")
 
     def exp(self) -> "Array":
         return self._ew(0.0, "exp_")
@@ -276,14 +650,22 @@ class Array:
     def _reduce(self, kind: str, axis=0):
         if axis not in (0, 1, None):
             raise ValueError("axis must be 0, 1 or None")
-        data = _reduce_op(self._data, self._shape, kind, axis)
         if axis is None:
             shape = (1, 1)
         elif axis == 0:
             shape = (1, self._shape[1])
         else:
             shape = (self._shape[0], 1)
-        return Array._from_logical_padded(_repad(data, shape), shape, None, False)
+        if _eager_mode():
+            data = _reduce_op(self._data, self._shape, kind, axis)
+            return Array._from_logical_padded(_repad(data, shape), shape,
+                                              None, False)
+        out_pshape = _padded_shape(shape, _mesh.pad_quantum())
+        expr = _LazyExpr("reduce", (kind, axis, self._shape, shape,
+                                    out_pshape),
+                         (self._node(),), out_pshape,
+                         _reduce_dtype(kind, self.dtype))
+        return _lazy_array(expr, shape, None, False)
 
     def sum(self, axis=0):  return self._reduce("sum", axis)
     def mean(self, axis=0): return self._reduce("mean", axis)
@@ -299,8 +681,19 @@ class Array:
         rows, cols = _split_key(key)
         r_idx, r_len = _normalize_index(rows, self._shape[0])
         c_idx, c_len = _normalize_index(cols, self._shape[1])
-        data = _gather_op(self._data, r_idx, c_idx)
         new_shape = (r_len, c_len)
+        if not _eager_mode() and isinstance(r_idx, slice) \
+                and isinstance(c_idx, slice):
+            # basic (int/slice) indexing stays on the fusion graph; fancy
+            # indexing below forces — its gather shapes are data-sized
+            out_pshape = _padded_shape(new_shape, _mesh.pad_quantum())
+            expr = _LazyExpr(
+                "slice", (r_idx.start, r_idx.stop, r_idx.step,
+                          c_idx.start, c_idx.stop, c_idx.step,
+                          new_shape, out_pshape),
+                (self._node(),), out_pshape, self.dtype)
+            return _lazy_array(expr, new_shape, None, self._sparse)
+        data = _gather_op(self._data, r_idx, c_idx)
         return Array._from_logical_padded(_repad(data, new_shape), new_shape,
                                           None, self._sparse)
 
@@ -336,7 +729,9 @@ def _broadcast_shape(a, b):
 
 
 # ---------------------------------------------------------------------------
-# jitted kernels (module-level so jit caches by shape)
+# op bodies + jitted kernels (module-level so jit caches by shape).  Each
+# body is shared VERBATIM by its eager kernel and the fused-program
+# instruction, so DSLIB_EAGER=1 results bit-match the fused path.
 # ---------------------------------------------------------------------------
 
 _BINOPS = {
@@ -348,11 +743,12 @@ _BINOPS = {
     "rdiv": lambda a, b: b / a,
     "pow": lambda a, b: a ** b,
     "exp_": lambda a, b: jnp.exp(a),
+    "abs_": lambda a, b: jnp.abs(a),
+    "sqrt_": lambda a, b: jnp.sqrt(a),
 }
 
 
-@partial(jax.jit, static_argnames=("a_shape", "b_shape", "out_shape", "op"))
-def _ew_array_op(a, b, a_shape, b_shape, out_shape, op):
+def _ew_array_body(a, b, a_shape, b_shape, out_shape, op):
     # crop each operand to its logical region, broadcast, then re-pad. The
     # crop/pad pair fuses to a masked op under XLA; it keeps broadcasting
     # semantics exact when a (1, n) operand's padded rows would otherwise
@@ -365,6 +761,12 @@ def _ew_array_op(a, b, a_shape, b_shape, out_shape, op):
     return res
 
 
+@partial(_pjit, static_argnames=("a_shape", "b_shape", "out_shape", "op"),
+         name="ew_array")
+def _ew_array_op(a, b, a_shape, b_shape, out_shape, op):
+    return _ew_array_body(a, b, a_shape, b_shape, out_shape, op)
+
+
 def _padded_shape_like(a, b, out_shape):
     # the padded canvas big enough for out_shape under the current quantum
     q_r = max(a.shape[0], b.shape[0])
@@ -374,19 +776,22 @@ def _padded_shape_like(a, b, out_shape):
     return (q_r, q_c)
 
 
-@partial(jax.jit, static_argnames=("shape", "op"))
-def _ew_scalar_op(a, scalar, shape, op):
+def _ew_scalar_body(a, scalar, shape, op):
     out = _BINOPS[op](a, jnp.asarray(scalar, a.dtype))
     return _zero_pad(out, shape)
 
 
-@partial(jax.jit, static_argnames=("shape",))
+@partial(_pjit, static_argnames=("shape", "op"), name="ew_scalar")
+def _ew_scalar_op(a, scalar, shape, op):
+    return _ew_scalar_body(a, scalar, shape, op)
+
+
+@partial(_pjit, static_argnames=("shape",), name="transpose")
 def _transpose_op(a, shape):
     return a.T
 
 
-@partial(jax.jit, static_argnames=("shape", "kind", "axis"))
-def _reduce_op(a, shape, kind, axis):
+def _reduce_body(a, shape, kind, axis):
     mask = _pad_mask(a.shape, shape)
     if kind in ("sum", "norm", "mean"):
         x = jnp.where(mask, a, 0)
@@ -406,6 +811,11 @@ def _reduce_op(a, shape, kind, axis):
         red = fn(x, axis=axis, keepdims=True) if axis is not None else \
             fn(x, keepdims=True).reshape(1, 1)
     return red
+
+
+@partial(_pjit, static_argnames=("shape", "kind", "axis"), name="reduce")
+def _reduce_op(a, shape, kind, axis):
+    return _reduce_body(a, shape, kind, axis)
 
 
 def _repad(logical_data, shape):
@@ -563,7 +973,8 @@ def random_array(shape, block_size=None, random_state=None,
     return Array(data, shape, reg_shape=block_size)
 
 
-@partial(jax.jit, static_argnames=("pshape", "shape", "dtype"))
+@partial(_pjit, static_argnames=("pshape", "shape", "dtype"),
+         name="random_uniform")
 def _random_uniform(key, pshape, shape, dtype):
     vals = jax.random.uniform(key, pshape, dtype=dtype)
     return _zero_pad(vals, shape)
@@ -596,7 +1007,7 @@ def full(shape, fill_value, block_size=None, dtype=jnp.float32) -> Array:
     return Array(data, shape, reg_shape=block_size)
 
 
-@partial(jax.jit, static_argnames=("pshape", "shape", "dtype"))
+@partial(_pjit, static_argnames=("pshape", "shape", "dtype"), name="full")
 def _full_op(pshape, shape, fill_value, dtype):
     return _zero_pad(jnp.full(pshape, fill_value, dtype), shape)
 
@@ -620,7 +1031,7 @@ def eye(n, m=None, block_size=None, dtype=jnp.float32) -> Array:
     return Array(data, (n, m), reg_shape=block_size)
 
 
-@partial(jax.jit, static_argnames=("pshape", "shape", "dtype"))
+@partial(_pjit, static_argnames=("pshape", "shape", "dtype"), name="eye")
 def _eye_op(pshape, shape, dtype):
     r = lax.broadcasted_iota(jnp.int32, pshape, 0)
     c = lax.broadcasted_iota(jnp.int32, pshape, 1)

@@ -27,25 +27,25 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.data.array import (
+    Array, _LazyExpr, _eager_mode, _lazy_array, _matmul_body, _repad,
+)
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
 
 # ---------------------------------------------------------------------------
 # matmul
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("ta", "tb", "a_shape", "b_shape"))
+@partial(_pjit, static_argnames=("ta", "tb", "a_shape", "b_shape"),
+         name="matmul")
 @precise
 def _matmul_kernel(a, b, ta, tb, a_shape, b_shape):
-    if ta:
-        a = a.T
-    if tb:
-        b = b.T
+    del a_shape, b_shape
     # zero-padding invariant ⇒ padded contraction == logical contraction
-    out = jnp.dot(a, b, preferred_element_type=jnp.float32)
-    return lax.with_sharding_constraint(out, _mesh.data_sharding())
+    return _matmul_body(a, b, ta, tb)
 
 
 def matmul(a: Array, b: Array, transpose_a: bool = False,
@@ -53,15 +53,28 @@ def matmul(a: Array, b: Array, transpose_a: bool = False,
     """Distributed GEMM (reference: dislib.math.matmul, `_multiply` task).
 
     One XLA dot over the 2-D-sharded operands; the partitioner owns the
-    communication schedule the reference expressed as O(p^3) COMPSs tasks."""
+    communication schedule the reference expressed as O(p^3) COMPSs tasks.
+    On dense ds-array operands this is a fusion-graph node: the dot joins
+    the operands' deferred chains and dispatches with the first force."""
     a_shape = (a.shape[1], a.shape[0]) if transpose_a else a.shape
     b_shape = (b.shape[1], b.shape[0]) if transpose_b else b.shape
     if a_shape[1] != b_shape[0]:
         raise ValueError(f"matmul shape mismatch: {a_shape} @ {b_shape}")
+    out_shape = (a_shape[0], b_shape[1])
+    reg = (a._reg_shape[1] if transpose_a else a._reg_shape[0],
+           b._reg_shape[0] if transpose_b else b._reg_shape[1])
+    dense = type(a) is Array and type(b) is Array
+    if dense and not _eager_mode():
+        pa, pb = a._pshape, b._pshape
+        out_pshape = (pa[1] if transpose_a else pa[0],
+                      pb[0] if transpose_b else pb[1])
+        dtype = jnp.promote_types(jnp.promote_types(a.dtype, b.dtype),
+                                  jnp.float32)
+        expr = _LazyExpr("matmul", (transpose_a, transpose_b),
+                         (a._node(), b._node()), out_pshape, dtype)
+        return _lazy_array(expr, out_shape, reg, False)
     # padded inner dims must agree for the padded dot; repad if quantum differs
     ad, bd = a._data, b._data
-    if transpose_a:
-        ad = ad  # transposed inside kernel
     inner_a = ad.shape[0] if transpose_a else ad.shape[1]
     inner_b = bd.shape[1] if transpose_b else bd.shape[0]
     if inner_a != inner_b:
@@ -75,9 +88,6 @@ def matmul(a: Array, b: Array, transpose_a: bool = False,
         else:
             bd = _grow(bd, (pad_to, bd.shape[1]))
     out = _matmul_kernel(ad, bd, transpose_a, transpose_b, a_shape, b_shape)
-    out_shape = (a_shape[0], b_shape[1])
-    reg = (a._reg_shape[1] if transpose_a else a._reg_shape[0],
-           b._reg_shape[0] if transpose_b else b._reg_shape[1])
     return Array(_crop_or_keep(out, out_shape), out_shape, reg, False)
 
 
@@ -113,7 +123,7 @@ def kron(a: Array, b: Array, block_size=None) -> Array:
     return Array(out, shape, reg_shape=block_size)
 
 
-@partial(jax.jit, static_argnames=("shapes", "pshape"))
+@partial(_pjit, static_argnames=("shapes", "pshape"), name="kron")
 def _kron_kernel(ap, bp, shapes, pshape):
     (ma, na), (mb, nb) = shapes
     av, bv = ap[:ma, :na], bp[:mb, :nb]
@@ -188,7 +198,8 @@ def svd(a: Array, compute_uv: bool = True, sort: bool = True,
     return (u_arr, s_arr, v_arr)
 
 
-@partial(jax.jit, static_argnames=("n_valid", "sort", "max_sweeps"))
+@partial(_pjit, static_argnames=("n_valid", "sort", "max_sweeps"),
+         name="jacobi_svd")
 @precise
 def _jacobi_svd(a, n_valid, sort, eps, max_sweeps):
     m, n = a.shape
@@ -255,7 +266,8 @@ def _jacobi_svd(a, n_valid, sort, eps, max_sweeps):
 _JACOBI_BLOCK = 64
 
 
-@partial(jax.jit, static_argnames=("n_valid", "sort", "max_sweeps"))
+@partial(_pjit, static_argnames=("n_valid", "sort", "max_sweeps"),
+         name="jacobi_svd_block")
 @precise
 def _jacobi_svd_block(a, n_valid, sort, eps, max_sweeps):
     """One-sided BLOCK Jacobi: round-robin over column blocks of width b.

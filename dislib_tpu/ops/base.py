@@ -36,12 +36,27 @@ def distances_sq(a, b, precision=None):
     and rows of `b` (k, d): one GEMM + norms (‖a‖² − 2a·bᵀ + ‖b‖²), clamped
     at zero against cancellation.
 
+    Dense ds-array operands return a ds-array and join the dispatch-fusion
+    graph (`data/array.py`): the distance GEMM rides the operands' deferred
+    chains and dispatches with the first force — under ``DSLIB_EAGER=1`` it
+    is one dedicated kernel dispatch instead.
+
     ``precision=None`` inherits the enclosing scope's matmul precision —
     inside the library's kernels that is the float32-faithful scope set by
     :func:`precise`.  At TPU-native bf16 the cross-term error (~‖x‖²/256)
     dwarfs ε-thresholds — a point's distance to ITSELF comes out ≫ 0,
     breaking radius comparisons (DBSCAN/Daura) — so callers outside a
     ``precise`` kernel should pass an explicit precision."""
+    import importlib
+    # deferred import, cycle-free at load; the data package re-exports an
+    # `array` FUNCTION, so resolve the module by its dotted name
+    _arr = importlib.import_module("dislib_tpu.data.array")
+    if isinstance(a, _arr.Array) or isinstance(b, _arr.Array):
+        if not (type(a) is _arr.Array and type(b) is _arr.Array):
+            raise TypeError(
+                "distances_sq over ds-arrays needs BOTH operands as dense "
+                f"Arrays, got {type(a).__name__} and {type(b).__name__}")
+        return _arr._array_distances(a, b, precision)
     a_sq = jnp.sum(a * a, axis=1, keepdims=True)
     b_sq = jnp.sum(b * b, axis=1)
     cross = jnp.matmul(a, b.T, precision=precision)

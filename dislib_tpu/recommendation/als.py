@@ -45,6 +45,7 @@ from dislib_tpu.ops.base import precise
 from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows, \
     raise_if_preempted as _raise_if_preempted
 from dislib_tpu.utils.dlog import verbose_logger
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
 
 class ALS(BaseEstimator):
@@ -172,18 +173,25 @@ class ALS(BaseEstimator):
             it += int(n_done)
             rmse = float(rmse_dev)
             conv = bool(conv_dev)
-            history.extend(np.asarray(jax.device_get(hist))[: int(n_done)])
+            history.extend(_fetch(hist)[: int(n_done)])
             log.info("iter %d: rmse=%.6g", it, rmse)
             state = (u, v, rmse)
             if checkpoint is not None:
-                checkpoint.save({"users": _fetch(u), "items": _fetch(v),
-                                 "m": x.shape[0], "n": x.shape[1],
-                                 "rmse": rmse, "n_iter": it,
-                                 "converged": conv})
+                # the factors are DONATED to the next chunk's kernel call
+                # (their HBM is reused in place), so their device->host
+                # copies must land before that dispatch: fetch blocking,
+                # and offload only the checksum+write to the snapshot
+                # worker (it still overlaps the next chunk's compute)
+                checkpoint.save_async({
+                    "users": _fetch(u), "items": _fetch(v),
+                    "m": x.shape[0], "n": x.shape[1],
+                    "rmse": rmse, "n_iter": it, "converged": conv})
                 if not conv and it < self.max_iter:  # work left only
                     _raise_if_preempted(checkpoint)
             if checkpoint is None:
                 break
+        if checkpoint is not None:
+            checkpoint.flush()
         u, v, _ = state
         m, n = x.shape
         self.users_ = np.asarray(jax.device_get(u))[:m]
@@ -306,7 +314,12 @@ def _solve_factors(r, mask, v, lambda_, n_f):
     return jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
 
 
-@partial(jax.jit, static_argnames=("shape", "n_f", "max_iter"))
+# init_state (the resumed/chunked factor carries) is DONATED: XLA aliases
+# u0/v0 to the output factors and reuses their HBM in place instead of
+# double-buffering the two largest arrays of the fit (round-7 perf PR).
+# Callers never reuse a passed init_state afterwards.
+@partial(_pjit, static_argnames=("shape", "n_f", "max_iter"),
+         donate_argnames=("init_state",), name="als_fit")
 @precise
 def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed,
              init_state=None):
@@ -346,7 +359,8 @@ def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed,
     return u, v, cur, n_iter, conv, hist
 
 
-@partial(jax.jit, static_argnames=("m", "n", "n_f", "max_iter"))
+@partial(_pjit, static_argnames=("m", "n", "n_f", "max_iter"),
+         donate_argnames=("init_state",), name="als_fit_sparse")
 @precise
 def _als_fit_sparse(rows, cols, vals, trows, tcols, tvals, m, n, n_f,
                     lambda_, tol, max_iter, seed, init_state=None):

@@ -21,7 +21,7 @@ driving ``tests/test_resilience.py`` is ``dislib_tpu.utils.faults``.
 """
 
 from dislib_tpu.runtime import xla_flags  # noqa: F401
-from dislib_tpu.runtime.elastic import fetch, repad_rows
+from dislib_tpu.runtime.elastic import AsyncFetch, fetch, repad_rows
 from dislib_tpu.runtime.preemption import (
     Preempted, PreemptionWatcher, clear_preemption, last_signal,
     preemption_requested, raise_if_preempted, request_preemption,
@@ -33,6 +33,6 @@ __all__ = [
     "request_preemption", "clear_preemption", "last_signal",
     "raise_if_preempted",
     "Retry", "retry_call", "is_transient_error",
-    "repad_rows", "fetch",
+    "repad_rows", "fetch", "AsyncFetch",
     "xla_flags",
 ]

@@ -13,14 +13,19 @@ An 8-device snapshot restores onto a 4-device or 2-D mesh this way.
 
 :func:`fetch` is the host↔device transfer boundary with the
 transient-failure :class:`~dislib_tpu.runtime.retry.Retry` policy applied
-— the read every snapshot goes through.
+— the read every snapshot goes through.  ``fetch(x, blocking=False)``
+returns an :class:`AsyncFetch` handle instead: the device→host copy is
+enqueued immediately (before any later dispatch), but the blocking
+resolution happens at ``result()`` — on the snapshot worker thread for
+``FitCheckpoint.save_async``, so the copy and the file write overlap the
+next chunk's compute instead of stalling the fit loop (round-7 perf PR).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["repad_rows", "fetch"]
+__all__ = ["repad_rows", "fetch", "AsyncFetch"]
 
 
 def repad_rows(a, logical: int, target: int, axis: int = 0):
@@ -48,10 +53,64 @@ def repad_rows(a, logical: int, target: int, axis: int = 0):
     return np.pad(a, pad)
 
 
-def fetch(x) -> np.ndarray:
+class AsyncFetch:
+    """Deferred device→host read started by ``fetch(x, blocking=False)``.
+
+    The copy is enqueued at construction (``copy_to_host_async``) so it
+    runs concurrently with whatever the caller dispatches next;
+    :meth:`result` blocks until the bytes are on host (retried under the
+    default transient policy) and caches the ndarray.
+
+    NOT safe for buffers a later kernel call DONATES: donation
+    invalidates the device buffer at dispatch time, before an un-resolved
+    copy may have landed.  Estimators whose snapshot state is also a
+    donated loop carry (ALS factors, GMM parameters, forest node arrays)
+    fetch those blocking and overlap only the file write.
+    """
+
+    def __init__(self, x):
+        self._x = x
+        self._value = None
+        self._resolved = False
+        try:
+            x.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass                        # host values / exotic backends
+
+    def result(self) -> np.ndarray:
+        if not self._resolved:
+            import jax
+
+            from dislib_tpu.runtime.retry import Retry
+            try:
+                self._value = Retry.from_env().call(
+                    lambda: np.asarray(jax.device_get(self._x)))
+            except RuntimeError as e:
+                if "deleted" in str(e) or "donated" in str(e):
+                    raise RuntimeError(
+                        "async fetch source buffer was donated before the "
+                        "copy resolved — snapshot donated loop carries with "
+                        "fetch(x, blocking=True) (see the user guide's "
+                        "'Dispatch, fusion & donation' section)") from e
+                raise
+            self._resolved = True
+            self._x = None
+        return self._value
+
+
+def fetch(x, blocking: bool = True):
     """Device→host read (``jax.device_get`` → ndarray) with transient
     failures retried under the env-tunable default policy — the snapshot
-    write path's half of the host↔device boundary."""
+    write path's half of the host↔device boundary.
+
+    A ds-array input is a force point: its deferred op chain runs as one
+    program before the copy.  ``blocking=False`` returns an
+    :class:`AsyncFetch` whose copy overlaps later host work;
+    ``FitCheckpoint.save`` resolves such handles at write time."""
+    if hasattr(x, "_data"):             # ds-array → padded device backing
+        x = x._data
+    if not blocking:
+        return AsyncFetch(x)
     import jax
 
     from dislib_tpu.runtime.retry import Retry

@@ -79,12 +79,17 @@ def last_signal() -> int | None:
 
 
 def raise_if_preempted(checkpoint=None) -> None:
-    """Estimator hook: call right AFTER a snapshot lands, at the chunk
-    boundary.  Raises :class:`Preempted` when the flag is set; no-op
-    otherwise.  The snapshot-first ordering is what makes the raise safe:
-    whatever is on disk at raise time is a complete resume point."""
+    """Estimator hook: call right AFTER a snapshot lands (or its async
+    write starts), at the chunk boundary.  Raises :class:`Preempted` when
+    the flag is set; no-op otherwise.  The snapshot-first ordering is what
+    makes the raise safe: an in-flight ``save_async`` is flushed before
+    raising, so whatever is on disk at raise time is a complete resume
+    point."""
     if not preemption_requested():
         return
+    flush = getattr(checkpoint, "flush", None)
+    if flush is not None:
+        flush()                         # async snapshot must land first
     path = getattr(checkpoint, "path", None)
     msg = "fit preempted at a chunk boundary"
     if path:

@@ -39,6 +39,7 @@ from jax import lax
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
 from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows, \
     preemption_requested as _preemption_requested, \
     raise_if_preempted as _raise_if_preempted
@@ -156,9 +157,15 @@ def _level_step(node, bx, w, stats, key, n_nodes, try_features, min_gain,
     return feat, tbin, is_split, new_node, totals
 
 
-# one jitted step per (level-shape, config); vmapped over the whole forest
-@partial(jax.jit, static_argnames=("n_nodes", "try_features", "criterion",
-                                   "n_bins"))
+# one jitted step per (level-shape, config); vmapped over the whole forest.
+# `node` (the (T, m_pad) per-sample node assignment) is DONATED: it aliases
+# the returned new_node, so level growth updates the forest's largest
+# carried array in place instead of double-buffering it.  The loop rebinds
+# `node` to the output each level and never touches the old buffer (snapshot
+# fetches read the NEW node, blocking, before the next level dispatches).
+@partial(_pjit, static_argnames=("n_nodes", "try_features", "criterion",
+                                 "n_bins"),
+         donate_argnames=("node",), name="forest_level")
 def _forest_level(node, bx, w, stats, keys, n_nodes, try_features,
                   min_gain, criterion, n_bins):
     step = partial(_level_step, n_nodes=n_nodes, try_features=try_features,
@@ -167,7 +174,7 @@ def _forest_level(node, bx, w, stats, keys, n_nodes, try_features,
         node, bx, w, stats, keys)
 
 
-@partial(jax.jit, static_argnames=("n_leaves",))
+@partial(_pjit, static_argnames=("n_leaves",), name="leaf_stats")
 def _leaf_stats(node, w, stats, n_leaves):
     """Final-level per-leaf stat sums: (T, n_leaves, S)."""
     def one(nd, wt):
@@ -176,7 +183,7 @@ def _leaf_stats(node, w, stats, n_leaves):
     return jax.vmap(one)(node, w)
 
 
-@partial(jax.jit, static_argnames=("depth", "q_shape"))
+@partial(_pjit, static_argnames=("depth", "q_shape"), name="forest_apply")
 def _forest_apply(qp, q_shape, edges, feats, tbins, depth):
     """Leaf index of every query row in every tree: (T, mq_pad)."""
     bq = _bin_data(qp, q_shape, edges)                # (mq_pad, n)
@@ -324,12 +331,15 @@ class _BaseTreeEnsemble(BaseEstimator):
         try_features = self._try_features_count(n)
 
         def _snap(lvl_next):
+            # node is donated to the next level's kernel — its copy must
+            # land on host before that dispatch (blocking fetch); only the
+            # checksum+file write moves to the snapshot worker
             state = {"lvl": lvl_next, "seed": seed, "fp": fp,
                      "digest": digest, "node": _fetch(node), "w": _fetch(w)}
             for i, (f_, t_) in enumerate(zip(feats, tbins)):
                 state[f"feats_{i}"] = _fetch(f_)
                 state[f"tbins_{i}"] = _fetch(t_)
-            checkpoint.save(state)
+            checkpoint.save_async(state)
 
         for lvl in range(start_lvl, depth):
             key, k_lvl = jax.random.split(key)
@@ -350,6 +360,8 @@ class _BaseTreeEnsemble(BaseEstimator):
                     _snap(lvl + 1)
                     _raise_if_preempted(checkpoint)
 
+        if checkpoint is not None:
+            checkpoint.flush()          # last level snapshot lands
         leaves = _leaf_stats(node, w, stats, 2 ** depth)
         # feats/tbins stay as the ragged per-level device arrays: packing
         # here would dispatch eager multi-device pad/stack programs while

@@ -90,23 +90,41 @@ def _enc(obj, out: bytearray) -> None:
 
 
 # -- decoding ---------------------------------------------------------------
+#
+# Every length/argument read is BOUNDS-CHECKED: a truncated file used to
+# surface as an IndexError from `mv[off]`, or worse, a short `mv[off:off+n]`
+# slice silently decoding to a wrong (smaller) length argument — the
+# "length decode" failure class.  All damage now raises ValueError with a
+# position, which `saving.load_model` wraps into a clear model-file error.
+
+def _need(mv, off, n):
+    if off + n > len(mv):
+        raise ValueError(
+            f"truncated CBOR: need {n} byte(s) at offset {off}, "
+            f"have {len(mv) - off}")
+
 
 def _arg(mv, off, info):
     if info < 24:
         return info, off
     if info == 24:
+        _need(mv, off, 1)
         return mv[off], off + 1
     if info == 25:
+        _need(mv, off, 2)
         return int.from_bytes(mv[off:off + 2], "big"), off + 2
     if info == 26:
+        _need(mv, off, 4)
         return int.from_bytes(mv[off:off + 4], "big"), off + 4
     if info == 27:
+        _need(mv, off, 8)
         return int.from_bytes(mv[off:off + 8], "big"), off + 8
     raise ValueError(f"unsupported CBOR additional info {info} "
                      "(indefinite lengths are out of scope)")
 
 
 def _dec(mv, off):
+    _need(mv, off, 1)
     ib = mv[off]; off += 1
     major, info = ib >> 5, ib & 0x1F
     if major == 0:
@@ -116,9 +134,11 @@ def _dec(mv, off):
         return -1 - n, off
     if major == 2:
         n, off = _arg(mv, off, info)
+        _need(mv, off, n)
         return bytes(mv[off:off + n]), off + n
     if major == 3:
         n, off = _arg(mv, off, info)
+        _need(mv, off, n)
         return bytes(mv[off:off + n]).decode("utf-8"), off + n
     if major == 4:
         n, off = _arg(mv, off, info)
@@ -143,10 +163,13 @@ def _dec(mv, off):
         if info in (22, 23):          # null / undefined
             return None, off
         if info == 25:
+            _need(mv, off, 2)
             return float(struct.unpack(">e", mv[off:off + 2])[0]), off + 2
         if info == 26:
+            _need(mv, off, 4)
             return float(struct.unpack(">f", mv[off:off + 4])[0]), off + 4
         if info == 27:
+            _need(mv, off, 8)
             return float(struct.unpack(">d", mv[off:off + 8])[0]), off + 8
         raise ValueError(f"unsupported CBOR simple value {info}")
     raise ValueError(f"unsupported CBOR major type {major} (tags are out "

@@ -25,6 +25,7 @@ mid-write (or mid-rotation) never costs more than one generation.
 from __future__ import annotations
 
 import os
+import threading
 import warnings
 import zipfile
 import zlib
@@ -95,6 +96,20 @@ def _load_verified(path: str) -> dict:
     return state
 
 
+class _PendingSave:
+    """Handle for one in-flight `save_async`; `wait()` blocks until the
+    snapshot is on disk and re-raises any write-side failure."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._exc: BaseException | None = None
+
+    def wait(self) -> None:
+        self._done.wait()
+        if self._exc is not None:
+            raise self._exc
+
+
 class FitCheckpoint:
     """Snapshot/restore of in-flight fit state.
 
@@ -105,12 +120,23 @@ class FitCheckpoint:
     every : int, default 10 — checkpoint every `every` iterations.
     keep : int, default 2 — generations retained; ``load()`` falls back
         to the newest generation that verifies.
+
+    ``save`` blocks until the snapshot is on disk; checkpointed fit loops
+    use :meth:`save_async` instead, which runs the SAME save (device→host
+    resolution of any ``AsyncFetch`` values, checksum, atomic write,
+    rotation) on a worker thread so it overlaps the next chunk's device
+    compute.  At most one write is in flight per checkpoint — the next
+    ``save_async`` (and ``load``/``delete``/:meth:`flush`) waits for it
+    first, so generation rotation order and the crash-consistency
+    guarantees are exactly those of the blocking path.
     """
 
     def __init__(self, path: str, every: int = 10, keep: int = 2):
         self.path = str(path)
         self.every = int(every)
         self.keep = int(keep)
+        self._pending: _PendingSave | None = None
+        self._pending_thread: threading.Thread | None = None
         if self.every < 1:
             raise ValueError("every must be >= 1")
         if self.keep < 1:
@@ -118,6 +144,47 @@ class FitCheckpoint:
 
     def _gen_path(self, i: int) -> str:
         return self.path if i == 0 else f"{self.path}.{i}"
+
+    def save_async(self, state: dict) -> _PendingSave:
+        """Start :meth:`save` on a worker thread and return immediately.
+
+        Waits for any previous in-flight save first (writes never
+        reorder), then hands ``state`` — ndarrays, scalars, or
+        ``AsyncFetch`` handles whose device→host copies are already in
+        flight — to the worker.  A failed write surfaces at the next
+        ``flush()``/``save_async()``/``load()``, i.e. still inside
+        ``fit``."""
+        self.flush()
+        pending = _PendingSave()
+
+        def run():
+            try:
+                self.save(state)
+            except BaseException as e:  # noqa: BLE001 — re-raised at flush
+                pending._exc = e
+            finally:
+                pending._done.set()
+
+        worker = threading.Thread(target=run, name="dslib-snapshot",
+                                  daemon=True)
+        self._pending = pending
+        self._pending_thread = worker
+        worker.start()
+        return pending
+
+    def flush(self) -> None:
+        """Block until the in-flight `save_async` (if any) is on disk;
+        re-raises its failure.  Estimators call this at fit exit and
+        before raising `Preempted`, so the snapshot-first contract holds
+        with the write off the hot path.  A no-op on the snapshot worker
+        itself (its `save` re-enters here and must not wait on its own
+        completion)."""
+        if self._pending_thread is threading.current_thread():
+            return
+        pending, self._pending = self._pending, None
+        self._pending_thread = None
+        if pending is not None:
+            pending.wait()
 
     def save(self, state: dict) -> None:
         """Atomically persist a dict of ndarrays/scalars, embedding a
@@ -130,7 +197,13 @@ class FitCheckpoint:
         between renames leaves every file a complete snapshot of SOME
         generation — `load()` takes the newest that verifies."""
         import tempfile
-        arrs = {k: np.asarray(v) for k, v in state.items()}
+        # mixing the blocking and async APIs on one checkpoint must not
+        # race the rotation chain: wait out any in-flight async write
+        # first (no-op when this call IS the async worker's)
+        self.flush()
+        from dislib_tpu.runtime.elastic import AsyncFetch
+        arrs = {k: np.asarray(v.result() if isinstance(v, AsyncFetch) else v)
+                for k, v in state.items()}
         if _CRC_KEY in arrs:
             raise ValueError(f"{_CRC_KEY!r} is a reserved snapshot key")
         arrs[_CRC_KEY] = np.asarray([_state_crc(arrs)], np.uint32)
@@ -158,6 +231,7 @@ class FitCheckpoint:
         file falls back (with a warning) to the previous generation;
         :class:`SnapshotCorrupt` raises only when EVERY generation on disk
         is damaged."""
+        self.flush()                    # never read around an in-flight write
         seen = 0
         first_err: SnapshotCorrupt | None = None
         bad: list[str] = []
@@ -197,6 +271,7 @@ class FitCheckpoint:
             "the fit from scratch") from first_err
 
     def delete(self) -> None:
+        self.flush()
         for i in range(self.keep):
             p = self._gen_path(i)
             if os.path.exists(p):

@@ -15,11 +15,20 @@ profiler hooks the runtime):
   Extrae user-events analog.  Estimators wrap their phases with it.
 - `op_graph(fn, *args)` — compiled-HLO text of a jitted function — the
   `--graph` task-DAG analog.
+- dispatch/retrace counters (round-7 fusion PR): every library kernel is
+  wrapped by :func:`profiled_jit`, which counts one *dispatch* per call
+  and one *trace* per (re)compilation.  `dispatch_count()` is how the
+  fusion layer's "a chain of ops is ONE XLA program" claim becomes a
+  measured number (and a test assertion), and `trace_count()` is the
+  retrace guard — a cache-key regression shows up as extra traces, not
+  as a silent 20-second recompile on chip.
 """
 
 from __future__ import annotations
 
 import contextlib
+import functools
+import threading
 
 import jax
 
@@ -53,6 +62,94 @@ def annotate(name: str):
 def op_graph(fn, *args, **kwargs) -> str:
     """Compiled-HLO text of `fn(*args)` — the task-DAG dump analog."""
     return jax.jit(fn).lower(*args, **kwargs).compile().as_text()
+
+
+# ---------------------------------------------------------------------------
+# dispatch / retrace counters
+# ---------------------------------------------------------------------------
+
+class _Counters:
+    """Process-wide dispatch/trace tallies, total and per kernel name."""
+
+    __slots__ = ("dispatches", "traces", "dispatch_by", "trace_by")
+
+    def __init__(self):
+        self.dispatches = 0
+        self.traces = 0
+        self.dispatch_by: dict[str, int] = {}
+        self.trace_by: dict[str, int] = {}
+
+
+_COUNTERS = _Counters()
+_COUNTERS_LOCK = threading.Lock()
+
+
+def profiled_jit(fn=None, *, name: str | None = None, **jit_kwargs):
+    """``jax.jit`` plus the library's dispatch/retrace counters.
+
+    Every call of the returned function counts one dispatch; every run of
+    the traced Python body (i.e. a compilation-cache miss, including AOT
+    lowering) counts one trace, both under ``name`` (default: the
+    function's ``__name__``).  All remaining keyword arguments —
+    ``static_argnames``, ``donate_argnames``, ... — pass through to
+    ``jax.jit`` unchanged.  The underlying jitted callable is exposed as
+    ``.jitted`` for ``.lower()``-style AOT access.
+    """
+    if fn is None:
+        return lambda f: profiled_jit(f, name=name, **jit_kwargs)
+    label = name or getattr(fn, "__name__", "jit")
+
+    @functools.wraps(fn)
+    def traced(*args, **kwargs):
+        with _COUNTERS_LOCK:
+            _COUNTERS.traces += 1
+            _COUNTERS.trace_by[label] = _COUNTERS.trace_by.get(label, 0) + 1
+        return fn(*args, **kwargs)
+
+    jitted = jax.jit(traced, **jit_kwargs)
+
+    @functools.wraps(fn)
+    def dispatch(*args, **kwargs):
+        with _COUNTERS_LOCK:
+            _COUNTERS.dispatches += 1
+            _COUNTERS.dispatch_by[label] = \
+                _COUNTERS.dispatch_by.get(label, 0) + 1
+        return jitted(*args, **kwargs)
+
+    dispatch.jitted = jitted
+    dispatch.lower = jitted.lower       # AOT access (HLO audits) counts a
+    dispatch.eval_shape = jitted.eval_shape  # trace, never a dispatch
+    dispatch.profiled_name = label
+    return dispatch
+
+
+def dispatch_count() -> int:
+    """Total library-kernel dispatches since the last `reset_counters()`."""
+    return _COUNTERS.dispatches
+
+
+def trace_count() -> int:
+    """Total library-kernel (re)compilations since `reset_counters()`."""
+    return _COUNTERS.traces
+
+
+def counters() -> dict:
+    """Snapshot of the tallies: ``{dispatches, traces, dispatch_by,
+    trace_by}`` with per-kernel-name breakdowns (plain dict copies)."""
+    with _COUNTERS_LOCK:
+        return {"dispatches": _COUNTERS.dispatches,
+                "traces": _COUNTERS.traces,
+                "dispatch_by": dict(_COUNTERS.dispatch_by),
+                "trace_by": dict(_COUNTERS.trace_by)}
+
+
+def reset_counters() -> None:
+    """Zero the dispatch/trace tallies (tests and bench regions)."""
+    with _COUNTERS_LOCK:
+        _COUNTERS.dispatches = 0
+        _COUNTERS.traces = 0
+        _COUNTERS.dispatch_by.clear()
+        _COUNTERS.trace_by.clear()
 
 
 def memory_stats():

@@ -19,6 +19,8 @@ from __future__ import annotations
 import base64
 import importlib
 import json
+import struct
+import zipfile
 
 import numpy as np
 
@@ -132,7 +134,12 @@ def save_model(model, filepath: str, overwrite: bool = True,
             _cbor().dump(state, f)
     elif save_format == "npz":
         flat = json.dumps(state).encode()
-        np.savez_compressed(filepath, state=np.frombuffer(flat, dtype=np.uint8))
+        # write through the open file handle: np.savez_compressed APPENDS
+        # ".npz" to a bare path, silently saving `model` as `model.npz`
+        # and breaking the load_model round trip for any other extension
+        with open(filepath, "wb") as f:
+            np.savez_compressed(
+                f, state=np.frombuffer(flat, dtype=np.uint8))
     else:
         raise ValueError(f"unknown save_format {save_format!r}")
 
@@ -150,9 +157,22 @@ def load_model(filepath: str, load_format: str | None = None):
             state = json.load(f)
     elif load_format == "cbor":
         with open(filepath, "rb") as f:
-            state = _cbor().load(f)
+            try:
+                state = _cbor().load(f)
+            except (ValueError, struct.error, UnicodeDecodeError) as e:
+                raise ValueError(
+                    f"{filepath} is not a dislib_tpu cbor model (truncated "
+                    f"or foreign file: {e})") from e
     elif load_format == "npz":
-        raw = np.load(filepath)["state"].tobytes()
+        # allow_pickle stays OFF explicitly: a model file must never be a
+        # pickle-execution vector, and the payload is a plain uint8 buffer
+        try:
+            with np.load(filepath, allow_pickle=False) as z:
+                raw = z["state"].tobytes()
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile) as e:
+            raise ValueError(
+                f"{filepath} is not a dislib_tpu npz model (truncated, "
+                f"foreign, or pickled file: {e})") from e
         state = json.loads(raw.decode())
     else:
         raise ValueError(f"unknown load_format {load_format!r}")

@@ -1,0 +1,170 @@
+"""Async snapshot offload (round-7 perf PR): `FitCheckpoint.save_async`
+runs the device→host resolution + checksum + atomic write on a worker
+thread, so the fit loop's next chunk dispatches while the previous
+snapshot is still being written — PR 1 made these saves synchronous on
+the hot path; this pins the overlap AND that every crash-consistency
+property survived the move.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import KMeans
+from dislib_tpu.utils import FitCheckpoint, faults
+from dislib_tpu.utils.checkpoint import _load_verified
+
+
+def _blobs(rng, n=210, d=4, k=3):
+    centers = rng.rand(k, d) * 10
+    x = np.vstack([centers[i] + 0.3 * rng.randn(n // k, d)
+                   for i in range(k)])
+    return x.astype(np.float32)
+
+
+class TestOverlap:
+    def test_next_chunk_dispatches_while_write_in_flight(
+            self, rng, tmp_path, monkeypatch):
+        """The acceptance assertion: with a deliberately slow writer, the
+        fit loop's next device chunk starts BEFORE the previous snapshot
+        write finishes — `save` no longer blocks the loop."""
+        import dislib_tpu.cluster.kmeans as km_mod
+        events = []
+
+        class SlowWrite(FitCheckpoint):
+            def save(self, state):
+                events.append(("write_start", time.monotonic()))
+                time.sleep(0.25)            # slow disk stand-in
+                super().save(state)
+                events.append(("write_end", time.monotonic()))
+
+        real_fit = km_mod._kmeans_fit
+
+        def spying_fit(*args, **kwargs):
+            events.append(("chunk_start", time.monotonic()))
+            return real_fit(*args, **kwargs)
+
+        monkeypatch.setattr(km_mod, "_kmeans_fit", spying_fit)
+        x = ds.array(_blobs(rng))
+        path = str(tmp_path / "km.npz")
+        KMeans(n_clusters=3, max_iter=6, tol=0.0, random_state=0).fit(
+            x, checkpoint=SlowWrite(path, every=2))
+
+        writes = [(t, e) for e, t in events if e.startswith("write")]
+        chunks = [t for e, t in events if e == "chunk_start"]
+        assert len(chunks) == 3 and len(writes) == 6
+        # some chunk must start inside a (write_start, write_end) window
+        spans = list(zip(sorted(t for t, e in writes if e == "write_start"),
+                         sorted(t for t, e in writes if e == "write_end")))
+        overlapped = any(s < c < e for c in chunks for s, e in spans)
+        assert overlapped, (
+            f"no chunk dispatched during a snapshot write — the save "
+            f"blocked the loop (events: {events})")
+        # and the final snapshot still landed before fit returned
+        snap = FitCheckpoint(path, every=2).load()
+        assert int(snap["n_iter"]) == 6 and bool(snap["converged"]) is False
+
+    def test_fit_result_identical_to_sync_saves(self, rng, tmp_path):
+        """Offloading the write must not change the fit itself."""
+        x_np = _blobs(rng)
+        init = np.ascontiguousarray(x_np[[0, 70, 140]])
+        plain = KMeans(n_clusters=3, init=init, max_iter=6, tol=0.0) \
+            .fit(ds.array(x_np))
+        ck = FitCheckpoint(str(tmp_path / "a.npz"), every=2)
+        chunked = KMeans(n_clusters=3, init=init, max_iter=6, tol=0.0) \
+            .fit(ds.array(x_np), checkpoint=ck)
+        np.testing.assert_allclose(chunked.centers_, plain.centers_,
+                                   rtol=1e-5)
+        assert chunked.n_iter_ == plain.n_iter_
+
+
+class TestAsyncFetch:
+    def test_resolves_and_caches(self):
+        import jax.numpy as jnp
+        from dislib_tpu.runtime import AsyncFetch, fetch
+        x = jnp.arange(12.0).reshape(3, 4)
+        h = fetch(x, blocking=False)
+        assert isinstance(h, AsyncFetch)
+        v = h.result()
+        np.testing.assert_array_equal(v, np.arange(12.0).reshape(3, 4))
+        assert h.result() is v               # cached ndarray
+
+    def test_forces_lazy_ds_array(self, rng):
+        from dislib_tpu.runtime import fetch
+        x = rng.rand(8, 8).astype(np.float32)
+        a = ds.array(x) * 2.0
+        assert a.is_lazy
+        h = fetch(a, blocking=False)
+        assert not a.is_lazy                 # fetch is a force point
+        np.testing.assert_allclose(h.result()[:8, :8], x * 2.0, rtol=1e-6)
+
+    def test_retries_transient_failures(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+        from dislib_tpu.runtime import fetch
+        monkeypatch.setenv("DSLIB_RETRY_BACKOFF", "0")
+        x = jnp.ones((4, 4))
+        h = fetch(x, blocking=False)
+        flaky = faults.FlakyCall(jax.device_get, failures=1)
+        monkeypatch.setattr(jax, "device_get", flaky)
+        np.testing.assert_array_equal(h.result(), np.ones((4, 4)))
+        assert flaky.calls == 2              # one injected failure + retry
+
+
+class TestAsyncSemantics:
+    def test_writes_never_reorder(self, tmp_path):
+        path = str(tmp_path / "s.npz")
+        ck = FitCheckpoint(path, every=1, keep=2)
+        ck.save_async({"v": np.asarray([1])})
+        ck.save_async({"v": np.asarray([2])})
+        ck.flush()
+        assert int(ck.load()["v"][0]) == 2
+        assert int(_load_verified(path + ".1")["v"][0]) == 1
+
+    def test_write_failure_surfaces_at_flush(self, tmp_path):
+        class Boom(FitCheckpoint):
+            def save(self, state):
+                raise OSError(28, "injected: no space left on device")
+
+        ck = Boom(str(tmp_path / "b.npz"))
+        ck.save_async({"v": np.asarray([1])})
+        with pytest.raises(OSError, match="no space"):
+            ck.flush()
+        ck.save_async({"v": np.asarray([1])})   # next one re-arms cleanly
+        with pytest.raises(OSError):
+            ck.save_async({"v": np.asarray([2])})
+
+    def test_load_and_delete_wait_for_pending(self, tmp_path):
+        gate = threading.Event()
+
+        class Gated(FitCheckpoint):
+            def save(self, state):
+                gate.wait(5.0)
+                super().save(state)
+
+        path = str(tmp_path / "g.npz")
+        ck = Gated(path, keep=1)
+        ck.save_async({"v": np.asarray([7])})
+        assert not os.path.exists(path)      # still gated
+        gate.set()
+        assert int(ck.load()["v"][0]) == 7   # load flushed first
+        ck.delete()
+        assert not os.path.exists(path)
+
+    def test_fault_callback_fires_on_worker(self, tmp_path):
+        """`CallbackCheckpoint` semantics survive the offload: the callback
+        runs right after the n-th snapshot reaches disk (now on the worker
+        thread), before the next save_async can start."""
+        fired = []
+        ck = faults.CallbackCheckpoint(
+            str(tmp_path / "c.npz"), after=2,
+            callback=lambda: fired.append(os.path.exists(
+                str(tmp_path / "c.npz"))))
+        ck.save_async({"v": np.asarray([1])})
+        ck.save_async({"v": np.asarray([2])})
+        ck.flush()
+        assert fired == [True]               # fired once, file on disk

@@ -1,0 +1,134 @@
+"""Host-sync lint (round-7 fusion PR satellite, the `test_xla_flags_policy`
+pattern): estimator iteration loops must not read device values back to
+host except through the blessed boundaries — `runtime.fetch` (retried,
+async-capable, a fusion force point) or an explicit `force()`.
+
+The per-dispatch host RTT on this rig is ~70 ms (BENCH_local_r05): ONE
+stray `jax.device_get` / `float(device_scalar)` / `np.asarray(device_val)`
+inside a fit loop reintroduces a per-iteration sync and silently costs
+5-500x on chip.  This lint makes that a CPU test failure instead.
+
+Policy, enforced by AST scan of the estimator packages:
+
+1. inside any `for`/`while` loop, the raw sync spellings — `.device_get`,
+   `np.asarray`, `.collect()`, `.block_until_ready()`, `float(<non-const>)`
+   — are flagged; `fetch`/`_fetch` never is (it IS the blessed boundary);
+2. flagged sites must be on the explicit allowlist below.  Every entry is
+   a CHUNK-boundary loop (one sync per k-iteration device chunk, next to
+   its snapshot) or the deliberately host-orchestrated irregular tier
+   (cascade merges, async-trial collection) — NOT a per-iteration sync.
+   Adding a new site means consciously extending the list with a reason.
+"""
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ESTIMATOR_DIRS = (
+    "dislib_tpu/cluster",
+    "dislib_tpu/classification",
+    "dislib_tpu/recommendation",
+    "dislib_tpu/trees",
+    "dislib_tpu/regression",
+    "dislib_tpu/decomposition",
+    "dislib_tpu/neighbors",
+    "dislib_tpu/optimization",
+    "dislib_tpu/model_selection",
+)
+
+# (file, enclosing function) pairs allowed to host-sync inside a loop,
+# each with the reason it is a boundary and not a per-iteration sync.
+ALLOWLIST = {
+    # chunked fit loops: one sync per k-iteration device chunk, at the
+    # snapshot/convergence boundary (float of the chunk's scalars)
+    ("dislib_tpu/cluster/kmeans.py", "fit"),
+    ("dislib_tpu/cluster/gm.py", "fit"),
+    ("dislib_tpu/recommendation/als.py", "fit"),
+    # (dbscan/daura's checkpointed rounds sync ONLY through runtime.fetch
+    # now, so they need no entry — the lint's desired end state)
+    # cascade SVM: the irregular tier — level merges are host-planned by
+    # design (SURVEY §3.3), one sync per cascade level, never per solver
+    # iteration (those run in lax.while_loop on device)
+    ("dislib_tpu/classification/csvm.py", "fit"),
+    ("dislib_tpu/classification/csvm.py", "_merge_level"),
+    ("dislib_tpu/classification/csvm.py", "k_of"),
+    ("dislib_tpu/classification/csvm.py", "_solve_level_batched"),
+    # async-trial grid search: block_until_ready/float AFTER every trial
+    # of a fold is dispatched — the protocol's single collection point
+    ("dislib_tpu/model_selection/search.py", "_block_tree"),
+    ("dislib_tpu/model_selection/search.py", "_dispatch_fold"),
+    ("dislib_tpu/model_selection/search.py", "fit"),
+}
+
+_RAW_SYNC_ATTRS = ("device_get", "collect", "block_until_ready")
+
+
+def _sync_calls(loop_node):
+    """Raw host-sync spellings inside one loop body."""
+    hits = []
+    for sub in ast.walk(loop_node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _RAW_SYNC_ATTRS:
+                hits.append(f.attr)
+            elif f.attr == "asarray" and isinstance(f.value, ast.Name) \
+                    and f.value.id in ("np", "numpy"):
+                hits.append("np.asarray")
+        elif isinstance(f, ast.Name):
+            if f.id == "float" and sub.args \
+                    and not isinstance(sub.args[0], ast.Constant):
+                hits.append("float")
+    return hits
+
+
+def _scan(path):
+    """Yield (function_name, lineno, syncs) for every loop with raw syncs."""
+    tree = ast.parse(open(path, encoding="utf-8").read())
+
+    def walk(node, fname):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from walk(child, child.name)
+            else:
+                if isinstance(child, (ast.For, ast.While)):
+                    syncs = _sync_calls(child)
+                    if syncs:
+                        yield fname, child.lineno, sorted(set(syncs))
+                yield from walk(child, fname)
+
+    yield from walk(tree, "<module>")
+
+
+def _estimator_files():
+    for d in ESTIMATOR_DIRS:
+        full = os.path.join(REPO, d)
+        for fn in sorted(os.listdir(full)):
+            if fn.endswith(".py"):
+                yield f"{d}/{fn}", os.path.join(full, fn)
+
+
+def test_no_unblessed_host_syncs_in_estimator_loops():
+    offenders = []
+    for rel, full in _estimator_files():
+        for fname, lineno, syncs in _scan(full):
+            if (rel, fname) not in ALLOWLIST:
+                offenders.append(f"{rel}:{lineno} in {fname}(): {syncs}")
+    assert not offenders, (
+        "raw host syncs inside estimator iteration loops — route them "
+        "through runtime.fetch (or force()) at a chunk boundary, or "
+        "consciously extend the lint allowlist with a reason:\n  "
+        + "\n  ".join(offenders))
+
+
+def test_allowlist_entries_still_exist():
+    """A refactor that renames or removes an allowlisted loop must prune
+    the list — dead entries would quietly bless future regressions."""
+    live = set()
+    for rel, full in _estimator_files():
+        for fname, _, _ in _scan(full):
+            live.add((rel, fname))
+    dead = {site for site in ALLOWLIST if site not in live}
+    assert not dead, f"allowlist entries no longer match any code: {dead}"

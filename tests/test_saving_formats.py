@@ -1,0 +1,106 @@
+"""save_model/load_model format regressions (round-7 satellite — the PR 1
+known-issue entry: npz crashing via numpy's implicit behaviors, cbor
+failing on length decode).  One regression class per format:
+
+- every format round-trips an estimator with LARGE fitted state (forest:
+  multi-level split arrays force cbor 2- and 4-byte length arguments and
+  a multi-MB npz payload);
+- npz: `np.savez_compressed` silently APPENDS ".npz" to a bare path —
+  save now writes through the file handle, so any extension round-trips;
+  loads run with `allow_pickle=False` and reject foreign/pickled files
+  with a clear error instead of numpy's allow_pickle crash;
+- cbor: the in-tree decoder bounds-checks every length argument — a
+  truncated/foreign file raises a clear ValueError at the exact offset
+  instead of IndexError or a silently-misread length.
+"""
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.trees import RandomForestClassifier
+from dislib_tpu.utils.saving import load_model, save_model
+
+
+@pytest.fixture(scope="module")
+def forest(rng_module):
+    rng = rng_module
+    x = rng.rand(600, 8).astype(np.float32)
+    y = (x[:, 0] + x[:, 3] > 1.0).astype(np.float32)[:, None]
+    a, ya = ds.array(x), ds.array(y)
+    rf = RandomForestClassifier(n_estimators=3, max_depth=6,
+                                random_state=0).fit(a, ya)
+    return rf, a, rf.predict(a).collect()
+
+
+@pytest.fixture(scope="module")
+def rng_module():
+    return np.random.RandomState(7)
+
+
+@pytest.mark.parametrize("fmt", ["json", "cbor", "npz"])
+def test_large_state_roundtrip_per_format(forest, tmp_path, fmt):
+    rf, a, pred = forest
+    path = str(tmp_path / f"forest.{fmt}")
+    save_model(rf, path, save_format=fmt)
+    rf2 = load_model(path)
+    np.testing.assert_array_equal(rf2.predict(a).collect(), pred)
+
+
+@pytest.mark.parametrize("fmt", ["json", "cbor", "npz"])
+def test_extensionless_path_roundtrip(forest, tmp_path, fmt):
+    """np.savez_compressed appends '.npz' to bare paths — the npz format
+    used to save `model` as `model.npz` and fail its own load; every
+    format must round-trip whatever path the caller names."""
+    import os
+    rf, a, pred = forest
+    path = str(tmp_path / f"model_{fmt}_noext")
+    save_model(rf, path, save_format=fmt)
+    assert os.path.exists(path) and not os.path.exists(path + ".npz")
+    rf2 = load_model(path, load_format=fmt)
+    np.testing.assert_array_equal(rf2.predict(a).collect(), pred)
+
+
+def test_npz_rejects_foreign_and_pickled_files(tmp_path):
+    foreign = str(tmp_path / "foreign.npz")
+    np.savez(foreign, junk=np.arange(3))             # no 'state' entry
+    with pytest.raises(ValueError, match="not a dislib_tpu npz model"):
+        load_model(foreign, load_format="npz")
+    pickled = str(tmp_path / "pickled.npz")
+    np.savez(pickled, state=np.asarray([{"a": 1}], dtype=object))
+    with pytest.raises(ValueError, match="not a dislib_tpu npz model"):
+        load_model(pickled, load_format="npz")       # allow_pickle stays off
+
+
+def test_npz_rejects_truncated_file(forest, tmp_path):
+    rf, _, _ = forest
+    path = str(tmp_path / "trunc.npz")
+    save_model(rf, path, save_format="npz")
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 2])
+    with pytest.raises(ValueError, match="not a dislib_tpu npz model"):
+        load_model(path)
+
+
+def test_cbor_rejects_truncated_file(forest, tmp_path):
+    rf, _, _ = forest
+    path = str(tmp_path / "trunc.cbor")
+    save_model(rf, path, save_format="cbor")
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 3])
+    with pytest.raises(ValueError, match="not a dislib_tpu cbor model"):
+        load_model(path)
+
+
+def test_cbor_decoder_flags_truncation_not_indexerror():
+    """Bounds checks at the decoder layer: every cut point of a valid
+    encoding raises ValueError('truncated CBOR...') — never IndexError,
+    never a silently-misread shorter length."""
+    from dislib_tpu.utils import cbor_lite
+    payload = {"k" * 30: [list(range(30)), "v" * 300, 2 ** 40, 1.25],
+               "b": bytes(range(256))}
+    enc = cbor_lite.dumps(payload)
+    assert cbor_lite.loads(enc) == payload
+    for cut in range(len(enc)):
+        with pytest.raises(ValueError):
+            cbor_lite.loads(enc[:cut])
