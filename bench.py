@@ -527,7 +527,18 @@ def bench_randomsvd(m, n, nsv=64, iters=2):
     from dislib_tpu.decomposition import random_svd
 
     rng = np.random.RandomState(0)
-    x_host = rng.standard_normal((m, n)).astype(np.float32)
+    # Spectral decay (0.95^j column scaling) makes the 1% gate well-posed:
+    # on a FLAT Gaussian spectrum, sketch-and-project with oversample=10
+    # leaves ~6% error vs the exact values for BOTH the device path and
+    # the proxy, and since the two draw DIFFERENT test matrices Ω (jax vs
+    # numpy RNG) their estimates differ by up to ~1.5% from each other —
+    # the pre-round-8 smoke-gate flake, reproduced back to PR 1.  With
+    # decay (the workload truncated SVD exists for) both land within
+    # ~0.2% of the exact spectrum; the timed GEMMs are value-independent,
+    # so the wall-clock metric is unaffected.  Regression-pinned by
+    # tests/test_math.py::test_randomsvd_smoke_gate_margin.
+    x_host = (rng.standard_normal((m, n))
+              * 0.95 ** np.arange(n)).astype(np.float32)
     sketch = nsv + 10
     t0 = time.perf_counter()
     _, s_proxy, _ = _numpy_random_svd(x_host, sketch, iters)

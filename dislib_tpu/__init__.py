@@ -56,6 +56,7 @@ from dislib_tpu.data.array import (
 )
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
+    QuarantineReport, last_quarantine_report,
 )
 from dislib_tpu.data.sparse import SparseArray
 from dislib_tpu.math import matmul, kron, svd, qr

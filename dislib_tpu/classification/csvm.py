@@ -57,6 +57,7 @@ from dislib_tpu.utils.profiling import profiled_jit as _pjit
 from dislib_tpu.runtime import fetch as _fetch, \
     preemption_requested as _preemption_requested, \
     raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import health as _health
 
 
 class CascadeSVM(BaseEstimator):
@@ -104,13 +105,20 @@ class CascadeSVM(BaseEstimator):
             return 1.0 / n_features
         return float(self.gamma)
 
-    def fit(self, x: Array, y: Array, checkpoint=None):
+    def fit(self, x: Array, y: Array, checkpoint=None, health=None):
         """Fit the cascade.  With ``checkpoint=FitCheckpoint(path, every=k)``
         the global-iteration state (SV indices/alphas, objective, counter)
         snapshots every k iterations; a re-run resumes from the snapshot and
         lands on the uninterrupted run's model (each global iteration
         depends only on the fed-back SV set and previous objective — SURVEY
-        §6 checkpoint/resume)."""
+        §6 checkpoint/resume).
+
+        ``health`` — optional :class:`~dislib_tpu.runtime.HealthPolicy`.
+        The cascade's per-iteration state (top-node alphas, dual
+        objective) is host-side already, so the guard checks it directly
+        (`check_host`) at each global iteration — no extra dispatches; a
+        tripped guard rolls back to the last-good snapshot or raises a
+        typed ``NumericalDivergence``."""
         if self.kernel not in ("rbf", "linear"):
             raise ValueError(f"unsupported kernel {self.kernel!r}")
         if self.max_iter < 1:
@@ -218,11 +226,12 @@ class CascadeSVM(BaseEstimator):
                 # check_convergence=False means "run the iterations"
                 self.converged_ = bool(snap["converged"]) \
                     and self.check_convergence
+        guard = _health.guard("csvm", health, checkpoint)
         start_it = it
-        for it in range(start_it + 1, self.max_iter + 1):
-            if self.converged_:
-                it = start_it
-                break
+        it = start_it
+        while it < self.max_iter and not self.converged_:
+            nxt = it + 1
+            guard.admit()               # chunk counter (state is host-side)
             if sv_idx is not None and len(sv_idx):
                 # feed global SVs back into every level-0 partition
                 # (dedupe: a partition may already own some of them)
@@ -243,6 +252,24 @@ class CascadeSVM(BaseEstimator):
                 nodes = self._merge_level(nodes, np.asarray(alphas))
             # top node: global SVs + dual objective
             top_idx, top_alpha = nodes[0], np.asarray(alphas[0])
+            verdict = guard.check_host(
+                {"sv_alpha": top_alpha, "objective": np.asarray(objs[0])},
+                it=nxt)
+            if not verdict.ok:
+                rem = guard.remediate(verdict, it=nxt)
+                del rem                 # no damping/reseed knob: pure retry
+                snap = checkpoint.load()
+                if snap is not None:    # last-good generation (gated writes)
+                    sv_idx = np.asarray(snap["sv_idx"], np.int64)
+                    self._sv_alpha = np.asarray(snap["sv_alpha"], np.float32)
+                    last_w = float(snap["last_w"])
+                    it = int(snap["n_iter"])
+                    self.converged_ = bool(snap["converged"]) \
+                        and self.check_convergence
+                else:                   # nothing written yet: from scratch
+                    sv_idx, last_w, it = None, None, start_it
+                continue
+            it = nxt
             keep = (top_alpha > 1e-8) & (top_idx >= 0)
             if not keep.any():
                 # degenerate solve (tiny C / degenerate data): an empty SV
@@ -263,12 +290,14 @@ class CascadeSVM(BaseEstimator):
                 "iter %d: W=%.6f, SVs=%d", it, w, len(sv_idx))
             def _snap():
                 # host-side state already — the async offload moves the
-                # checksum+atomic write off the cascade's critical path
-                checkpoint.save_async({"sv_idx": np.asarray(sv_idx, np.int64),
-                                 "sv_alpha": self._sv_alpha,
-                                 "last_w": w, "n_iter": it, "fp": fp,
-                                 "digest": digest,
-                                 "converged": self.converged_})
+                # checksum+atomic write off the cascade's critical path;
+                # the write is GATED on this iteration's health verdict
+                guard.save_async(checkpoint,
+                                 {"sv_idx": np.asarray(sv_idx, np.int64),
+                                  "sv_alpha": self._sv_alpha,
+                                  "last_w": w, "n_iter": it, "fp": fp,
+                                  "digest": digest,
+                                  "converged": self.converged_})
 
             if self.check_convergence and last_w is not None:
                 if abs(w - last_w) <= self.tol * max(abs(w), 1e-12):

@@ -34,6 +34,7 @@ from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.runtime import fetch as _fetch, \
     raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import health as _health
 
 # padded frame counts above this stream the RMSD adjacency in tiles
 # (module-level so tests can force the path)
@@ -61,32 +62,44 @@ class Daura(BaseEstimator):
     def __init__(self, cutoff=1.0):
         self.cutoff = cutoff
 
-    def fit(self, x: Array, y=None, checkpoint=None):
+    def fit(self, x: Array, y=None, checkpoint=None, health=None):
         """Fit.  With ``checkpoint=FitCheckpoint(path, every=k)`` the greedy
         state (active mask, labels, medoids, cluster counter) snapshots
         every k extracted clusters, on whichever streamed tier the plain
         fit would pick (ring on a multi-row mesh, tiled otherwise); a
         re-run resumes the extraction and lands on the uninterrupted
         run's clustering (the greedy loop is deterministic in its carried
-        state — SURVEY §6)."""
+        state — SURVEY §6).
+
+        ``health`` — optional :class:`~dislib_tpu.runtime.HealthPolicy`.
+        The greedy state is integral, so the fused guard watches the
+        INPUT frames: a non-finite coordinate silently fails every RMSD
+        cutoff comparison — the guard raises a typed
+        ``NumericalDivergence`` instead (quarantine the frames at
+        ingest).  The chunk watchdog covers hung extraction passes."""
         if x.shape[1] % 3 != 0:
             raise ValueError("Daura expects rows of 3*n_atoms coordinates")
         n_atoms = x.shape[1] // 3
         mesh = _mesh.get_mesh()
+        guard = _health.guard("daura", health, checkpoint)
         if checkpoint is not None:
             labels, medoids = self._fit_checkpointed(x, n_atoms, checkpoint,
-                                                     mesh)
-        elif ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
-            labels, medoids = _daura_fit_ring(x._data, x.shape,
-                                              float(self.cutoff), n_atoms,
-                                              mesh)
-        elif x._data.shape[0] <= _DENSE_MAX:
-            labels, medoids = _daura_fit(x._data, x.shape, float(self.cutoff),
-                                         n_atoms)
+                                                     mesh, guard)
         else:
-            labels, medoids = _daura_fit_tiled(x._data, x.shape,
-                                               float(self.cutoff), n_atoms,
-                                               _tiled.TILE)
+            guard.admit()
+            if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
+                labels, medoids, hvec = _daura_fit_ring(
+                    x._data, x.shape, float(self.cutoff), n_atoms, mesh)
+            elif x._data.shape[0] <= _DENSE_MAX:
+                labels, medoids, hvec = _daura_fit(
+                    x._data, x.shape, float(self.cutoff), n_atoms)
+            else:
+                labels, medoids, hvec = _daura_fit_tiled(
+                    x._data, x.shape, float(self.cutoff), n_atoms,
+                    _tiled.TILE)
+            verdict = guard.check(hvec, it=0)
+            if not verdict.ok:
+                guard.remediate(verdict, it=0)  # input faults: typed raise
         labels = np.asarray(jax.device_get(labels))[: x.shape[0]]
         medoids = np.asarray(jax.device_get(medoids))
         self.labels_ = labels.astype(np.int64)
@@ -104,7 +117,8 @@ class Daura(BaseEstimator):
         return Array._from_logical_padded(_repad(lab, (x.shape[0], 1)),
                                           (x.shape[0], 1))
 
-    def _fit_checkpointed(self, x: Array, n_atoms, checkpoint, mesh):
+    def _fit_checkpointed(self, x: Array, n_atoms, checkpoint, mesh,
+                          guard=None):
         """Chunked fit: `every` cluster extractions per dispatch, the
         greedy state snapshotted between chunks.  The ring tier is picked
         by the same policy as the plain fit (scale-out + fault tolerance
@@ -131,6 +145,14 @@ class Daura(BaseEstimator):
                     labels, medoids, cid, max_new=checkpoint.every)
         fp = np.asarray([x.shape[0], x.shape[1], cutoff, mp], np.float64)
         digest = data_digest(x._data)
+        if guard is None:
+            guard = _health.guard("daura", None, checkpoint)
+
+        def _reset():
+            return (jnp.arange(mp, dtype=jnp.int32) < x.shape[0],
+                    jnp.full((mp,), -1, jnp.int32),
+                    jnp.full((mp,), -1, jnp.int32), jnp.int32(0))
+
         snap = checkpoint.load()
         if snap is not None:
             validate_snapshot(snap, fp, digest)
@@ -139,21 +161,32 @@ class Daura(BaseEstimator):
             medoids = jnp.asarray(snap["medoids"])
             cid = jnp.int32(int(snap["cid"]))
         else:
-            active = jnp.arange(mp, dtype=jnp.int32) < x.shape[0]
-            labels = jnp.full((mp,), -1, jnp.int32)
-            medoids = jnp.full((mp,), -1, jnp.int32)
-            cid = jnp.int32(0)
+            active, labels, medoids, cid = _reset()
         while True:
-            active, labels, medoids, cid = extract(active, labels, medoids,
-                                                   cid)
+            (labels,) = guard.admit(labels)
+            active, labels, medoids, cid, hvec = extract(active, labels,
+                                                         medoids, cid)
+            verdict = guard.check(hvec)     # watchdogged chunk force point
+            if not verdict.ok:
+                guard.remediate(verdict)    # input faults: typed raise
+                snap = checkpoint.load()    # recoverable trip: last good
+                if snap is not None:
+                    active = jnp.asarray(snap["active"])
+                    labels = jnp.asarray(snap["labels"])
+                    medoids = jnp.asarray(snap["medoids"])
+                    cid = jnp.int32(int(snap["cid"]))
+                else:
+                    active, labels, medoids, cid = _reset()
+                continue
             done = not bool(_fetch(jnp.any(active)))
             # blocking fetches (the round's own sync), async file write —
-            # the checksum+atomic rename overlaps the next extract round
-            checkpoint.save_async({"active": _fetch(active),
-                                   "labels": _fetch(labels),
-                                   "medoids": _fetch(medoids),
-                                   "cid": int(_fetch(cid)),
-                                   "fp": fp, "digest": digest})
+            # the checksum+atomic rename overlaps the next extract round;
+            # the write is GATED on this chunk's health verdict
+            guard.save_async(checkpoint, {"active": _fetch(active),
+                                          "labels": _fetch(labels),
+                                          "medoids": _fetch(medoids),
+                                          "cid": int(_fetch(cid)),
+                                          "fp": fp, "digest": digest})
             if done:
                 break
             _raise_if_preempted(checkpoint)
@@ -194,7 +227,10 @@ def _daura_fit(xp, shape, cutoff, n_atoms):
     active0 = valid
     _, labels, medoids, _ = lax.while_loop(
         cond, body, (active0, labels0, medoids0, jnp.int32(0)))
-    return labels, medoids
+    # fused input guard — non-finite frame coordinates silently fail every
+    # cutoff comparison, so they must trip, not pass through
+    hvec = _health.health_vec(inputs=(jnp.where(valid[:, None], xv, 0.0),))
+    return labels, medoids, hvec
 
 
 @partial(jax.jit, static_argnames=("shape", "n_atoms", "tile", "max_new"))
@@ -229,7 +265,9 @@ def _daura_extract_tiled(xp, shape, cutoff, n_atoms, tile, active, labels,
 
     active, labels, medoids, cid, _ = lax.while_loop(
         cond, body, (active, labels, medoids, cid, jnp.int32(0)))
-    return active, labels, medoids, cid
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
+    hvec = _health.health_vec(inputs=(jnp.where(valid[:, None], xv, 0.0),))
+    return active, labels, medoids, cid, hvec
 
 
 def _daura_fit_tiled(xp, shape, cutoff, n_atoms, tile):
@@ -246,10 +284,10 @@ def _daura_fit_tiled(xp, shape, cutoff, n_atoms, tile):
     valid = jnp.arange(mp, dtype=jnp.int32) < m
     labels0 = jnp.full((mp,), -1, jnp.int32)
     medoids0 = jnp.full((mp,), -1, jnp.int32)
-    _, labels, medoids, _ = _daura_extract_tiled(
+    _, labels, medoids, _, hvec = _daura_extract_tiled(
         xp, shape, cutoff, n_atoms, tile, valid, labels0, medoids0,
         jnp.int32(0), max_new=1 << 30)
-    return labels, medoids
+    return labels, medoids, hvec
 
 
 @partial(jax.jit, static_argnames=("n_atoms", "mesh", "max_new"))
@@ -280,7 +318,10 @@ def _daura_extract_ring(xp, cutoff, n_atoms, mesh, active, labels,
     active, labels, medoids, cid, _ = lax.while_loop(
         lambda c: jnp.any(c[0]) & (c[4] < max_new), body,
         (active, labels, medoids, cid, jnp.int32(0)))
-    return active, labels, medoids, cid
+    # pad rows/cols are zero under the pad-and-mask invariant, so the raw
+    # sharded backing is safe to scan for non-finite input coordinates
+    hvec = _health.health_vec(inputs=(xp,))
+    return active, labels, medoids, cid, hvec
 
 
 def _daura_fit_ring(xp, shape, cutoff, n_atoms, mesh):
@@ -290,7 +331,7 @@ def _daura_fit_ring(xp, shape, cutoff, n_atoms, mesh):
     valid = jnp.arange(mp, dtype=jnp.int32) < m
     labels0 = jnp.full((mp,), -1, jnp.int32)
     medoids0 = jnp.full((mp,), -1, jnp.int32)
-    _, labels, medoids, _ = _daura_extract_ring(
+    _, labels, medoids, _, hvec = _daura_extract_ring(
         xp, cutoff, n_atoms, mesh, valid, labels0, medoids0,
         jnp.int32(0), max_new=1 << 30)
-    return labels, medoids
+    return labels, medoids, hvec

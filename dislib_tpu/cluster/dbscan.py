@@ -50,6 +50,7 @@ from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.runtime import fetch as _fetch, \
     raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import health as _health
 
 # padded row counts above this stream the adjacency in tiles instead of
 # materialising the m×m matrix (module-level so tests can force the path)
@@ -86,7 +87,7 @@ class DBSCAN(BaseEstimator):
         self.dimensions = dimensions
         self.max_samples = max_samples
 
-    def fit(self, x: Array, y=None, checkpoint=None):
+    def fit(self, x: Array, y=None, checkpoint=None, health=None):
         """Fit.  With ``checkpoint=FitCheckpoint(path, every=k)`` the label
         vector snapshots every k propagation rounds (the per-pass boundary
         — SURVEY §6 checkpoint/resume) on whichever streamed tier the
@@ -94,19 +95,35 @@ class DBSCAN(BaseEstimator):
         so scale-out and fault tolerance compose.  A re-run resumes the
         propagation from the snapshot and lands on the uninterrupted
         run's clustering (min-label propagation is monotone in the label
-        vector, so resuming from any intermediate state is exact)."""
+        vector, so resuming from any intermediate state is exact).
+
+        ``health`` — optional :class:`~dislib_tpu.runtime.HealthPolicy`.
+        Labels are integral (no numeric trajectory to diverge), so the
+        fused guard watches the INPUT: a non-finite coordinate makes
+        every ε-comparison silently false (all-noise clustering) — the
+        guard raises a typed ``NumericalDivergence`` instead (quarantine
+        the rows at ingest).  The chunk watchdog covers hung passes."""
         mesh = _mesh.get_mesh()
+        guard = _health.guard("dbscan", health, checkpoint)
         if checkpoint is not None:
-            raw, core = self._fit_checkpointed(x, checkpoint, mesh)
-        elif ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
-            raw, core = _dbscan_fit_ring(x._data, x.shape, float(self.eps),
-                                         int(self.min_samples), mesh)
-        elif x._data.shape[0] <= _DENSE_MAX:
-            raw, core = _dbscan_fit(x._data, x.shape, float(self.eps),
-                                    int(self.min_samples))
+            raw, core = self._fit_checkpointed(x, checkpoint, mesh, guard)
         else:
-            raw, core = _dbscan_fit_tiled(x._data, x.shape, float(self.eps),
-                                          int(self.min_samples), _tiled.TILE)
+            guard.admit()
+            if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
+                raw, core, hvec = _dbscan_fit_ring(
+                    x._data, x.shape, float(self.eps),
+                    int(self.min_samples), mesh)
+            elif x._data.shape[0] <= _DENSE_MAX:
+                raw, core, hvec = _dbscan_fit(x._data, x.shape,
+                                              float(self.eps),
+                                              int(self.min_samples))
+            else:
+                raw, core, hvec = _dbscan_fit_tiled(
+                    x._data, x.shape, float(self.eps),
+                    int(self.min_samples), _tiled.TILE)
+            verdict = guard.check(hvec, it=0)
+            if not verdict.ok:
+                guard.remediate(verdict, it=0)  # input faults: typed raise
         raw = np.asarray(jax.device_get(raw))[: x.shape[0]]
         core = np.asarray(jax.device_get(core))[: x.shape[0]]
         # renumber root labels compactly in order of first appearance
@@ -129,7 +146,7 @@ class DBSCAN(BaseEstimator):
         return Array._from_logical_padded(_repad(lab, (x.shape[0], 1)),
                                           (x.shape[0], 1))
 
-    def _fit_checkpointed(self, x: Array, checkpoint, mesh):
+    def _fit_checkpointed(self, x: Array, checkpoint, mesh, guard=None):
         """Chunked fit: `every` propagation rounds per dispatch, the
         (label, core) state snapshotted between chunks.  The ring tier is
         picked by the same policy as the plain fit (scale-out and fault
@@ -171,6 +188,8 @@ class DBSCAN(BaseEstimator):
                                               core, _tiled.TILE)
         fp = np.asarray([x.shape[0], x.shape[1], eps, ms, mp], np.float64)
         digest = data_digest(x._data)
+        if guard is None:
+            guard = _health.guard("dbscan", None, checkpoint)
         snap = checkpoint.load()
         if snap is not None:
             validate_snapshot(snap, fp, digest)
@@ -179,11 +198,23 @@ class DBSCAN(BaseEstimator):
         else:
             core, label = setup()
         while True:
-            label, changed = propagate(label, core)
-            # blocking fetches, async file write (overlaps next propagate)
-            checkpoint.save_async({"label": _fetch(label),
-                                   "core": _fetch(core),
-                                   "fp": fp, "digest": digest})
+            (label,) = guard.admit(label)
+            label, changed, hvec = propagate(label, core)
+            verdict = guard.check(hvec)     # watchdogged chunk force point
+            if not verdict.ok:
+                guard.remediate(verdict)    # input faults: typed raise
+                snap = checkpoint.load()    # recoverable trip: last good
+                if snap is not None:
+                    label = jnp.asarray(snap["label"])
+                    core = jnp.asarray(snap["core"])
+                else:
+                    core, label = setup()
+                continue
+            # blocking fetches, async file write (overlaps next propagate);
+            # the write is GATED on this chunk's health verdict
+            guard.save_async(checkpoint, {"label": _fetch(label),
+                                          "core": _fetch(core),
+                                          "fp": fp, "digest": digest})
             if not bool(_fetch(changed)):
                 break
             _raise_if_preempted(checkpoint)
@@ -233,7 +264,10 @@ def _dbscan_fit(xp, shape, eps, min_samples):
     border_label = jnp.min(border_neigh, axis=1)
     final = jnp.where(core, label, jnp.where(valid, border_label, sentinel))
     final = jnp.where(final < sentinel, final, -1)
-    return final, core
+    # fused input guard — a non-finite coordinate silently fails every
+    # ε-comparison (all-noise output), so it must trip, not pass through
+    hvec = _health.health_vec(inputs=(jnp.where(valid[:, None], xv, 0.0),))
+    return final, core, hvec
 
 
 @partial(jax.jit, static_argnames=("shape", "min_samples", "tile"))
@@ -279,7 +313,9 @@ def _dbscan_propagate_tiled(xp, shape, eps, label, core, tile, max_rounds):
 
     label, changed, _ = lax.while_loop(
         cond, body, (label, jnp.bool_(True), jnp.int32(0)))
-    return label, changed
+    valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
+    hvec = _health.health_vec(inputs=(jnp.where(valid[:, None], xv, 0.0),))
+    return label, changed, hvec
 
 
 @partial(jax.jit, static_argnames=("shape", "tile"))
@@ -304,9 +340,10 @@ def _dbscan_fit_tiled(xp, shape, eps, min_samples, tile):
     setup → propagate(unbounded) → finalize, the same three programs the
     checkpointed fit runs in bounded chunks."""
     core, label0 = _dbscan_setup_tiled(xp, shape, eps, min_samples, tile)
-    label, _ = _dbscan_propagate_tiled(xp, shape, eps, label0, core, tile,
-                                       max_rounds=1 << 30)
-    return _dbscan_finalize_tiled(xp, shape, eps, label, core, tile), core
+    label, _, hvec = _dbscan_propagate_tiled(xp, shape, eps, label0, core,
+                                             tile, max_rounds=1 << 30)
+    return (_dbscan_finalize_tiled(xp, shape, eps, label, core, tile), core,
+            hvec)
 
 
 @partial(jax.jit, static_argnames=("shape", "min_samples", "mesh"))
@@ -348,7 +385,10 @@ def _dbscan_propagate_ring(xp, eps, label, core, mesh, max_rounds):
     label, changed, _ = lax.while_loop(
         lambda c: c[1] & (c[2] < max_rounds), body,
         (label, jnp.bool_(True), jnp.int32(0)))
-    return label, changed
+    # pad rows are zero under the pad-and-mask invariant, so the raw
+    # backing is safe to scan for non-finite input coordinates
+    hvec = _health.health_vec(inputs=(xp,))
+    return label, changed, hvec
 
 
 @partial(jax.jit, static_argnames=("shape", "mesh"))
@@ -374,6 +414,7 @@ def _dbscan_fit_ring(xp, shape, eps, min_samples, mesh):
     Expressed as setup → propagate(unbounded) → finalize, the same three
     programs the checkpointed ring fit runs in bounded chunks."""
     core, label0 = _dbscan_setup_ring(xp, shape, eps, min_samples, mesh)
-    label, _ = _dbscan_propagate_ring(xp, eps, label0, core, mesh,
-                                      max_rounds=1 << 30)
-    return _dbscan_finalize_ring(xp, shape, eps, label, core, mesh), core
+    label, _, hvec = _dbscan_propagate_ring(xp, eps, label0, core, mesh,
+                                            max_rounds=1 << 30)
+    return (_dbscan_finalize_ring(xp, shape, eps, label, core, mesh), core,
+            hvec)

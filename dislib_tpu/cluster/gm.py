@@ -31,6 +31,7 @@ from dislib_tpu.ops.base import precise
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
 from dislib_tpu.runtime import fetch as _fetch, \
     raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import health as _health
 from dislib_tpu.utils.dlog import verbose_logger
 
 _LOG2PI = float(np.log(2.0 * np.pi))
@@ -103,16 +104,26 @@ class GaussianMixture(BaseEstimator):
             raise ValueError(f"unsupported init_params {self.init_params!r}")
         return resp
 
-    def fit(self, x: Array, y=None, checkpoint=None):
+    def fit(self, x: Array, y=None, checkpoint=None, health=None):
         """Fit by EM.  With ``checkpoint=FitCheckpoint(path, every=k)`` the
         device loop runs in k-iteration chunks, snapshotting (weights, means,
         covariances, lower_bound, n_iter) after each; a re-run resumes from
-        the snapshot (SURVEY §6 checkpoint/resume)."""
+        the snapshot (SURVEY §6 checkpoint/resume).
+
+        ``health`` — optional :class:`~dislib_tpu.runtime.HealthPolicy`;
+        each chunk's kernel emits a fused health vector over the EM
+        parameters and the lower-bound history (monotone nondecreasing).
+        A tripped guard rolls back to the last-good snapshot; the
+        ``halve`` action additionally doubles ``reg_covar`` per restart
+        (the EM damping knob — a collapsing component's singular
+        covariance is the classic EM failure)."""
         if self.covariance_type not in ("full", "tied", "diag", "spherical"):
             raise ValueError(f"bad covariance_type {self.covariance_type!r}")
         if self.max_iter < 1:
             raise ValueError("max_iter must be >= 1")
         m, n = x.shape
+        guard = _health.guard("gm", health, checkpoint)
+        reg_covar = float(self.reg_covar)
         it, lb, converged = 0, None, False
         state = checkpoint.load() if checkpoint is not None else None
         if state is not None:
@@ -134,6 +145,7 @@ class GaussianMixture(BaseEstimator):
         else:
             resp0 = self._init_resp(x)
             overrides = self._explicit_inits(n)
+        it0 = it                       # this-run history starts here
         history = []
         log = verbose_logger("gm", self.verbose)
         while not converged:
@@ -141,10 +153,37 @@ class GaussianMixture(BaseEstimator):
                 min(checkpoint.every, self.max_iter - it)
             if chunk <= 0:
                 break
-            weights, means, covs, lb_dev, n_done, conv, hist = _gm_fit(
+            overrides = guard.admit(*overrides)
+            weights, means, covs, lb_dev, n_done, conv, hist, hvec = _gm_fit(
                 x._data, x.shape, resp0, self.covariance_type,
-                float(self.reg_covar), float(self.tol), chunk, overrides,
+                reg_covar, float(self.tol), chunk, overrides,
                 prev_lb0=lb)
+            verdict = guard.check(
+                hvec, carry_names=("weights", "means", "covariances"),
+                carry_shapes=((self.n_components,), (self.n_components, n)),
+                it=it, increasing=True)     # EM lower bound must not fall
+            if not verdict.ok:
+                rem = guard.remediate(verdict, it=it)
+                # EM damping: the 'halve' action raises the covariance
+                # ridge per restart, the standard fix for a component
+                # collapsing onto a point (singular covariance → NaN)
+                reg_covar = float(self.reg_covar) * rem.damping
+                snap = checkpoint.load()
+                resp0 = jnp.zeros((x._data.shape[0], self.n_components),
+                                  jnp.float32)
+                if snap is not None:
+                    overrides = tuple(
+                        jnp.asarray(rem.perturb(snap[k])) for k in
+                        ("weights", "means", "covariances"))
+                    lb = float(snap["lower_bound"])
+                    it = int(snap["n_iter"])
+                    converged = bool(snap.get("converged", False))
+                else:                   # nothing written yet: from scratch
+                    resp0 = self._init_resp(x)
+                    overrides = self._explicit_inits(n)
+                    it, lb, converged = 0, None, False
+                del history[max(0, it - it0):]
+                continue
             it += int(n_done)
             lb = float(lb_dev)
             converged = bool(conv)
@@ -155,8 +194,9 @@ class GaussianMixture(BaseEstimator):
                 # the EM parameters are DONATED to the next chunk's kernel
                 # (HBM reused in place), so their device->host copies are
                 # blocking; the checksum+file write still overlaps the next
-                # chunk on the snapshot worker
-                checkpoint.save_async({
+                # chunk on the snapshot worker.  The write is GATED on this
+                # chunk's health verdict.
+                guard.save_async(checkpoint, {
                     "weights": _fetch(weights),
                     "means": _fetch(means),
                     "covariances": _fetch(covs),
@@ -203,7 +243,7 @@ class GaussianMixture(BaseEstimator):
     def _fit_finalize(self, state):
         if state is None:
             return
-        weights, means, covs, lb, n_iter, conv, hist = state
+        weights, means, covs, lb, n_iter, conv, hist, _ = state
         self.weights_ = np.asarray(jax.device_get(weights))
         self.means_ = np.asarray(jax.device_get(means))
         self.covariances_ = np.asarray(jax.device_get(covs))
@@ -394,7 +434,11 @@ def _gm_fit(xp, shape, resp0, cov_type, reg_covar, tol, max_iter,
             jnp.zeros((max_iter,), xv.dtype))
     weights, means, covs, lb, conv, n_iter, hist = \
         lax.while_loop(cond, step, init)
-    return weights, means, covs, lb, n_iter, conv, hist
+    # fused health vector — same program, zero extra dispatches (the EM
+    # lower bound is nondecreasing, so `hist` is the monotone signal)
+    hvec = _health.health_vec(carries=(weights, means, covs), hist=hist,
+                              n_done=n_iter, increasing=True)
+    return weights, means, covs, lb, n_iter, conv, hist, hvec
 
 
 @partial(_pjit, static_argnames=("shape", "cov_type"), name="gm_loglik")

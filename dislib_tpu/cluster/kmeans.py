@@ -43,6 +43,7 @@ from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
 from dislib_tpu.runtime import fetch as _fetch, \
     raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import health as _health
 from dislib_tpu.utils.dlog import verbose_logger
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
@@ -125,7 +126,7 @@ class KMeans(BaseEstimator):
             rows = jnp.concatenate([rows, extra], axis=0)
         return rows
 
-    def fit(self, x: Array, y=None, checkpoint=None):
+    def fit(self, x: Array, y=None, checkpoint=None, health=None):
         """Fit on `x`.  With ``checkpoint=FitCheckpoint(path, every=k)`` the
         device loop runs in k-iteration chunks, snapshotting (centers,
         n_iter) after each; a re-run resumes from the snapshot (SURVEY §6
@@ -133,9 +134,17 @@ class KMeans(BaseEstimator):
         loop honours the preemption flag (`dislib_tpu.runtime`): snapshot
         first, then a clean ``Preempted`` instead of dying mid-collective.
         Centers are host-side logical state, so a snapshot restores onto a
-        different mesh/device count unchanged (elastic resume)."""
+        different mesh/device count unchanged (elastic resume).
+
+        ``health`` — optional :class:`~dislib_tpu.runtime.HealthPolicy`.
+        Every chunk's kernel emits a fused health vector (non-finite
+        centers, inertia monotonicity, center norm) at zero extra
+        dispatches; a tripped guard rolls the fit back to the last GOOD
+        snapshot (writes are gated on healthy chunks) and applies the
+        policy, or raises a typed ``NumericalDivergence``."""
         it = 0
         done = False
+        guard = _health.guard("kmeans", health, checkpoint)
         state = checkpoint.load() if checkpoint is not None else None
         if state is not None:
             centers = jnp.asarray(state["centers"])
@@ -148,6 +157,7 @@ class KMeans(BaseEstimator):
             done = bool(state.get("converged", False))
         else:
             centers = self._init_centers(x)
+        it0 = it                       # this-run history starts here
         inertia = None
         history = []
         log = verbose_logger("kmeans", self.verbose)
@@ -156,16 +166,41 @@ class KMeans(BaseEstimator):
                 min(checkpoint.every, self.max_iter - it)
             if chunk <= 0:
                 break
+            (centers,) = guard.admit(centers)
             if isinstance(x, SparseArray):
                 data, lrows, cols, rowsq = x.sharded_rows()
-                centers, n_done, inertia, shift, hist = \
+                new_centers, n_done, inertia, shift, hist, hvec = \
                     _kmeans_fit_sparse_sharded(
                         data, lrows, cols, rowsq, centers, x.shape[0], chunk,
                         float(self.tol), _mesh.get_mesh())
             else:
-                centers, n_done, inertia, shift, hist = _kmeans_fit(
+                new_centers, n_done, inertia, shift, hist, hvec = _kmeans_fit(
                     x._data, x.shape, centers, chunk, float(self.tol),
                     fast=self._fast())
+            verdict = guard.check(
+                hvec, carry_names=("centers",),
+                carry_shapes=((self.n_clusters, x.shape[1]),), it=it)
+            if not verdict.ok:
+                # roll back to the last-good generation (gated writes keep
+                # it good) and apply the remediation policy; raises the
+                # typed diagnostic when the policy says so
+                rem = guard.remediate(verdict, it=it)
+                snap = checkpoint.load()
+                # the faulted chunk's inertia must not leak into the
+                # fitted attrs if the restored state exits the loop
+                # (converged snapshot): None falls back to -score(x)
+                inertia = None
+                if snap is not None:
+                    centers = jnp.asarray(rem.perturb(snap["centers"]))
+                    it = int(snap["n_iter"])
+                    done = bool(snap.get("converged", False))
+                else:                   # nothing written yet: from scratch
+                    centers = jnp.asarray(
+                        rem.perturb(_fetch(self._init_centers(x))))
+                    it, done = 0, False
+                del history[max(0, it - it0):]
+                continue
+            centers = new_centers
             it += int(n_done)
             history.extend(_fetch(hist)[: int(n_done)])
             done = float(shift) < self.tol
@@ -175,8 +210,9 @@ class KMeans(BaseEstimator):
                 # async offload: the device->host copy starts now and the
                 # file write runs on the snapshot worker, both overlapping
                 # the next chunk's compute (centers are never donated, so
-                # the non-blocking fetch is safe)
-                checkpoint.save_async({
+                # the non-blocking fetch is safe); the write is GATED on
+                # this chunk's health verdict
+                guard.save_async(checkpoint, {
                     "centers": _fetch(centers, blocking=False),
                     "n_iter": it, "converged": done})
                 if not done and it < self.max_iter:  # work left: allow a
@@ -205,7 +241,7 @@ class KMeans(BaseEstimator):
     def _fit_finalize(self, state):
         if state is None:
             return
-        centers, n_iter, inertia, _, hist = state
+        centers, n_iter, inertia, _, hist, _ = state
         self.centers_ = np.asarray(jax.device_get(centers))
         self.n_iter_ = int(n_iter)
         self.inertia_ = float(inertia)
@@ -292,7 +328,11 @@ def _kmeans_fit(xp, shape, centers0, max_iter, tol, fast=False):
     init = (centers0, jnp.asarray(jnp.inf, xv.dtype), jnp.int32(0),
             jnp.asarray(0.0, xv.dtype), jnp.zeros((max_iter,), xv.dtype))
     centers, shift, n_iter, inertia, hist = lax.while_loop(cond, step, init)
-    return centers, n_iter, inertia, shift, hist
+    # fused health vector — same program, zero extra dispatches (inertia
+    # is nonincreasing under exact Lloyd's, so `hist` is the monotone
+    # signal; the guard's threshold is host-side policy)
+    hvec = _health.health_vec(carries=(centers,), hist=hist, n_done=n_iter)
+    return centers, n_iter, inertia, shift, hist, hvec
 
 
 @partial(_pjit, static_argnames=("shape",), name="kmeans_predict")
@@ -381,7 +421,10 @@ def _kmeans_fit_sparse_sharded(data, lrows, cols, rowsq, centers0, m,
         out_specs=(P(), P(), P(), P(), P()),
         check_vma=True,
     )(data, lrows, cols, rowsq, centers0)
-    return centers, n_iter, inertia, shift, hist
+    # fused health vector over the replicated outputs — still inside this
+    # jitted program, zero extra dispatches
+    hvec = _health.health_vec(carries=(centers,), hist=hist, n_done=n_iter)
+    return centers, n_iter, inertia, shift, hist, hvec
 
 
 @partial(_pjit, static_argnames=("shape",), name="kmeans_score")

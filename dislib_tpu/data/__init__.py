@@ -4,6 +4,7 @@ from dislib_tpu.data.array import (
 )
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
+    QuarantineReport, last_quarantine_report,
 )
 from dislib_tpu.data.sparse import SparseArray
 
@@ -11,5 +12,5 @@ __all__ = [
     "Array", "array", "random_array", "zeros", "full", "ones", "identity",
     "eye", "apply_along_axis", "concat_rows", "concat_cols",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
-    "save_txt", "SparseArray",
+    "save_txt", "QuarantineReport", "last_quarantine_report", "SparseArray",
 ]

@@ -14,6 +14,19 @@ Single-host (this build's test rig) parses locally and `device_put`s with
 the canonical sharding.  Parsing itself is host-side C-speed (numpy loadtxt
 / native fastio), matching the reference where parsing was also CPU-side
 inside tasks.
+
+**Ingest quarantine** (round-8 health PR): a single NaN row in a loaded
+file would poison every block it lands in — distances go NaN, ε/cutoff
+comparisons silently fail, and the runtime health guards can only refuse
+the fit after the fact.  The loaders therefore detect non-finite rows at
+parse time, ISOLATE them into a :class:`QuarantineReport` (attached to
+the returned array as ``.quarantine_`` and readable via
+:func:`last_quarantine_report`), and build the ds-array from the clean
+rows only.  Opt out per call (``quarantine=False``) or globally
+(``DSLIB_QUARANTINE=0``) to load the raw rows — the health guards then
+raise their typed diagnostic instead.  Multi-process sharded ingest
+skips quarantine (dropping rows host-locally would desync the global
+shape) — scrub files offline for multi-host jobs.
 """
 
 from __future__ import annotations
@@ -21,12 +34,120 @@ from __future__ import annotations
 import functools
 import io as _io
 import os
+import warnings
 
 import numpy as np
 
 from dislib_tpu.data.array import (Array as _Array, array as _ds_array,
                                    _padded_shape)
 from dislib_tpu.parallel import mesh as _mesh
+
+
+class QuarantineReport:
+    """What the ingest quarantine isolated from one load: the 0-based
+    ``rows`` (in the file's row order), the offending ``values`` rows
+    themselves (for offline triage), the ``labels`` that rode along
+    (svmlight), the ``source`` path, and ``n_loaded`` clean rows.
+
+    **Paired files.** Dropping rows changes row numbering, so arrays
+    loaded from SEPARATE files that pair row-by-row (features.csv +
+    labels.csv) silently misalign if either file quarantined rows.
+    ``load_svmlight_file`` keeps its own x/y aligned; for separately
+    loaded pairs, apply this report's :attr:`keep_mask` to the partner
+    (``y = y[report.keep_mask, :]``) — and the partner's report to this
+    array — or load both with ``quarantine=False`` and let the runtime
+    health guards raise their typed diagnostic instead."""
+
+    def __init__(self, source, rows, values, n_loaded, labels=None):
+        self.source = str(source)
+        self.rows = np.asarray(rows, np.int64)
+        self.values = values
+        self.labels = labels
+        self.n_loaded = int(n_loaded)
+
+    @property
+    def n_quarantined(self):
+        return int(self.rows.size)
+
+    @property
+    def n_total(self):
+        """Rows in the source file (loaded + quarantined)."""
+        return self.n_loaded + self.n_quarantined
+
+    @property
+    def keep_mask(self):
+        """Boolean mask over the ORIGINAL file's rows (True = kept) —
+        apply it to a row-paired array from another file to restore
+        row correspondence after this load's quarantine."""
+        mask = np.ones(self.n_total, bool)
+        mask[self.rows] = False
+        return mask
+
+    def __repr__(self):
+        return (f"QuarantineReport(source={self.source!r}, "
+                f"n_quarantined={self.n_quarantined}, "
+                f"n_loaded={self.n_loaded}, rows={self.rows.tolist()})")
+
+
+_LAST_QUARANTINE: QuarantineReport | None = None
+
+
+def last_quarantine_report() -> QuarantineReport | None:
+    """The :class:`QuarantineReport` of the most recent load that
+    quarantined rows in this process, or None."""
+    return _LAST_QUARANTINE
+
+
+def _quarantine_enabled(opt) -> bool:
+    if opt is not None:
+        return bool(opt)
+    return os.environ.get("DSLIB_QUARANTINE", "1") != "0"
+
+
+def _emit_quarantine(source, rows, bad_values, n_clean, bad_labels=None):
+    """The shared report/warn/refuse tail of both quarantine paths (dense
+    rows and CSR) — one place owns the report registration and the user
+    messages so they cannot drift."""
+    global _LAST_QUARANTINE
+    report = QuarantineReport(source, rows, bad_values, n_clean,
+                              labels=bad_labels)
+    _LAST_QUARANTINE = report
+    warnings.warn(
+        f"{source}: quarantined {report.n_quarantined} non-finite row(s) "
+        f"(indices {rows[:8].tolist()}{'...' if len(rows) > 8 else ''}) — "
+        "see last_quarantine_report() / the returned array's .quarantine_; "
+        "pass quarantine=False (or DSLIB_QUARANTINE=0) to load them raw. "
+        "If this file pairs row-by-row with another (features/labels), "
+        "re-align the partner with report.keep_mask or row numbering "
+        "silently shifts",
+        RuntimeWarning, stacklevel=4)
+    if n_clean == 0:
+        raise ValueError(
+            f"{source}: every row is non-finite — nothing left to load "
+            "after quarantine (pass quarantine=False to load raw)")
+    return report
+
+
+def _quarantine_rows(data, source, opt, labels=None):
+    """Split non-finite rows out of a parsed host matrix (and the labels
+    vector riding along, svmlight).  Returns ``(clean, clean_labels,
+    report_or_None)``; multi-process jobs skip (see module docstring)."""
+    import jax
+    if not _quarantine_enabled(opt) or jax.process_count() > 1 \
+            or data.size == 0:
+        return data, labels, None
+    bad = ~np.isfinite(data).all(axis=1)
+    if labels is not None:
+        bad |= ~np.isfinite(np.asarray(labels, np.float64)).ravel()
+    if not bad.any():
+        return data, labels, None
+    rows = np.nonzero(bad)[0]
+    clean = data[~bad]
+    clean_labels = labels[~bad] if labels is not None else None
+    report = _emit_quarantine(
+        source, rows, data[bad], clean.shape[0],
+        bad_labels=None if labels is None else labels[bad])
+    return clean, clean_labels, report
 
 
 def _retrying_loader(fn):
@@ -150,7 +271,9 @@ def _from_local_rows(local, lo, shape, block_size, dtype):
     """Assemble a global ds-array from this process's parsed row slab
     ``local`` (rows [lo, lo+len(local)) of the logical array) — one
     device_put per addressable shard, zero collectives, no host ever holds
-    more than its slab."""
+    more than its slab.  Sharded ingest skips quarantine (module
+    docstring), but the returned array still carries ``quarantine_=None``
+    so `x.quarantine_` is readable on every load path."""
     import jax
     m, n = shape
     pshape = _padded_shape((m, n), _mesh.pad_quantum())
@@ -169,24 +292,35 @@ def _from_local_rows(local, lo, shape, block_size, dtype):
                 local[rr0 - lo: rr1 - lo, c0:cc1]
         arrs.append(jax.device_put(blk, d))
     garr = jax.make_array_from_single_device_arrays(pshape, sh, arrs)
-    return _Array(garr, (m, n), reg_shape=block_size)
+    out = _Array(garr, (m, n), reg_shape=block_size)
+    out.quarantine_ = None
+    return out
 
 
 @_retrying_loader
-def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32):
+def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32,
+                  quarantine=None):
     """Load a delimited text file into a ds-array (reference: load_txt_file).
 
     Multi-process jobs (``jax.process_count() > 1``): each host scans line
     offsets (byte pass), parses only the rows its shards cover, and places
     them shard-locally — ingest parallelism AND ingest memory both scale
-    with hosts (SURVEY §4.1).  Single-process parses locally."""
+    with hosts (SURVEY §4.1).  Single-process parses locally.
+
+    ``quarantine`` — non-finite rows are isolated into the returned
+    array's ``.quarantine_`` report instead of poisoning blocks (module
+    docstring); ``False`` loads them raw, ``None`` reads
+    ``DSLIB_QUARANTINE``."""
     import jax
     if jax.process_count() <= 1:
         with open(path, "rb") as f:
             data = _parse_txt_buf(f.read(), delimiter, dtype)
         if data.size == 0:
             data = np.loadtxt(path, delimiter=delimiter, dtype=dtype, ndmin=2)
-        return _ds_array(data, block_size=block_size, dtype=dtype)
+        data, _, report = _quarantine_rows(data, path, quarantine)
+        out = _ds_array(data, block_size=block_size, dtype=dtype)
+        out.quarantine_ = report
+        return out
     from dislib_tpu.data.array import _require_dtype_support
     _require_dtype_support(dtype)
     starts, fsize = _scan_line_offsets(path)
@@ -220,18 +354,22 @@ def load_txt_file(path, block_size=None, delimiter=",", dtype=np.float32):
 
 
 @_retrying_loader
-def load_npy_file(path, block_size=None, dtype=None):
+def load_npy_file(path, block_size=None, dtype=None, quarantine=None):
     """Load a .npy file into a ds-array (reference: load_npy_file).
 
     Multi-process jobs memory-map the file and materialise only this
-    host's row slab (same shard-local contract as `load_txt_file`)."""
+    host's row slab (same shard-local contract as `load_txt_file`).
+    ``quarantine``: see `load_txt_file`."""
     import jax
     from dislib_tpu.data.array import _coerce_dtype
     mm = np.load(path, allow_pickle=False, mmap_mode="r")
     if mm.ndim != 2:
         raise ValueError("load_npy_file expects a 2-D array")
     if jax.process_count() <= 1:
-        return _ds_array(np.asarray(mm), block_size=block_size, dtype=dtype)
+        data, _, report = _quarantine_rows(np.asarray(mm), path, quarantine)
+        out = _ds_array(data, block_size=block_size, dtype=dtype)
+        out.quarantine_ = report
+        return out
     m, n = mm.shape
     lo, hi = _process_row_slab(m, n)
     rlo, rhi = min(lo, m), min(hi, m)
@@ -314,8 +452,32 @@ def _load_svmlight_sharded(path, block_size, n_features):
     return x, y
 
 
+def _quarantine_csr(csr, labels, source, opt):
+    """CSR-path quarantine: a row is bad when any stored value — or its
+    label — is non-finite.  Returns (clean_csr, clean_labels, report)."""
+    import jax
+    if not _quarantine_enabled(opt) or jax.process_count() > 1 \
+            or csr.shape[0] == 0:
+        return csr, labels, None
+    bad_rows = np.zeros(csr.shape[0], bool)
+    bad_vals = np.nonzero(~np.isfinite(csr.data))[0]
+    if bad_vals.size:
+        # entry i lives in the row whose indptr window contains i
+        bad_rows[np.searchsorted(csr.indptr, bad_vals, side="right") - 1] = \
+            True
+    bad_rows |= ~np.isfinite(np.asarray(labels, np.float64))
+    if not bad_rows.any():
+        return csr, labels, None
+    rows = np.nonzero(bad_rows)[0]
+    clean = csr[~bad_rows]
+    report = _emit_quarantine(source, rows, csr[bad_rows], clean.shape[0],
+                              bad_labels=labels[bad_rows])
+    return clean, labels[~bad_rows], report
+
+
 @_retrying_loader
-def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True):
+def load_svmlight_file(path, block_size=None, n_features=None,
+                       store_sparse=True, quarantine=None):
     """Load a svmlight/libsvm file -> (x, y) ds-arrays (reference parity).
 
     Hand-rolled parser (no sklearn dependency in the library path); native
@@ -338,12 +500,15 @@ def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True
         m = n_features if n_features is not None else nfeat
         import scipy.sparse as sp
         csr = sp.csr_matrix((data, indices, indptr), shape=(n, m))
+        csr, labels_a, report = _quarantine_csr(csr, labels_a, path,
+                                                quarantine)
         if store_sparse:
             from dislib_tpu.data.sparse import SparseArray
             x = SparseArray.from_scipy(csr, block_size=block_size)
         else:
             x = _ds_array(csr.toarray().astype(np.float32),
                           block_size=block_size)
+        x.quarantine_ = report
         y = _ds_array(labels_a.reshape(-1, 1),
                       block_size=(block_size[0], 1) if block_size else None)
         return x, y
@@ -351,21 +516,28 @@ def load_svmlight_file(path, block_size=None, n_features=None, store_sparse=True
         rows, labels, max_feat = _parse_svmlight_text(f)
     m = n_features if n_features is not None else max_feat
     dense = _svmlight_dense(rows, m)
+    dense, labels, report = _quarantine_rows(
+        dense, path, quarantine,
+        labels=np.asarray(labels, dtype=np.float32))
     if store_sparse:
         import scipy.sparse as sp
         from dislib_tpu.data.sparse import SparseArray
         x = SparseArray.from_scipy(sp.csr_matrix(dense), block_size=block_size)
     else:
         x = _ds_array(dense, block_size=block_size)
+    x.quarantine_ = report
     y = _ds_array(np.asarray(labels, dtype=np.float32).reshape(-1, 1),
                    block_size=(block_size[0], 1) if block_size else None)
     return x, y
 
 
 @_retrying_loader
-def load_mdcrd_file(path, block_size=None, n_atoms=None, copy_first=False):
+def load_mdcrd_file(path, block_size=None, n_atoms=None, copy_first=False,
+                    quarantine=None):
     """Load an AMBER .mdcrd trajectory: one row per frame, 3*n_atoms coords
-    (reference: load_mdcrd_file for the Daura/MD pipeline)."""
+    (reference: load_mdcrd_file for the Daura/MD pipeline).
+    ``quarantine``: non-finite FRAMES are isolated (see `load_txt_file`);
+    the ``copy_first`` duplicate is taken from the cleaned trajectory."""
     if n_atoms is None:
         raise ValueError("n_atoms is required for mdcrd parsing")
     values = _native_parse("parse_mdcrd", path)
@@ -382,9 +554,12 @@ def load_mdcrd_file(path, block_size=None, n_atoms=None, copy_first=False):
     n_frames = len(values) // per_frame
     data = np.asarray(values[: n_frames * per_frame], dtype=np.float32)
     data = data.reshape(n_frames, per_frame)
-    if copy_first and n_frames > 0:
+    data, _, report = _quarantine_rows(data, path, quarantine)
+    if copy_first and data.shape[0] > 0:
         data = np.vstack([data, data[:1]])
-    return _ds_array(data, block_size=block_size)
+    out = _ds_array(data, block_size=block_size)
+    out.quarantine_ = report
+    return out
 
 
 def save_txt(x, path, merge_rows=True, delimiter=","):

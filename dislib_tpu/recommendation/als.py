@@ -44,6 +44,7 @@ from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
 from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows, \
     raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import health as _health
 from dislib_tpu.utils.dlog import verbose_logger
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
@@ -85,7 +86,7 @@ class ALS(BaseEstimator):
         self.verbose = verbose
         self.arity = arity
 
-    def fit(self, x: Array, test=None, checkpoint=None):
+    def fit(self, x: Array, test=None, checkpoint=None, health=None):
         """Factorise the ratings matrix ``x`` (users × items, 0 = unobserved).
 
         ``test`` — optional held-out ratings (ndarray or ds-array with the
@@ -99,6 +100,12 @@ class ALS(BaseEstimator):
         LOGICAL factor dims, so a checkpoint written on one mesh resumes on
         a different device count (the factors are re-padded on restore —
         elastic resume).
+        ``health`` — optional :class:`~dislib_tpu.runtime.HealthPolicy`;
+        each chunk's kernel emits a fused health vector over the factors
+        and the RMSE history.  A tripped guard rolls back to the
+        last-good snapshot; the ``halve`` action additionally doubles
+        ``lambda_`` per restart (the normal-equation ridge — ALS's
+        damping knob against ill-conditioned solves).
         """
         if self.max_iter < 1:
             raise ValueError("max_iter must be >= 1")
@@ -126,33 +133,39 @@ class ALS(BaseEstimator):
                     f"test ratings shape {t.shape} != ratings shape {x.shape}")
             test_p = _pad_like(t, x)
         seed = self.random_state if self.random_state is not None else 0
+        guard = _health.guard("als", health, checkpoint)
+        lam = float(self.lambda_)
+        tu = x.shape[0] if sparse_in else x._data.shape[0]
+        tv = x.shape[1] if sparse_in else x._data.shape[1]
+
+        def _restore(snap, perturb=lambda a: a):
+            # snapshots carry the LOGICAL factor dims (m, n); the stored
+            # factor arrays may be padded for a different mesh — elastic
+            # resume re-pads them for this mesh (runtime.repad_rows)
+            if "m" not in snap or "users" not in snap:
+                raise ValueError(
+                    "checkpoint is missing the ALS factor state — stale "
+                    "or foreign snapshot")
+            sm, sn = int(snap["m"]), int(snap["n"])
+            if (sm, sn) != tuple(x.shape) or \
+                    snap["users"].shape[1:] != (int(self.n_f),):
+                raise ValueError(
+                    f"checkpoint factors (users {snap['users'].shape} "
+                    f"over ratings {(sm, sn)}) do not match this "
+                    f"estimator/data (ratings {tuple(x.shape)}, "
+                    f"n_f={self.n_f}) — stale or foreign snapshot")
+            st = (jnp.asarray(perturb(_repad_rows(snap["users"], sm, tu))),
+                  jnp.asarray(perturb(_repad_rows(snap["items"], sn, tv))),
+                  float(snap["rmse"]))
+            return (st, float(snap["rmse"]), int(snap["n_iter"]),
+                    bool(snap.get("converged", False)))
+
         it, rmse, conv, state = 0, np.inf, False, None
         if checkpoint is not None:
             snap = checkpoint.load()
             if snap is not None:
-                # snapshots carry the LOGICAL factor dims (m, n); the stored
-                # factor arrays may be padded for a different mesh — elastic
-                # resume re-pads them for this mesh (runtime.repad_rows)
-                if "m" not in snap or "users" not in snap:
-                    raise ValueError(
-                        "checkpoint is missing the ALS factor state — stale "
-                        "or foreign snapshot")
-                sm, sn = int(snap["m"]), int(snap["n"])
-                if (sm, sn) != tuple(x.shape) or \
-                        snap["users"].shape[1:] != (int(self.n_f),):
-                    raise ValueError(
-                        f"checkpoint factors (users {snap['users'].shape} "
-                        f"over ratings {(sm, sn)}) do not match this "
-                        f"estimator/data (ratings {tuple(x.shape)}, "
-                        f"n_f={self.n_f}) — stale or foreign snapshot")
-                tu = x.shape[0] if sparse_in else x._data.shape[0]
-                tv = x.shape[1] if sparse_in else x._data.shape[1]
-                state = (jnp.asarray(_repad_rows(snap["users"], sm, tu)),
-                         jnp.asarray(_repad_rows(snap["items"], sn, tv)),
-                         float(snap["rmse"]))
-                rmse = float(snap["rmse"])
-                it = int(snap["n_iter"])
-                conv = bool(snap.get("converged", False))
+                state, rmse, it, conv = _restore(snap)
+        it0 = it                       # this-run history starts here
         history = []
         log = verbose_logger("als", self.verbose)
         while not conv:
@@ -160,16 +173,34 @@ class ALS(BaseEstimator):
                 min(checkpoint.every, self.max_iter - it)
             if chunk <= 0:
                 break
+            state = guard.admit(*state) if state is not None else \
+                guard.admit() or None
             if sparse_in:
-                u, v, rmse_dev, n_done, conv_dev, hist = _als_fit_sparse(
+                u, v, rmse_dev, n_done, conv_dev, hist, hvec = _als_fit_sparse(
                     rows_d, cols_d, vals, *t_trip, x.shape[0], x.shape[1],
-                    int(self.n_f), float(self.lambda_), float(self.tol),
+                    int(self.n_f), lam, float(self.tol),
                     chunk, int(seed), init_state=state)
             else:
-                u, v, rmse_dev, n_done, conv_dev, hist = _als_fit(
+                u, v, rmse_dev, n_done, conv_dev, hist, hvec = _als_fit(
                     x._data, test_p, x.shape, int(self.n_f),
-                    float(self.lambda_), float(self.tol), chunk, int(seed),
+                    lam, float(self.tol), chunk, int(seed),
                     init_state=state)
+            verdict = guard.check(hvec, carry_names=("users", "items"),
+                                  carry_shapes=((tu, int(self.n_f)),
+                                                (tv, int(self.n_f))), it=it)
+            if not verdict.ok:
+                rem = guard.remediate(verdict, it=it)
+                # ALS damping: the 'halve' action raises the per-row ridge
+                # λ·n_u per restart (ill-conditioned normal equations are
+                # the numeric failure mode of the batched Cholesky solves)
+                lam = float(self.lambda_) * rem.damping
+                snap = checkpoint.load()
+                if snap is not None:
+                    state, rmse, it, conv = _restore(snap, rem.perturb)
+                else:                   # nothing written yet: from scratch
+                    it, rmse, conv, state = 0, np.inf, False, None
+                del history[max(0, it - it0):]
+                continue
             it += int(n_done)
             rmse = float(rmse_dev)
             conv = bool(conv_dev)
@@ -181,8 +212,9 @@ class ALS(BaseEstimator):
                 # (their HBM is reused in place), so their device->host
                 # copies must land before that dispatch: fetch blocking,
                 # and offload only the checksum+write to the snapshot
-                # worker (it still overlaps the next chunk's compute)
-                checkpoint.save_async({
+                # worker (it still overlaps the next chunk's compute).
+                # The write is GATED on this chunk's health verdict.
+                guard.save_async(checkpoint, {
                     "users": _fetch(u), "items": _fetch(v),
                     "m": x.shape[0], "n": x.shape[1],
                     "rmse": rmse, "n_iter": it, "converged": conv})
@@ -225,7 +257,7 @@ class ALS(BaseEstimator):
     def _fit_finalize(self, state):
         if state is None:
             return
-        (u, v, rmse, n_iter, conv, hist), (m, n) = state
+        (u, v, rmse, n_iter, conv, hist, _), (m, n) = state
         self.users_ = np.asarray(jax.device_get(u))[:m]
         self.items_ = np.asarray(jax.device_get(v))[:n]
         self.rmse_ = float(rmse)
@@ -356,7 +388,10 @@ def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed,
     init = (u0, v0, prev0, jnp.int32(0), jnp.asarray(False),
             jnp.zeros((max_iter,), rp.dtype))
     u, v, cur, n_iter, conv, hist = lax.while_loop(cond, step, init)
-    return u, v, cur, n_iter, conv, hist
+    # fused health vector — same program, zero extra dispatches
+    from dislib_tpu.runtime import health as _health
+    hvec = _health.health_vec(carries=(u, v), hist=hist, n_done=n_iter)
+    return u, v, cur, n_iter, conv, hist, hvec
 
 
 @partial(_pjit, static_argnames=("m", "n", "n_f", "max_iter"),
@@ -442,7 +477,11 @@ def _als_fit_sparse(rows, cols, vals, trows, tcols, tvals, m, n, n_f,
 
     init = (u0, v0, prev0, jnp.int32(0), jnp.asarray(False),
             jnp.zeros((max_iter,), vals.dtype))
-    return lax.while_loop(cond, step, init)
+    u, v, cur, n_iter, conv, hist = lax.while_loop(cond, step, init)
+    # fused health vector — same program, zero extra dispatches
+    from dislib_tpu.runtime import health as _health
+    hvec = _health.health_vec(carries=(u, v), hist=hist, n_done=n_iter)
+    return u, v, cur, n_iter, conv, hist, hvec
 
 
 # nnz chunk cap for the streamed normal-equation sums, and the element

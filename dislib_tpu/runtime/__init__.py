@@ -13,7 +13,11 @@ the survival story is built from four pieces that compose (SURVEY §6
 - **elastic** — restore snapshots onto a different device count/mesh
   shape by re-padding host-side logical state (``elastic.py``);
 - **xla_flags** — the single guarded site allowed to mutate ``XLA_FLAGS``
-  (version-gated XLA:CPU collective-timeout mitigation; ``xla_flags.py``).
+  (version-gated XLA:CPU collective-timeout mitigation; ``xla_flags.py``);
+- **health** — the round-8 *internal*-fault layer: fused numerical-health
+  guards on every chunked fit loop, a chunk watchdog, snapshot writes
+  gated on healthy chunks, and rollback-to-last-good remediation
+  (``health.py``).
 
 Crash-consistent rotating snapshots live with the checkpoint format in
 ``dislib_tpu.utils.checkpoint``; the deterministic fault-injection harness
@@ -21,7 +25,10 @@ driving ``tests/test_resilience.py`` is ``dislib_tpu.utils.faults``.
 """
 
 from dislib_tpu.runtime import xla_flags  # noqa: F401
+from dislib_tpu.runtime import health  # noqa: F401
 from dislib_tpu.runtime.elastic import AsyncFetch, fetch, repad_rows
+from dislib_tpu.runtime.health import (ChunkGuard, HealthPolicy,
+                                       NumericalDivergence, WatchdogTimeout)
 from dislib_tpu.runtime.preemption import (
     Preempted, PreemptionWatcher, clear_preemption, last_signal,
     preemption_requested, raise_if_preempted, request_preemption,
@@ -34,5 +41,6 @@ __all__ = [
     "raise_if_preempted",
     "Retry", "retry_call", "is_transient_error",
     "repad_rows", "fetch", "AsyncFetch",
-    "xla_flags",
+    "HealthPolicy", "ChunkGuard", "NumericalDivergence", "WatchdogTimeout",
+    "health", "xla_flags",
 ]

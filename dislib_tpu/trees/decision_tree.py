@@ -43,6 +43,7 @@ from dislib_tpu.utils.profiling import profiled_jit as _pjit
 from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows, \
     preemption_requested as _preemption_requested, \
     raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import health as _health
 
 # Discretisation contract (documented divergence from the reference, which
 # delegates subtrees to exact sklearn trees with arbitrary thresholds):
@@ -170,17 +171,26 @@ def _forest_level(node, bx, w, stats, keys, n_nodes, try_features,
                   min_gain, criterion, n_bins):
     step = partial(_level_step, n_nodes=n_nodes, try_features=try_features,
                    min_gain=min_gain, criterion=criterion, n_bins=n_bins)
-    return jax.vmap(step, in_axes=(0, None, 0, None, 0))(
-        node, bx, w, stats, keys)
+    feat, tbin, is_split, new_node, totals = \
+        jax.vmap(step, in_axes=(0, None, 0, None, 0))(
+            node, bx, w, stats, keys)
+    # fused health vector — same program, zero extra dispatches.  The
+    # per-node stat totals are where a poisoned weight/stat carry first
+    # shows up as NaN (feat/tbin/node are integral and cannot hold one).
+    hvec = _health.health_vec(carries=(totals, w))
+    return feat, tbin, is_split, new_node, totals, hvec
 
 
 @partial(_pjit, static_argnames=("n_leaves",), name="leaf_stats")
 def _leaf_stats(node, w, stats, n_leaves):
-    """Final-level per-leaf stat sums: (T, n_leaves, S)."""
+    """Final-level per-leaf stat sums: (T, n_leaves, S), plus the fused
+    health vector over them (the forest's terminal numeric state — a NaN
+    here is what would silently poison every prediction)."""
     def one(nd, wt):
         out = jnp.zeros((n_leaves, stats.shape[1]), jnp.float32)
         return out.at[nd].add(wt[:, None] * stats)
-    return jax.vmap(one)(node, w)
+    leaves = jax.vmap(one)(node, w)
+    return leaves, _health.health_vec(carries=(leaves,))
 
 
 @partial(_pjit, static_argnames=("depth", "q_shape"), name="forest_apply")
@@ -252,7 +262,7 @@ class _BaseTreeEnsemble(BaseEstimator):
         return max(1, int(tf))
 
     def _grow_forest(self, x: Array, stats_host, n_trees, bootstrap,
-                     checkpoint=None):
+                     checkpoint=None, health=None):
         """Dispatch the whole forest growth as device programs — no host
         read (the async-fit half; `_adopt_forest` materialises attrs).
 
@@ -329,54 +339,122 @@ class _BaseTreeEnsemble(BaseEstimator):
 
         stats = jnp.asarray(stats_host)               # (mp, S)
         try_features = self._try_features_count(n)
+        guard = _health.guard("forest", health, checkpoint)
 
         def _snap(lvl_next):
             # node is donated to the next level's kernel — its copy must
             # land on host before that dispatch (blocking fetch); only the
-            # checksum+file write moves to the snapshot worker
+            # checksum+file write moves to the snapshot worker.  The write
+            # is GATED on the chunk's health verdict (guard.save_async).
             state = {"lvl": lvl_next, "seed": seed, "fp": fp,
                      "digest": digest, "node": _fetch(node), "w": _fetch(w)}
             for i, (f_, t_) in enumerate(zip(feats, tbins)):
                 state[f"feats_{i}"] = _fetch(f_)
                 state[f"tbins_{i}"] = _fetch(t_)
-            checkpoint.save_async(state)
+            guard.save_async(checkpoint, state)
 
-        for lvl in range(start_lvl, depth):
+        base_lvl = start_lvl            # snapshot cadence anchor
+        lvl = start_lvl
+        while lvl < depth:
             key, k_lvl = jax.random.split(key)
             keys = jax.random.split(k_lvl, n_trees)
-            feat, tbin, is_split, node, _ = _forest_level(
+            (w,) = guard.admit(w)
+            feat, tbin, is_split, node, _, hvec = _forest_level(
                 node, bx, w, stats, keys, 2 ** lvl, try_features,
                 0.0, self._criterion, n_bins)
             feats.append(feat)
             tbins.append(tbin)
-            if checkpoint is not None and lvl + 1 < depth:
-                if (lvl + 1 - start_lvl) % checkpoint.every == 0:
-                    _snap(lvl + 1)
+            nxt = lvl + 1
+            if checkpoint is not None:
+                at_every = (nxt - base_lvl) % checkpoint.every == 0
+                preempt = _preemption_requested()
+                if nxt == depth or at_every or preempt:
+                    # chunk boundary: the fused per-level health vector is
+                    # read here (one sync per chunk, same cadence as the
+                    # snapshot's own blocking fetches)
+                    verdict = guard.check(
+                        hvec, carry_names=("node_totals", "w"), it=nxt)
+                    if not verdict.ok:
+                        rem = guard.remediate(verdict, it=nxt)
+                        snap2 = checkpoint.load()
+                        if snap2 is not None:   # last-good level boundary
+                            base_lvl = int(snap2["lvl"])
+                            node = jnp.asarray(
+                                _repad_rows(snap2["node"], m, mp, axis=1))
+                            w = jnp.asarray(rem.perturb(
+                                _repad_rows(snap2["w"], m, mp, axis=1)))
+                            feats = [jnp.asarray(snap2[f"feats_{i}"])
+                                     for i in range(base_lvl)]
+                            tbins = [jnp.asarray(snap2[f"tbins_{i}"])
+                                     for i in range(base_lvl)]
+                        else:           # nothing written yet: from scratch
+                            base_lvl = 0
+                            if bootstrap:
+                                w = jax.random.poisson(
+                                    k_boot, 1.0,
+                                    (n_trees, mp)).astype(jnp.float32)
+                            else:
+                                w = jnp.ones((n_trees, mp), jnp.float32)
+                            w = rem.perturb(_fetch(w * jnp.asarray(
+                                valid)[None, :]))
+                            w = jnp.asarray(w)
+                            node = jnp.zeros((n_trees, mp), jnp.int32)
+                            feats, tbins = [], []
+                        # replay the PRNG key chain to the rollback level —
+                        # a resumed growth stays bit-identical
+                        key = jax.random.PRNGKey(int(seed))
+                        k_boot, key = jax.random.split(key)
+                        for _ in range(base_lvl):
+                            key, _ = jax.random.split(key)
+                        lvl = base_lvl
+                        continue
+                if nxt < depth and (at_every or preempt):
+                    _snap(nxt)
+                    # preemption notice between levels: snapshot NOW (the
+                    # off-`every` case included) and raise cleanly — a
+                    # level boundary is always a resumable point
                     _raise_if_preempted(checkpoint)
-                elif _preemption_requested():
-                    # preemption notice between levels: snapshot NOW (off
-                    # the `every` boundary) and raise cleanly — a level
-                    # boundary is always a resumable point
-                    _snap(lvl + 1)
-                    _raise_if_preempted(checkpoint)
+            lvl = nxt
 
         if checkpoint is not None:
             checkpoint.flush()          # last level snapshot lands
-        leaves = _leaf_stats(node, w, stats, 2 ** depth)
+        leaves, leaf_hvec = _leaf_stats(node, w, stats, 2 ** depth)
         # feats/tbins stay as the ragged per-level device arrays: packing
         # here would dispatch eager multi-device pad/stack programs while
         # the level producers are still in flight — on a thread-starved
         # XLA:CPU pool their parked rendezvous participants can starve the
         # producers into a true deadlock (observed round 3).  The pack
         # happens on host at adoption, or traced INSIDE the score kernels.
+        # `hvec` rides along so the adoption step (the first host
+        # materialisation) can refuse a non-finite forest — the async
+        # dispatch-only contract of this function is preserved.
         return {"edges": edges, "feats": tuple(feats), "tbins": tuple(tbins),
-                "depth": depth, "leaves": leaves, "n_features": n}
+                "depth": depth, "leaves": leaves, "n_features": n,
+                "hvec": leaf_hvec, "guard": guard}
 
     def _adopt_forest(self, grown):
         """Materialise fitted attributes from a `_grow_forest` handle.
         The ragged per-level (T, 2^lvl) arrays pad+stack to (T, depth,
         2^(depth-1)) in host NumPy — tiny arrays, and no extra device
-        programs — so predict calls are a single gather-walk jit."""
+        programs — so predict calls are a single gather-walk jit.
+
+        Adoption is the first host materialisation of the grown forest,
+        so the fused leaf health vector is judged here: a non-finite
+        forest raises a typed ``NumericalDivergence`` instead of silently
+        serving NaN predictions (rollback is no longer possible at this
+        point — the checkpointed growth loop already healed what it
+        could)."""
+        hvec = grown.get("hvec")
+        if hvec is not None:
+            g = grown.get("guard") or _health.guard("forest")
+            v = g.check(hvec, carry_names=("leaves",),
+                        carry_shapes=(np.shape(grown["leaves"]),))
+            if not v.ok:
+                raise _health.NumericalDivergence(
+                    f"forest: health guard {v.guard!r} tripped at adoption "
+                    f"— the grown forest is not numerically usable "
+                    f"(detail: {v.detail})",
+                    estimator="forest", guard=v.guard, detail=v.detail)
         wide = 2 ** (grown["depth"] - 1)
 
         def _pack(levels):
@@ -392,11 +470,13 @@ class _BaseTreeEnsemble(BaseEstimator):
         self.n_features_ = grown["n_features"]
         return self
 
-    def fit(self, x: Array, y: Array, checkpoint=None):
+    def fit(self, x: Array, y: Array, checkpoint=None, health=None):
         """Shared fit = the async protocol run to completion (one recipe —
         sync and async fits cannot diverge).  ``checkpoint``: see
-        `_grow_forest` (per-level snapshots + resume)."""
-        self._fit_finalize(self._fit_async(x, y, checkpoint=checkpoint))
+        `_grow_forest` (per-level snapshots + resume); ``health``: see
+        `_grow_forest` (per-chunk fused guards + rollback)."""
+        self._fit_finalize(self._fit_async(x, y, checkpoint=checkpoint,
+                                           health=health))
         return self
 
     # async trial protocol (SURVEY §4.5): growth is read-free device
@@ -404,13 +484,13 @@ class _BaseTreeEnsemble(BaseEstimator):
     # reads the INPUT y (prep, not fit results) at dispatch time, cached
     # per (y, padding) so a search encodes each fold once, not once per
     # candidate.
-    def _fit_async(self, x, y=None, checkpoint=None):
+    def _fit_async(self, x, y=None, checkpoint=None, health=None):
         if y is None:
             raise ValueError(f"{type(self).__name__} requires y")
         stats = self._encode_stats(x, y)
         n_trees, bootstrap = self._fit_spec()
         return self._grow_forest(x, stats, n_trees, bootstrap,
-                                 checkpoint=checkpoint)
+                                 checkpoint=checkpoint, health=health)
 
     def _fit_finalize(self, state):
         if state is None:

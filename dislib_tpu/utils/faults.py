@@ -13,7 +13,16 @@ Three fault families:
   or replaces a snapshot with a foreign ``.npz``;
 - **flaky IO / RPC** — :class:`FlakyCall` and :class:`FlakyOpen` fail the
   first n invocations with a transient error, exercising the
-  :class:`~dislib_tpu.runtime.retry.Retry` policy.
+  :class:`~dislib_tpu.runtime.retry.Retry` policy;
+- **numerical / liveness faults** (round-8 health PR) —
+  :class:`NaNAtChunk` poisons a loop carry at an exact chunk index,
+  :class:`DivergenceRamp` scales it into a blow-up, :class:`HangAtChunk`
+  stalls a chunk's force point past the watchdog deadline, and
+  :class:`TripAtChunk` forces a guard verdict where no float carry exists
+  to poison (the cascade SVM's host-side state).  All four are
+  :class:`~dislib_tpu.runtime.health.HealthPolicy` subclasses: pass them
+  as ``fit(..., health=...)`` and the estimator's own guard becomes the
+  injector — the production code path is exercised unchanged.
 """
 
 from __future__ import annotations
@@ -21,13 +30,16 @@ from __future__ import annotations
 import builtins
 import os
 import signal as _signal
+import time as _time
 
 import numpy as np
 
+from dislib_tpu.runtime.health import ChunkGuard, HealthPolicy, Verdict
 from dislib_tpu.utils.checkpoint import FitCheckpoint
 
 __all__ = ["CallbackCheckpoint", "SigtermAtNthSave", "sigterm_self",
-           "corrupt_snapshot", "FlakyCall", "FlakyOpen"]
+           "corrupt_snapshot", "FlakyCall", "FlakyOpen",
+           "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk"]
 
 
 class CallbackCheckpoint(FitCheckpoint):
@@ -130,3 +142,178 @@ class FlakyOpen:
             self.fails += 1
             raise self.exc_factory()
         return self._real(file, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# numerical / liveness fault injection (round-8 health PR)
+# ---------------------------------------------------------------------------
+
+def _poison_carry(carries, where, mutate):
+    """Apply ``mutate(host_ndarray) -> host_ndarray`` to the ``where``-th
+    float-dtype array among ``carries`` (None/ints/scalars skipped),
+    returning ``(new_tuple, hit)``.  The poisoned carry re-enters the
+    device as a fresh array — exactly what a corrupted HBM buffer or a
+    bad collective would hand the next chunk.  ``hit`` is False when no
+    eligible carry exists (e.g. a first chunk that admits no state) —
+    callers keep the fault ARMED then, so an injection can never be
+    silently lost and a resilience test can never vacuously pass against
+    an unfaulted run."""
+    import jax
+    import jax.numpy as jnp
+    out = list(carries)
+    fi = 0
+    for i, c in enumerate(carries):
+        dt = getattr(c, "dtype", None)
+        if dt is None or not np.issubdtype(np.dtype(dt), np.floating) \
+                or getattr(c, "ndim", 0) == 0:
+            continue
+        if fi == where:
+            host = np.array(jax.device_get(c))
+            out[i] = jnp.asarray(mutate(host))
+            return tuple(out), True
+        fi += 1
+    return tuple(out), False
+
+
+class NaNAtChunk(HealthPolicy):
+    """Health policy whose guard poisons one carry with NaN right before
+    the ``at_chunk``-th chunk dispatches (1-based admit count) — the
+    deterministic stand-in for a numerical blow-up inside that chunk.
+    Fires once: after a rollback the re-run chunk is clean, so a fit
+    under the default 'retry' action must land on the unfaulted model.
+
+    ``where`` selects the n-th float carry, ``position`` the flat element
+    poisoned (middle when None)."""
+
+    def __init__(self, at_chunk=2, where=0, position=None, **kw):
+        super().__init__(**kw)
+        self.at_chunk = int(at_chunk)
+        self.where = int(where)
+        self.position = position
+        self.fired = 0
+
+    def make_guard(self, name, checkpoint=None):
+        return _NaNAtChunkGuard(name, self, checkpoint)
+
+
+class _NaNAtChunkGuard(ChunkGuard):
+    def admit(self, *carries):
+        carries = super().admit(*carries)
+        pol = self.policy
+        # >= keeps the fault ARMED past a chunk with no eligible carry
+        # (e.g. ALS's first fresh chunk admits no state): it lands on the
+        # first admit that CAN be poisoned instead of silently fizzling
+        if self.chunk_index >= pol.at_chunk and not pol.fired:
+            def mutate(host):
+                pos = host.size // 2 if pol.position is None \
+                    else int(pol.position) % max(host.size, 1)
+                host.flat[pos] = np.nan
+                return host
+            carries, hit = _poison_carry(carries, pol.where, mutate)
+            pol.fired += int(hit)
+        return carries
+
+
+class DivergenceRamp(HealthPolicy):
+    """Health policy whose guard scales one carry by ``factor`` at every
+    chunk from ``at_chunk`` on (or once, with ``repeat=False``) — a
+    deterministic divergence ramp for the norm-growth / monotonicity
+    guards (arm them: ``grow_limit=`` or ``monotone_rtol=``)."""
+
+    def __init__(self, at_chunk=1, factor=1e4, repeat=True, **kw):
+        super().__init__(**kw)
+        self.at_chunk = int(at_chunk)
+        self.factor = float(factor)
+        self.repeat = bool(repeat)
+        self.fired = 0
+
+    def make_guard(self, name, checkpoint=None):
+        return _DivergenceRampGuard(name, self, checkpoint)
+
+
+class _DivergenceRampGuard(ChunkGuard):
+    def admit(self, *carries):
+        carries = super().admit(*carries)
+        pol = self.policy
+        if self.chunk_index >= pol.at_chunk and (pol.repeat or not pol.fired):
+            carries, hit = _poison_carry(
+                carries, 0, lambda host: host * pol.factor)
+            pol.fired += int(hit)
+        return carries
+
+
+class HangAtChunk(HealthPolicy):
+    """Health policy whose guard stalls the ``at_chunk``-th chunk's force
+    point (the health read) for ``hang_s`` seconds, ``times`` attempts in
+    a row — the deterministic stand-in for a hung collective/dispatch.
+    With ``deadline_s < hang_s`` the watchdog trips a typed
+    ``WatchdogTimeout``; the PR-1 ``Retry`` policy re-attempts the
+    resolution, so ``times=1`` self-heals on the second attempt and a
+    large ``times`` exhausts the attempts and aborts cleanly.
+
+    The stall fires at the first CHECK at-or-after ``at_chunk`` (loops
+    like the forest's only check at snapshot boundaries, so an exact
+    match could silently never inject — the same armed-fault rule as
+    ``_poison_carry``), and the injector pins ``first_deadline_s`` to
+    the steady-state deadline so the production compile-grace on a
+    guard's first check cannot mask the injected hang."""
+
+    def __init__(self, at_chunk=1, hang_s=0.4, times=1, deadline_s=0.05,
+                 **kw):
+        kw.setdefault("first_deadline_s", deadline_s)
+        super().__init__(deadline_s=deadline_s, **kw)
+        self.at_chunk = int(at_chunk)
+        self.hang_s = float(hang_s)
+        self.times = int(times)
+        self.stalls = 0
+
+    def make_guard(self, name, checkpoint=None):
+        return _HangAtChunkGuard(name, self, checkpoint)
+
+
+class _HangAtChunkGuard(ChunkGuard):
+    def _resolve(self, handle):
+        pol = self.policy
+        if self.chunk_index >= pol.at_chunk and pol.stalls < pol.times:
+            pol.stalls += 1
+            _time.sleep(pol.hang_s)
+        return super()._resolve(handle)
+
+
+class TripAtChunk(HealthPolicy):
+    """Health policy whose guard forces an unhealthy verdict at the
+    ``at_chunk``-th chunk regardless of the actual values — for loops
+    whose numeric state offers nothing to poison (the cascade SVM's
+    host-side SV indices) and for exercising the gating/rollback
+    machinery in isolation.  Fires at the first ``times`` checks from
+    ``at_chunk`` on (``times`` > max_restarts exhausts the remediation
+    budget and forces the typed raise)."""
+
+    def __init__(self, at_chunk=1, guard_name="injected", times=1, **kw):
+        super().__init__(**kw)
+        self.at_chunk = int(at_chunk)
+        self.guard_name = guard_name
+        self.times = int(times)
+        self.fired = 0
+
+    def make_guard(self, name, checkpoint=None):
+        return _TripAtChunkGuard(name, self, checkpoint)
+
+
+class _TripAtChunkGuard(ChunkGuard):
+    def _maybe_trip(self, it):
+        pol = self.policy
+        if self.chunk_index >= pol.at_chunk and pol.fired < pol.times:
+            pol.fired += 1
+            v = Verdict(False, guard=pol.guard_name,
+                        detail={"iteration": it, "injected": True})
+            self.last_verdict = v
+            return v
+        return None
+
+    def check(self, hvec, carry_names=(), carry_shapes=(), it=None):
+        return self._maybe_trip(it) or super().check(
+            hvec, carry_names, carry_shapes, it)
+
+    def check_host(self, values, it=None):
+        return self._maybe_trip(it) or super().check_host(values, it)

@@ -1,0 +1,118 @@
+"""Minimal, dependency-free stand-in for the slice of `hypothesis` the
+property tier uses (round-8 satellite: the env-gated property tests must
+RUN on a rig without the package instead of silently skipping).
+
+Semantics: deterministic seeded random sampling — every example's RNG is
+seeded from (test qualname, example index), so failures reproduce
+bit-identically and a plain re-run replays the exact same examples.  No
+shrinking, no example database, no deadline handling: when the real
+`hypothesis` is installed (the ``dev`` extra in pyproject.toml),
+``tests/test_property.py`` prefers it automatically and gains the full
+search.  Covered API: ``given``, ``settings(max_examples, deadline)``,
+``strategies.integers/floats/lists/composite/data``.
+"""
+
+from __future__ import annotations
+
+import functools
+import types
+import zlib
+
+import numpy as np
+
+__all__ = ["given", "settings", "strategies"]
+
+
+class _Strategy:
+    def __init__(self, sample):
+        self._sample = sample
+
+    def sample(self, rng):
+        return self._sample(rng)
+
+
+def _integers(lo, hi):
+    lo, hi = int(lo), int(hi)
+    if hi < lo:           # hypothesis raises too; fail loudly, not silently
+        raise ValueError(f"integers({lo}, {hi}): empty range")
+    return _Strategy(lambda rng: int(rng.randint(lo, hi + 1)))
+
+
+def _floats(lo, hi):
+    lo, hi = float(lo), float(hi)
+    return _Strategy(lambda rng: float(lo + (hi - lo) * rng.random_sample()))
+
+
+def _lists(elem, min_size=0, max_size=None):
+    max_size = (min_size + 10) if max_size is None else max_size
+
+    def sample(rng):
+        k = int(rng.randint(int(min_size), int(max_size) + 1))
+        return [elem.sample(rng) for _ in range(k)]
+    return _Strategy(sample)
+
+
+def _composite(fn):
+    """``@st.composite`` — the wrapped function receives ``draw`` first."""
+    def build(*args, **kwargs):
+        return _Strategy(
+            lambda rng: fn(lambda st: st.sample(rng), *args, **kwargs))
+    return build
+
+
+class _DataObject:
+    """``st.data()`` value: mid-test draws share the example's RNG."""
+
+    def __init__(self, rng):
+        self._rng = rng
+
+    def draw(self, st, label=None):
+        return st.sample(self._rng)
+
+
+def _data():
+    return _Strategy(_DataObject)
+
+
+strategies = types.SimpleNamespace(
+    integers=_integers, floats=_floats, lists=_lists, composite=_composite,
+    data=_data)
+
+
+def settings(max_examples=20, deadline=None, **_ignored):
+    """Decorator recording the example budget (deadline is accepted and
+    ignored — the lite runner never times out an example)."""
+    def deco(fn):
+        fn._hl_max_examples = int(max_examples)
+        return fn
+    return deco
+
+
+def given(*strats):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            # read the budget at CALL time, checking the wrapper first:
+            # @settings above @given tags the wrapper, below tags fn —
+            # hypothesis allows both orders and so must the shim
+            n = int(getattr(wrapper, "_hl_max_examples",
+                            getattr(fn, "_hl_max_examples", 20)))
+            for ex in range(n):
+                tag = f"{fn.__module__}.{fn.__qualname__}:{ex}"
+                rng = np.random.RandomState(
+                    zlib.crc32(tag.encode()) & 0xFFFFFFFF)
+                vals = [s.sample(rng) for s in strats]
+                try:
+                    fn(*args, *vals, **kwargs)
+                except Exception as e:  # noqa: BLE001 — annotate + re-raise
+                    e.args = ((f"[hypothesis-lite example {ex}/{n}, "
+                               f"drawn args: {vals!r}] {e.args[0] if e.args else ''}",)
+                              + e.args[1:])
+                    raise
+        # pytest must not see the strategy-filled parameters as fixtures:
+        # hide the wrapped signature (hypothesis does the same)
+        del wrapper.__wrapped__
+        import inspect
+        wrapper.__signature__ = inspect.Signature()
+        return wrapper
+    return deco

@@ -1,0 +1,137 @@
+"""Chaos soak (round-8 satellite, `slow` tier — run via
+``tools/chaos_soak.sh``, excluded from tier-1): N randomized-SCHEDULE but
+seeded-and-reproducible fit runs, each drawing a random combination of
+every fault family the runtime defends against —
+
+- preemption requested mid-fit (PR-1),
+- snapshot corruption between the faulted fit and the resume (PR-1),
+- NaN poisoned into a chunk carry (round-8),
+- a hung chunk force point under a watchdog deadline (round-8),
+
+— and asserts the ONE invariant the whole resilience+health stack
+promises: a fit either completes with a finite model (self-healed), or
+raises a TYPED diagnostic (``Preempted`` / ``NumericalDivergence`` /
+``WatchdogTimeout`` / ``SnapshotCorrupt``), and a clean resume from
+whatever snapshot survives lands on the unfaulted model.  Never a silent
+bad model, a hang, or a corrupted-over-good snapshot.
+
+``DSLIB_SOAK_RUNS`` (default 10) and ``DSLIB_SOAK_SEED`` (default 0)
+parameterize the sweep; every run's schedule derives from the seed, so a
+failure reproduces with the printed seed alone.
+"""
+
+import json
+import os
+import warnings
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import GaussianMixture, KMeans
+from dislib_tpu.recommendation import ALS
+from dislib_tpu.runtime import (NumericalDivergence, Preempted,
+                                WatchdogTimeout, clear_preemption,
+                                request_preemption)
+from dislib_tpu.utils import FitCheckpoint, faults
+from dislib_tpu.utils.checkpoint import SnapshotCorrupt
+
+TYPED = (Preempted, NumericalDivergence, WatchdogTimeout, SnapshotCorrupt)
+
+
+def _estimator(kind, rng):
+    """(fresh estimator factory, ds-array data, model-vector extractor)."""
+    if kind == "kmeans":
+        c = rng.rand(3, 4) * 10
+        x_np = np.vstack([c[i] + 0.3 * rng.randn(60, 4)
+                          for i in range(3)]).astype(np.float32)
+        init = np.ascontiguousarray(x_np[[0, 60, 120]])
+        make = lambda: KMeans(n_clusters=3, init=init, max_iter=12, tol=0.0)  # noqa: E731
+        return make, ds.array(x_np), lambda e: e.centers_
+    if kind == "gmm":
+        x_np = np.vstack([rng.rand(60, 3),
+                          rng.rand(60, 3) + 4]).astype(np.float32)
+        make = lambda: GaussianMixture(n_components=2, max_iter=10, tol=0.0,  # noqa: E731
+                                       random_state=0)
+        return make, ds.array(x_np), lambda e: e.means_
+    u, v = rng.rand(30, 4), rng.rand(20, 4)
+    r = ((u @ v.T) * (rng.rand(30, 20) < 0.6)).astype(np.float32)
+    make = lambda: ALS(n_f=4, max_iter=8, tol=1e-9, random_state=0)  # noqa: E731
+    return make, ds.array(r), lambda e: e.users_
+
+
+def _one_run(i, seed, tmp_path, monkeypatch):
+    rng = np.random.RandomState(seed)
+    kind = ("kmeans", "gmm", "als")[rng.randint(3)]
+    make, x, model_of = _estimator(kind, rng)
+    full = make().fit(x)
+    ref = model_of(full)
+
+    path = str(tmp_path / f"soak{i}.npz")
+    want_nan = bool(rng.randint(2))
+    want_hang = bool(rng.randint(2))
+    want_preempt = bool(rng.randint(2))
+    want_corrupt = bool(rng.randint(2))
+    at_chunk = 1 + int(rng.randint(3))
+    if want_hang:
+        pol = faults.HangAtChunk(at_chunk=at_chunk, hang_s=0.3,
+                                 deadline_s=0.05,
+                                 times=int(rng.randint(1, 3)))
+    elif want_nan:
+        pol = faults.NaNAtChunk(at_chunk=at_chunk)
+    else:
+        pol = None
+    ck = faults.CallbackCheckpoint(path, every=2, after=1 + int(rng.randint(2)),
+                                   callback=request_preemption) \
+        if want_preempt else FitCheckpoint(path, every=2)
+
+    outcome = "healed"
+    try:
+        est = make().fit(x, checkpoint=ck, health=pol)
+    except TYPED as e:
+        outcome = f"typed:{type(e).__name__}"
+    else:
+        m = model_of(est)
+        assert np.isfinite(np.asarray(m)).all(), \
+            f"seed {seed}: silent non-finite model ({kind})"
+    finally:
+        clear_preemption()
+
+    if want_corrupt and os.path.exists(path):
+        faults.corrupt_snapshot(
+            path, mode=("flip", "truncate", "foreign")[rng.randint(3)])
+
+    # clean resume from whatever snapshot state survives must land on the
+    # unfaulted model (corrupt newest generation falls back one)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        try:
+            res = make().fit(x, checkpoint=FitCheckpoint(path, every=2))
+        except SnapshotCorrupt:
+            # every generation damaged: restart from scratch is the
+            # documented operator action — and must still work
+            for j in range(3):
+                p = path if j == 0 else f"{path}.{j}"
+                if os.path.exists(p):
+                    os.remove(p)
+            res = make().fit(x, checkpoint=FitCheckpoint(path, every=2))
+            outcome += "+restart"
+    np.testing.assert_allclose(model_of(res), ref, rtol=1e-4, atol=1e-5)
+    return kind, outcome
+
+
+@pytest.mark.slow
+def test_chaos_soak(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSLIB_RETRY_BACKOFF", "0")
+    runs = int(os.environ.get("DSLIB_SOAK_RUNS", "10"))
+    base = int(os.environ.get("DSLIB_SOAK_SEED", "0"))
+    tally = Counter()
+    for i in range(runs):
+        kind, outcome = _one_run(i, base + i, tmp_path, monkeypatch)
+        tally[f"{kind}:{outcome}"] += 1
+        clear_preemption()
+    summary = {"metric": "chaos_soak", "runs": runs, "seed": base,
+               "outcomes": dict(sorted(tally.items()))}
+    print("CHAOS_SOAK_SUMMARY " + json.dumps(summary))
+    assert sum(tally.values()) == runs

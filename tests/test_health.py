@@ -1,0 +1,602 @@
+"""Self-healing fit loops, end to end (round-8 robustness PR), driven by
+the deterministic numerical/liveness fault injectors in
+``dislib_tpu.utils.faults``:
+
+- **fused guards** — every chunk kernel emits a health vector inside its
+  existing dispatch; the zero-extra-dispatch claim is asserted with the
+  round-7 ``dispatch_count`` counters;
+- **rollback-to-last-good** — NaN injected into a chunk's carry rolls the
+  fit back to the last good snapshot generation (writes are gated on
+  healthy chunks) and, under the default 'retry' action, the healed fit
+  lands on the SAME model as an unfaulted run — for every estimator that
+  carries float state (KMeans, GMM, ALS, forest; the cascade SVM's
+  host-side state uses the forced-trip injector);
+- **typed diagnostics, never silent bad models** — without a checkpoint
+  (or with the budget exhausted / 'raise' policy / non-finite input data)
+  the fit raises ``NumericalDivergence`` carrying estimator, iteration,
+  guard, and offending-carry coordinates; DBSCAN/Daura raise it on
+  non-finite input instead of silently emitting an all-noise clustering;
+- **chunk watchdog** — a hung force point trips ``WatchdogTimeout``,
+  escalates through the PR-1 ``Retry`` policy, and either self-heals or
+  aborts cleanly;
+- **ingest quarantine** — loaders isolate non-finite rows into a
+  ``QuarantineReport`` instead of poisoning blocks.
+
+Every fault fires on an exact chunk index — no timers (the hang injector
+sleeps a fixed interval but FIRES deterministically), no RNG — so the
+suite reproduces on any rig.
+"""
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import DBSCAN, Daura, GaussianMixture, KMeans
+from dislib_tpu.recommendation import ALS
+from dislib_tpu.runtime import (HealthPolicy, NumericalDivergence,
+                                WatchdogTimeout)
+from dislib_tpu.runtime import health as health_mod
+from dislib_tpu.utils import FitCheckpoint, faults
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore:.*invalid value encountered.*")
+
+
+@pytest.fixture
+def fast_retry(monkeypatch):
+    monkeypatch.setenv("DSLIB_RETRY_BACKOFF", "0")
+
+
+def _blobs(rng, n=198, d=4, k=3):
+    centers = rng.rand(k, d) * 10
+    x = np.vstack([centers[i] + 0.3 * rng.randn(n // k, d) for i in range(k)])
+    return x.astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# the health vector + guard classification (unit tier)
+# ---------------------------------------------------------------------------
+
+class TestHealthVec:
+    def test_layout_and_nonfinite_coords(self):
+        @jax.jit
+        def k(c, hist):
+            return health_mod.health_vec(carries=(c,), hist=hist, n_done=3)
+
+        c = jnp.asarray([[1.0, 2.0], [np.nan, 4.0]])
+        hist = jnp.asarray([5.0, 4.0, 6.0, 0.0])   # rise 2.0 inside n_done
+        h = np.asarray(k(c, hist))
+        assert len(h) == health_mod.HEALTH_BASE_LEN + 2
+        g = health_mod.guard("t")
+        v = g.check(h, carry_names=("centers",), carry_shapes=((2, 2),))
+        assert not v.ok and v.guard == "nonfinite" and v.recoverable
+        info = v.detail["carries"]["centers"]
+        assert info["count"] == 1 and info["coords"] == (1, 0)
+
+    def test_monotone_and_growth_guards_are_opt_in(self):
+        @jax.jit
+        def k(c, hist):
+            return health_mod.health_vec(carries=(c,), hist=hist)
+
+        h = np.asarray(k(jnp.full((2, 2), 50.0),
+                         jnp.asarray([1.0, 3.0])))   # rises, |carry|=50
+        assert health_mod.guard("t").check(h).ok, \
+            "default policy must trip on nonfinite only"
+        pol = HealthPolicy(monotone_rtol=0.1)
+        v = pol.make_guard("t").check(h)
+        assert not v.ok and v.guard == "divergence"
+        pol = HealthPolicy(grow_limit=10.0)
+        v = pol.make_guard("t").check(h)
+        assert not v.ok and v.guard == "norm-growth"
+
+    def test_loss_nonfinite_trips_even_with_clean_carries(self):
+        # a transient blow-up can wash out of a self-correcting carry
+        # (Lloyd's M-step recomputes centers from data) yet poison the
+        # trajectory — the loss history is the witness
+        @jax.jit
+        def k(c, hist):
+            return health_mod.health_vec(carries=(c,), hist=hist, n_done=2)
+
+        h = np.asarray(k(jnp.ones((2, 2)), jnp.asarray([np.nan, 1.0])))
+        v = health_mod.guard("t").check(h)
+        assert not v.ok and v.guard == "nonfinite"
+        assert v.detail["loss_nonfinite"] == 1
+
+    def test_input_nonfinite_is_not_recoverable(self):
+        @jax.jit
+        def k(x):
+            return health_mod.health_vec(inputs=(x,))
+
+        h = np.asarray(k(jnp.asarray([[np.inf, 1.0]])))
+        g = health_mod.guard("t", checkpoint=object())
+        v = g.check(h)
+        assert not v.ok and v.guard == "input-nonfinite" and not v.recoverable
+        with pytest.raises(NumericalDivergence, match="quarantine"):
+            g.remediate(v)
+
+    def test_cross_chunk_monotone_jump_trips(self):
+        """A loss jump landing exactly on a chunk boundary — invisible to
+        the in-chunk diffs, and at every=1 the ONLY signal — must trip
+        the armed monotone guard via the host-side loss carry-over."""
+        @jax.jit
+        def k(hist):
+            return health_mod.health_vec(hist=hist, n_done=1)
+
+        g = HealthPolicy(monotone_rtol=0.1).make_guard("t")
+        assert g.check(np.asarray(k(jnp.asarray([5.0])))).ok
+        assert g.check(np.asarray(k(jnp.asarray([4.0])))).ok  # fell: fine
+        v = g.check(np.asarray(k(jnp.asarray([9.0]))))        # jumped
+        assert not v.ok and v.guard == "divergence"
+        # remediate drops the reference: the re-run chunk is not judged
+        # against the pre-rollback trajectory
+        g.checkpoint = object()
+        g.remediate(v)
+        assert g.check(np.asarray(k(jnp.asarray([9.0])))).ok
+
+    def test_increasing_metric_mode(self):
+        @jax.jit
+        def k(hist):
+            return health_mod.health_vec(hist=hist, increasing=True)
+
+        h = np.asarray(k(jnp.asarray([2.0, 1.0])))  # fell: violation 1.0
+        v = HealthPolicy(monotone_rtol=0.1).make_guard("t").check(h)
+        assert not v.ok and v.guard == "divergence"
+
+    def test_remediation_schedule_and_budget(self):
+        pol = HealthPolicy(action="halve", max_restarts=2)
+        g = pol.make_guard("t", checkpoint=object())
+        bad = health_mod.Verdict(False, guard="nonfinite")
+        r1, r2 = g.remediate(bad), g.remediate(bad)
+        assert (r1.attempt, r2.attempt) == (1, 2)
+        assert (r1.damping, r2.damping) == (2.0, 4.0)
+        with pytest.raises(NumericalDivergence, match="max_restarts"):
+            g.remediate(bad)
+
+    def test_reseed_perturb_is_deterministic_and_action_scoped(self):
+        arr = np.ones((3, 2), np.float32)
+        r = health_mod.Remediation(1, "reseed", seed=7)
+        out1, out2 = r.perturb(arr), r.perturb(arr)
+        np.testing.assert_array_equal(out1, out2)
+        assert not np.array_equal(out1, arr)
+        np.testing.assert_array_equal(
+            health_mod.Remediation(1, "retry", seed=7).perturb(arr), arr)
+
+    def test_policy_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("DSLIB_HEALTH_ACTION", "raise")
+        monkeypatch.setenv("DSLIB_HEALTH_MAX_RESTARTS", "5")
+        monkeypatch.setenv("DSLIB_CHUNK_DEADLINE_S", "1.5")
+        monkeypatch.setenv("DSLIB_HEALTH_GROW_LIMIT", "1e6")
+        pol = HealthPolicy()
+        assert (pol.action, pol.max_restarts, pol.deadline_s,
+                pol.grow_limit) == ("raise", 5, 1.5, 1e6)
+        monkeypatch.setenv("DSLIB_HEALTH", "0")
+        assert not HealthPolicy().enabled
+        g = HealthPolicy().make_guard("t")
+        assert g.check(np.asarray([9.0] * 8)).ok, "disabled guard admits all"
+
+    def test_save_gate_blocks_unhealthy_state(self, tmp_path):
+        ck = FitCheckpoint(str(tmp_path / "s.npz"), every=1)
+        g = health_mod.guard("t", checkpoint=ck)
+        g.check_host({"w": np.asarray([1.0])})
+        g.save_async(ck, {"gen": np.asarray([0])})
+        ck.flush()
+        g.check_host({"w": np.asarray([np.nan])})
+        assert g.save_async(ck, {"gen": np.asarray([1])}) is None
+        ck.flush()
+        assert int(ck.load()["gen"][0]) == 0, \
+            "unhealthy state rotated over the good generation"
+
+
+# ---------------------------------------------------------------------------
+# chunk watchdog
+# ---------------------------------------------------------------------------
+
+class TestWatchdog:
+    def test_deadline_trips_typed_timeout(self):
+        import time as _t
+
+        class Slow:
+            def result(self):
+                _t.sleep(0.3)
+                return np.zeros(health_mod.HEALTH_BASE_LEN)
+
+        # first_deadline_s pinned: this is the fresh guard's first check,
+        # which otherwise gets the 10x compile grace
+        g = HealthPolicy(deadline_s=0.05,
+                         first_deadline_s=0.05).make_guard("t")
+        with pytest.raises(WatchdogTimeout, match="force point"):
+            g._watched_resolve(Slow())
+
+    def test_first_check_gets_compile_grace(self, fast_retry, monkeypatch):
+        """The guard's FIRST force point usually blocks on XLA compile —
+        it gets the (default 10x) grace deadline; steady-state checks get
+        the tight one."""
+        import time as _t
+
+        from dislib_tpu.runtime.elastic import AsyncFetch
+
+        class Slow(AsyncFetch):
+            def __init__(self):
+                pass
+
+            def result(self):
+                _t.sleep(0.2)
+                return np.zeros(health_mod.HEALTH_BASE_LEN)
+
+        monkeypatch.setenv("DSLIB_RETRY_ATTEMPTS", "1")
+        pol = HealthPolicy(deadline_s=0.05)
+        assert pol.first_deadline_s == pytest.approx(0.5)
+        g = pol.make_guard("t")
+        assert g.check(Slow()).ok            # first: grace covers 0.2s
+        with pytest.raises(WatchdogTimeout):
+            g.check(Slow())                  # second: tight deadline
+
+    def test_watchdog_timeout_is_retry_transient(self):
+        from dislib_tpu.runtime import is_transient_error
+        assert is_transient_error(WatchdogTimeout("hung"))
+
+    def test_hang_escalates_through_retry_then_heals(self, rng, tmp_path,
+                                                     fast_retry):
+        x = ds.array(_blobs(rng))
+        init = np.ascontiguousarray(_blobs(rng)[[0, 70, 140]])
+        # max_iter matches the rollback tests so the jitted fit kernels
+        # (static max_iter/chunk) are cache hits, not fresh compiles
+        kw = dict(n_clusters=3, init=init, max_iter=12, tol=0.0)
+        full = KMeans(**kw).fit(x)
+        pol = faults.HangAtChunk(at_chunk=2, hang_s=0.4, deadline_s=0.05,
+                                 times=1)
+        res = KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+            health=pol)
+        assert pol.stalls == 1, "hang was never injected"
+        np.testing.assert_allclose(res.centers_, full.centers_, rtol=1e-5)
+
+    def test_hang_exhaustion_aborts_cleanly(self, rng, tmp_path, fast_retry,
+                                            monkeypatch):
+        monkeypatch.setenv("DSLIB_RETRY_ATTEMPTS", "2")
+        x = ds.array(_blobs(rng))
+        init = np.ascontiguousarray(_blobs(rng)[[0, 70, 140]])
+        with pytest.raises(WatchdogTimeout):
+            KMeans(n_clusters=3, init=init, max_iter=12, tol=0.0).fit(
+                x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+                health=faults.HangAtChunk(at_chunk=1, hang_s=0.4,
+                                          deadline_s=0.05, times=10))
+
+
+# ---------------------------------------------------------------------------
+# rollback-under-fault: NaN at chunk k → heal == unfaulted (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestRollbackUnderFault:
+    def test_kmeans_nan_at_chunk_heals_to_unfaulted_model(self, rng,
+                                                          tmp_path):
+        x_np = _blobs(rng)
+        x = ds.array(x_np)
+        init = np.ascontiguousarray(x_np[[0, 70, 140]])
+        kw = dict(n_clusters=3, init=init, max_iter=12, tol=0.0)
+        full = KMeans(**kw).fit(x)
+        pol = faults.NaNAtChunk(at_chunk=3)
+        res = KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+            health=pol)
+        assert pol.fired == 1, "fault was never injected"
+        assert res.n_iter_ == full.n_iter_
+        np.testing.assert_allclose(res.centers_, full.centers_, rtol=1e-5)
+        assert len(res.history_) == full.n_iter_, \
+            "rollback left stale history entries"
+        assert np.isfinite(res.history_).all()
+
+    def test_gmm_nan_in_means_heals_to_unfaulted_model(self, rng, tmp_path):
+        # shapes and static args mirror test_resilience's GMM drill so the
+        # _gm_fit compiles (keyed on shape/cov_type/max_iter) are shared
+        # across the two files instead of paid twice
+        x = ds.array(_blobs(rng, n=150, d=3, k=2))
+        kw = dict(n_components=2, max_iter=12, tol=0.0, random_state=0)
+        full = GaussianMixture(**kw).fit(x)
+        pol = faults.NaNAtChunk(at_chunk=2, where=1)     # poison means
+        res = GaussianMixture(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "g.npz"), every=4),
+            health=pol)
+        assert pol.fired == 1
+        assert res.n_iter_ == full.n_iter_
+        np.testing.assert_allclose(res.means_, full.means_, rtol=1e-5)
+        assert res.lower_bound_ == pytest.approx(full.lower_bound_, rel=1e-6)
+
+    def test_als_nan_in_factors_heals_to_unfaulted_model(self, rng,
+                                                         tmp_path):
+        u = rng.rand(30, 4).astype(np.float32)
+        v = rng.rand(20, 4).astype(np.float32)
+        r = ((u @ v.T) * (rng.rand(30, 20) < 0.6)).astype(np.float32)
+        x = ds.array(r)
+        kw = dict(n_f=4, max_iter=8, tol=1e-9, random_state=0)
+        # checkpointed reference: both fits then use ONLY the every=2
+        # chunk compile of _als_fit (shared with test_resilience's ALS
+        # drills) — an unfaulted checkpointed run is the same model
+        full = ALS(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "ref.npz"), every=2))
+        pol = faults.NaNAtChunk(at_chunk=2)
+        res = ALS(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "a.npz"), every=2),
+            health=pol)
+        assert pol.fired == 1
+        assert res.n_iter_ == full.n_iter_
+        np.testing.assert_allclose(res.users_, full.users_,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(res.items_, full.items_,
+                                   rtol=1e-4, atol=1e-5)
+
+    def _forest_data(self, rng):
+        # one shared shape across BOTH forest tests AND test_resilience's
+        # forest drills: the level kernels compile per (n_trees,
+        # depth-level, padded-m) static config, so shape alignment means
+        # the whole suite pays each compile once
+        n, k = 240, 3
+        centers = rng.rand(k, 6) * 8
+        xh = np.vstack([centers[i] + 0.4 * rng.randn(n // k, 6)
+                        for i in range(k)]).astype(np.float32)
+        yh = np.repeat(np.arange(k), n // k).astype(np.float32)
+        p = rng.permutation(n)
+        return ds.array(xh[p]), ds.array(yh[p].reshape(-1, 1))
+
+    _forest_kw = dict(n_estimators=4, max_depth=6, random_state=7)
+
+    def test_forest_nan_in_weights_heals_to_unfaulted_model(self, rng,
+                                                            tmp_path):
+        from dislib_tpu.trees import RandomForestClassifier
+        x, y = self._forest_data(rng)
+        full = RandomForestClassifier(**self._forest_kw).fit(x, y)
+        pol = faults.NaNAtChunk(at_chunk=3)              # poison w at level 3
+        res = RandomForestClassifier(**self._forest_kw).fit(
+            x, y, checkpoint=FitCheckpoint(str(tmp_path / "f.npz"), every=2),
+            health=pol)
+        assert pol.fired == 1
+        np.testing.assert_array_equal(res.predict(x).collect(),
+                                      full.predict(x).collect())
+
+    def test_csvm_forced_trip_rolls_back_to_unfaulted_model(self, rng,
+                                                            tmp_path):
+        from dislib_tpu.classification import CascadeSVM
+        n = 120
+        xh = np.vstack([rng.randn(n // 2, 4) - 2,
+                        rng.randn(n // 2, 4) + 2]).astype(np.float32)
+        yh = np.r_[np.zeros(n // 2), np.ones(n // 2)].astype(np.float32)
+        sh = rng.permutation(n)
+        x, y = ds.array(xh[sh]), ds.array(yh[sh].reshape(-1, 1))
+        # config mirrors test_resilience's CSVM drill (same rng fixture →
+        # same data → same cascade node shapes → shared solve compiles)
+        kw = dict(cascade_arity=2, c=1.0, kernel="rbf", gamma=0.3,
+                  check_convergence=False)
+        full = CascadeSVM(max_iter=4, **kw).fit(x, y)
+        pol = faults.TripAtChunk(at_chunk=2)
+        res = CascadeSVM(max_iter=4, **kw).fit(
+            x, y, checkpoint=FitCheckpoint(str(tmp_path / "c.npz"), every=1),
+            health=pol)
+        assert pol.fired == 1
+        assert res.n_iter_ == full.n_iter_
+        np.testing.assert_array_equal(res._sv_idx, full._sv_idx)
+        np.testing.assert_allclose(res._sv_alpha, full._sv_alpha, rtol=1e-5)
+
+    def test_no_checkpoint_raises_typed_diagnostic(self, rng):
+        x_np = _blobs(rng)
+        x = ds.array(x_np)
+        init = np.ascontiguousarray(x_np[[0, 70, 140]])
+        with pytest.raises(NumericalDivergence) as exc:
+            KMeans(n_clusters=3, init=init, max_iter=12, tol=0.0).fit(
+                x, health=faults.NaNAtChunk(at_chunk=1))
+        e = exc.value
+        assert e.estimator == "kmeans" and e.guard == "nonfinite"
+        assert e.iteration is not None and "hvec" in e.detail
+
+    def test_restart_budget_exhaustion_raises(self, rng, tmp_path):
+        x_np = _blobs(rng)
+        x = ds.array(x_np)
+        init = np.ascontiguousarray(x_np[[0, 70, 140]])
+        pol = faults.TripAtChunk(at_chunk=2, times=10, max_restarts=2)
+        with pytest.raises(NumericalDivergence, match="max_restarts"):
+            KMeans(n_clusters=3, init=init, max_iter=12, tol=0.0).fit(
+                x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+                health=pol)
+        assert pol.fired == 3, "2 restarts + the final raise = 3 trips"
+
+    def test_raise_action_skips_remediation(self, rng, tmp_path):
+        x_np = _blobs(rng)
+        x = ds.array(x_np)
+        init = np.ascontiguousarray(x_np[[0, 70, 140]])
+        pol = faults.NaNAtChunk(at_chunk=2, action="raise")
+        with pytest.raises(NumericalDivergence, match="'raise'"):
+            KMeans(n_clusters=3, init=init, max_iter=12, tol=0.0).fit(
+                x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+                health=pol)
+
+    def test_forest_unchecked_nan_raises_at_adoption(self, rng):
+        from dislib_tpu.trees import RandomForestClassifier
+        x, y = self._forest_data(rng)    # same shapes: kernels cache-hit
+        with pytest.raises(NumericalDivergence, match="adoption"):
+            RandomForestClassifier(**self._forest_kw).fit(
+                x, y, health=faults.NaNAtChunk(at_chunk=1))
+
+    def test_dbscan_nonfinite_input_raises_not_all_noise(self, rng,
+                                                         tmp_path):
+        xb = rng.rand(60, 3).astype(np.float32)
+        xb[7, 1] = np.nan
+        with pytest.raises(NumericalDivergence) as exc:
+            DBSCAN(eps=0.5, min_samples=3).fit(ds.array(xb))
+        assert exc.value.guard == "input-nonfinite"
+        with pytest.raises(NumericalDivergence):
+            DBSCAN(eps=0.5, min_samples=3).fit(
+                ds.array(xb),
+                checkpoint=FitCheckpoint(str(tmp_path / "d.npz"), every=2))
+
+    def test_daura_nonfinite_input_raises(self, rng, tmp_path):
+        xt = rng.rand(40, 6).astype(np.float32)
+        xt[5, 2] = np.inf
+        with pytest.raises(NumericalDivergence) as exc:
+            Daura(cutoff=0.8).fit(ds.array(xt))
+        assert exc.value.guard == "input-nonfinite"
+        with pytest.raises(NumericalDivergence):
+            Daura(cutoff=0.8).fit(
+                ds.array(xt),
+                checkpoint=FitCheckpoint(str(tmp_path / "d.npz"), every=2))
+
+    def test_gated_writes_never_rotate_out_the_good_generation(self, rng,
+                                                               tmp_path):
+        """With keep=1 a single bad write would DESTROY the only good
+        generation — the gate must make the faulted fit still heal."""
+        x_np = _blobs(rng)
+        x = ds.array(x_np)
+        init = np.ascontiguousarray(x_np[[0, 70, 140]])
+        kw = dict(n_clusters=3, init=init, max_iter=12, tol=0.0)
+        full = KMeans(**kw).fit(x)
+        res = KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2,
+                                        keep=1),
+            health=faults.NaNAtChunk(at_chunk=3))
+        np.testing.assert_allclose(res.centers_, full.centers_, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# zero extra dispatches (acceptance: fused guards are free)
+# ---------------------------------------------------------------------------
+
+class TestZeroDispatchGuard:
+    def test_kmeans_chunked_fit_dispatch_count_is_chunks_only(self, rng,
+                                                              tmp_path,
+                                                              monkeypatch):
+        from dislib_tpu.utils import profiling as prof
+        x_np = _blobs(rng)
+        x = ds.array(x_np)
+        init = np.ascontiguousarray(x_np[[0, 70, 140]])
+        kw = dict(n_clusters=3, init=init, max_iter=6, tol=0.0)
+
+        def run(tag):
+            ck = FitCheckpoint(str(tmp_path / f"{tag}.npz"), every=2)
+            KMeans(**kw).fit(x, checkpoint=ck)          # warm the caches
+            ck.delete()
+            prof.reset_counters()
+            ck = FitCheckpoint(str(tmp_path / f"{tag}2.npz"), every=2)
+            KMeans(**kw).fit(x, checkpoint=ck)
+            return prof.counters()
+
+        with_guard = run("on")
+        # 6 iters / every=2 → 3 chunks → exactly 3 kmeans_fit dispatches,
+        # health vector included in each
+        assert with_guard["dispatch_by"].get("kmeans_fit") == 3
+        monkeypatch.setenv("DSLIB_HEALTH", "0")
+        without = run("off")
+        assert with_guard["dispatches"] == without["dispatches"], (
+            "the health guard added device dispatches: "
+            f"{with_guard['dispatch_by']} vs {without['dispatch_by']}")
+
+
+# ---------------------------------------------------------------------------
+# ingest quarantine
+# ---------------------------------------------------------------------------
+
+class TestIngestQuarantine:
+    def _csv(self, tmp_path, x):
+        p = str(tmp_path / "q.csv")
+        np.savetxt(p, x, delimiter=",")
+        return p
+
+    def test_txt_loader_isolates_nonfinite_rows(self, rng, tmp_path):
+        x = rng.rand(12, 3).astype(np.float32)
+        x[3, 1], x[9, 0] = np.nan, np.inf
+        p = self._csv(tmp_path, x)
+        with pytest.warns(RuntimeWarning, match="quarantined 2"):
+            got = ds.load_txt_file(p)
+        assert got.shape == (10, 3)
+        rep = got.quarantine_
+        assert rep is not None and rep.n_quarantined == 2
+        assert rep.rows.tolist() == [3, 9] and rep.n_loaded == 10
+        assert not np.isfinite(rep.values).all()
+        assert ds.last_quarantine_report() is rep
+        np.testing.assert_allclose(np.asarray(got.collect()),
+                                   x[np.isfinite(x).all(axis=1)], rtol=1e-5)
+
+    def test_keep_mask_realigns_a_row_paired_file(self, rng, tmp_path):
+        x = rng.rand(10, 3).astype(np.float32)
+        x[4, 0] = np.nan
+        y = np.arange(10, dtype=np.float32).reshape(-1, 1)
+        px, py = str(tmp_path / "x.csv"), str(tmp_path / "y.csv")
+        np.savetxt(px, x, delimiter=",")
+        np.savetxt(py, y, delimiter=",")
+        with pytest.warns(RuntimeWarning, match="keep_mask"):
+            gx = ds.load_txt_file(px)
+        gy = ds.load_txt_file(py)          # clean file: nothing dropped
+        mask = gx.quarantine_.keep_mask
+        assert mask.shape == (10,) and not mask[4]
+        aligned = np.asarray(gy.collect()).ravel()[mask]
+        np.testing.assert_array_equal(aligned,
+                                      y.ravel()[np.isfinite(x).all(axis=1)])
+        assert gx.shape[0] == aligned.shape[0]
+
+    def test_opt_out_loads_raw(self, rng, tmp_path, monkeypatch):
+        x = rng.rand(6, 2).astype(np.float32)
+        x[1, 0] = np.nan
+        p = self._csv(tmp_path, x)
+        got = ds.load_txt_file(p, quarantine=False)
+        assert got.shape == (6, 2) and got.quarantine_ is None
+        monkeypatch.setenv("DSLIB_QUARANTINE", "0")
+        got = ds.load_txt_file(p)
+        assert got.shape == (6, 2) and got.quarantine_ is None
+
+    def test_npy_loader_quarantines(self, rng, tmp_path):
+        x = rng.rand(8, 3).astype(np.float32)
+        x[2, 2] = np.nan
+        p = str(tmp_path / "q.npy")
+        np.save(p, x)
+        with pytest.warns(RuntimeWarning, match="quarantined 1"):
+            got = ds.load_npy_file(p)
+        assert got.shape == (7, 3) and got.quarantine_.rows.tolist() == [2]
+
+    def test_svmlight_quarantine_keeps_labels_aligned(self, tmp_path):
+        p = str(tmp_path / "q.svm")
+        with open(p, "w") as f:
+            f.write("1 1:0.5 3:0.25\n-1 2:nan\n1 1:2.0\n-1 2:1.0\n")
+        with pytest.warns(RuntimeWarning, match="quarantined 1"):
+            x, y = ds.load_svmlight_file(p)
+        assert x.shape[0] == 3
+        np.testing.assert_array_equal(
+            np.asarray(y.collect()).ravel(), [1, 1, -1])
+        assert x.quarantine_.rows.tolist() == [1]
+
+    def test_mdcrd_quarantines_frames_before_copy_first(self, rng,
+                                                        tmp_path):
+        fr = rng.rand(4, 6).astype(np.float32)
+        fr[1, 2] = np.nan
+        p = str(tmp_path / "t.mdcrd")
+        with open(p, "w") as f:
+            f.write("title\n")
+            for v in fr.ravel():
+                f.write(f"{v:8.3f}")
+            f.write("\n")
+        with pytest.warns(RuntimeWarning, match="quarantined 1"):
+            got = ds.load_mdcrd_file(p, n_atoms=2, copy_first=True)
+        # 3 clean frames + the duplicated (clean) first frame
+        assert got.shape == (4, 6)
+        assert np.isfinite(np.asarray(got.collect())).all()
+
+    def test_all_rows_bad_is_a_clear_error(self, tmp_path):
+        x = np.full((3, 2), np.nan, np.float32)
+        p = self._csv(tmp_path, x)
+        with pytest.warns(RuntimeWarning), \
+                pytest.raises(ValueError, match="nothing left to load"):
+            ds.load_txt_file(p)
+
+    def test_quarantined_load_fits_clean(self, rng, tmp_path):
+        """End to end: a poisoned file, quarantined at ingest, fits to a
+        finite model — the failure mode the guards would otherwise catch
+        mid-fit never materialises."""
+        x = _blobs(rng, n=90, d=3)
+        x[11] = np.nan
+        p = self._csv(tmp_path, x)
+        with pytest.warns(RuntimeWarning):
+            got = ds.load_txt_file(p)
+        km = KMeans(n_clusters=3, random_state=0, max_iter=5).fit(got)
+        assert np.isfinite(km.centers_).all()
+        assert np.isfinite(km.inertia_)

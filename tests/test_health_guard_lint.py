@@ -1,0 +1,146 @@
+"""Health-guard lint (round-8 robustness PR, the `test_host_sync_lint`
+pattern): every chunked fit loop must (1) register a runtime health guard,
+(2) actually judge each chunk with it, and (3) route every snapshot write
+through the guard's gate — a direct ``checkpoint.save_async`` would let an
+unhealthy chunk rotate the last GOOD generation out of the checkpoint,
+which is exactly the corruption mode the health layer exists to prevent.
+
+Enforced by AST scan so a new estimator (or a refactor of an existing
+one) cannot silently ship an unguarded loop: add the loop to the registry
+and wire the guard, or consciously change this lint with a reason.
+"""
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# every chunked fit loop in the library: (file, function) — the function
+# must build a guard (`_health.guard(...)`), judge chunks
+# (`guard.check(...)` / `guard.check_host(...)`), and gate writes
+# (`guard.save_async(...)`)
+CHUNKED_FIT_LOOPS = {
+    ("dislib_tpu/cluster/kmeans.py", "fit"),
+    ("dislib_tpu/cluster/gm.py", "fit"),
+    ("dislib_tpu/recommendation/als.py", "fit"),
+    ("dislib_tpu/classification/csvm.py", "fit"),
+    ("dislib_tpu/trees/decision_tree.py", "_grow_forest"),
+    ("dislib_tpu/cluster/dbscan.py", "_fit_checkpointed"),
+    ("dislib_tpu/cluster/daura.py", "_fit_checkpointed"),
+}
+
+ESTIMATOR_DIRS = (
+    "dislib_tpu/cluster",
+    "dislib_tpu/classification",
+    "dislib_tpu/recommendation",
+    "dislib_tpu/trees",
+    "dislib_tpu/regression",
+    "dislib_tpu/decomposition",
+    "dislib_tpu/neighbors",
+    "dislib_tpu/optimization",
+    "dislib_tpu/model_selection",
+)
+
+
+def _functions(path):
+    tree = ast.parse(open(path, encoding="utf-8").read())
+    out = {}
+
+    def walk(node, prefix=""):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.setdefault(child.name, child)
+                walk(child, child.name)
+            else:
+                walk(child, prefix)
+
+    walk(tree)
+    return out
+
+
+def _calls(node):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _attr_call(call, attr):
+    f = call.func
+    return isinstance(f, ast.Attribute) and f.attr == attr
+
+
+def _receiver_name(call):
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id
+    return None
+
+
+def test_every_chunked_fit_loop_registers_a_guard_and_checks_chunks():
+    missing = []
+    for rel, fname in sorted(CHUNKED_FIT_LOOPS):
+        fns = _functions(os.path.join(REPO, rel))
+        fn = fns.get(fname)
+        if fn is None:
+            missing.append(f"{rel}: function {fname}() no longer exists — "
+                           "update the lint registry")
+            continue
+        calls = list(_calls(fn))
+        registers = any(
+            (_attr_call(c, "guard") and _receiver_name(c) == "_health")
+            or _attr_call(c, "make_guard")
+            for c in calls)
+        # dbscan/daura build the guard in fit() and pass it down — accept
+        # a `guard` parameter as registration for those
+        takes_param = any(a.arg == "guard" for a in fn.args.args)
+        if not (registers or takes_param):
+            missing.append(f"{rel}:{fname}() never registers a health "
+                           "guard (_health.guard(...))")
+        checks = any(_attr_call(c, "check") or _attr_call(c, "check_host")
+                     for c in calls
+                     if _receiver_name(c) in ("guard", "self"))
+        if not checks:
+            missing.append(f"{rel}:{fname}() never judges a chunk "
+                           "(guard.check / guard.check_host)")
+    assert not missing, (
+        "chunked fit loops without a wired health guard:\n  "
+        + "\n  ".join(missing))
+
+
+def test_snapshot_writes_are_gated_on_the_guard():
+    """No estimator file may write a snapshot around the guard: every
+    ``save_async`` call must be the guard's own gate, and blocking
+    ``checkpoint.save`` must not appear at all."""
+    offenders = []
+    for d in ESTIMATOR_DIRS:
+        full_dir = os.path.join(REPO, d)
+        for fn in sorted(os.listdir(full_dir)):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(full_dir, fn)
+            tree = ast.parse(open(path, encoding="utf-8").read())
+            for call in _calls(tree):
+                if _attr_call(call, "save_async") and \
+                        _receiver_name(call) != "guard":
+                    offenders.append(
+                        f"{d}/{fn}:{call.lineno}: ungated "
+                        f"{_receiver_name(call)}.save_async(...)")
+                if _attr_call(call, "save") and \
+                        _receiver_name(call) in ("checkpoint", "ck"):
+                    offenders.append(
+                        f"{d}/{fn}:{call.lineno}: ungated checkpoint.save")
+    assert not offenders, (
+        "snapshot writes that bypass the health gate (route them through "
+        "guard.save_async so a bad chunk can never rotate out the last "
+        "good generation):\n  " + "\n  ".join(offenders))
+
+
+def test_registry_entries_still_exist():
+    """A refactor that renames a registered loop must update the registry
+    — dead entries would quietly bless future unguarded loops."""
+    dead = []
+    for rel, fname in sorted(CHUNKED_FIT_LOOPS):
+        path = os.path.join(REPO, rel)
+        if not os.path.exists(path) or fname not in _functions(path):
+            dead.append(f"{rel}:{fname}")
+    assert not dead, f"lint registry entries no longer match code: {dead}"

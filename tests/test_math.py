@@ -441,3 +441,34 @@ class TestCholQR2:
         np.testing.assert_allclose(
             np.asarray(qf.collect()) @ np.asarray(rf.collect()), x,
             rtol=1e-3, atol=1e-3)
+
+
+def test_randomsvd_smoke_gate_margin(rng):
+    """Regression pin for the bench_randomsvd smoke gate (round-8 satellite).
+
+    The pre-round-8 gate drew a FLAT Gaussian spectrum: with oversample=10
+    the device path and the numpy proxy each carry ~6% subspace error and —
+    because they draw different test matrices Ω (jax vs numpy RNG) — differ
+    from EACH OTHER by up to ~1.5%, flaking a 1% gate (reproduced back to
+    PR 1 on this rig).  bench.py now scales columns by 0.95^j, the decaying
+    spectrum truncated SVD is actually for; this test replays the exact
+    smoke-config comparison and demands ≥2x margin under the 1% gate so a
+    regression (in the data recipe OR the sketching path) fails here first."""
+    import bench
+    from dislib_tpu.decomposition import random_svd
+    m, n, nsv, iters = 1024, 128, 16, 2
+    r0 = np.random.RandomState(0)
+    x = (r0.standard_normal((m, n)) * 0.95 ** np.arange(n)).astype(np.float32)
+    _, s_proxy, _ = bench._numpy_random_svd(x, nsv + 10, iters)
+    a = ds.array(x, block_size=(m // 8, n))
+    _, s, _ = random_svd(a, iters=iters, nsv=nsv, oversample=10,
+                         random_state=0)
+    s_dev = np.asarray(s.collect()).ravel()[:16]
+    rel = np.max(np.abs(s_dev - s_proxy[:16]) / s_proxy[:16])
+    assert rel < 5e-3, (
+        f"smoke-gate margin regressed: dev-vs-proxy rel err {rel:.4f} "
+        "(gate is 1e-2; this pin demands >=2x headroom)")
+    # and the gate itself must hold against the EXACT spectrum too — the
+    # proxy agreeing with the device path is necessary but not sufficient
+    s_ref = np.linalg.svd(x, compute_uv=False)[:16]
+    np.testing.assert_allclose(s_dev, s_ref, rtol=1e-2)

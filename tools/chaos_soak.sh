@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Chaos soak (round-8 satellite): N randomized-schedule fault-injection
+# fit runs — preemption + NaN-in-carry + hung chunk + snapshot corruption
+# combined — asserting the resilience+health invariant (self-heal or a
+# typed diagnostic, then a clean resume equals the unfaulted model).
+#
+# Usage:  tools/chaos_soak.sh [RUNS] [SEED]
+#
+# Runs the `slow`-marked tests/test_chaos_soak.py (excluded from tier-1)
+# and echoes the machine-readable summary line; append it to the current
+# BENCH_local_*.jsonl when recording a capture.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+RUNS="${1:-10}"
+SEED="${2:-0}"
+LOG="$(mktemp)"
+env JAX_PLATFORMS=cpu DSLIB_SOAK_RUNS="$RUNS" DSLIB_SOAK_SEED="$SEED" \
+    python -m pytest tests/test_chaos_soak.py -q -m slow -s \
+    -p no:cacheprovider 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+echo "-- soak summary --"
+grep -a "^CHAOS_SOAK_SUMMARY" "$LOG" | sed 's/^CHAOS_SOAK_SUMMARY //'
+rm -f "$LOG"
+exit $rc
