@@ -65,10 +65,11 @@ from dislib_tpu.utils.base import shuffle, train_test_split
 from dislib_tpu.utils.saving import save_model, load_model
 
 # subpackages (sklearn-style namespaces, reference parity; `runtime` is
-# the preemption/retry/elastic resilience layer)
+# the preemption/retry/elastic resilience layer, `serving` the
+# low-latency predict path with micro-batching and model hot-swap)
 from dislib_tpu import cluster, classification, regression, neighbors, \
     preprocessing, optimization, model_selection, recommendation, \
-    trees, runtime  # noqa: E402,F401
+    trees, runtime, serving  # noqa: E402,F401
 
 # estimator classes re-exported at top level so every name in the SURVEY §8
 # parity contract is importable from `dislib_tpu` directly (their canonical
@@ -106,5 +107,5 @@ __all__ = [
     "NearestNeighbors", "LinearRegression", "Lasso", "ADMM", "ALS",
     "StandardScaler", "MinMaxScaler",
     "KFold", "GridSearchCV", "RandomizedSearchCV",
-    "runtime",
+    "runtime", "serving",
 ]

@@ -89,6 +89,50 @@ class BaseEstimator:
                             "pass scoring=")
         return self.score(x, y) if y is not None else self.score(x)
 
+    # -- device-resident predict parameters (round-9 serving PR) ----------
+
+    def _predict_leaves(self, *host_arrays):
+        """Device copies of this model's predict-time parameters, cached by
+        the identity of the host attribute objects.  A warm serving path
+        calls predict once per batch; re-running ``jnp.asarray`` on every
+        call would pay a host→device transfer of the whole model per
+        request batch.  One cache entry PER LEAF TUPLE (predict and
+        predict_proba pass different tuples — a single slot would thrash
+        and re-upload the model on every alternation).  Each entry PINS
+        its host arrays, which is what makes the id-tuple key sound: a
+        cached id cannot be reused while its entry exists, and clearing
+        drops the whole cache.  The cache invalidates when an attribute
+        is REASSIGNED (a new fit, a hot-swap adoption) — in-place
+        mutation of a fitted ndarray is not supported, as everywhere in
+        the library."""
+        import jax.numpy as jnp
+        cache = getattr(self, "_predict_leaf_cache", None)
+        if cache is None:
+            cache = self._predict_leaf_cache = {}
+        key = tuple(id(h) for h in host_arrays)
+        hit = cache.get(key)
+        if hit is not None:
+            return hit[1]
+        dev = tuple(jnp.asarray(h) for h in host_arrays)
+        if len(cache) >= 16:                # refit churn bound — a model
+            cache.clear()                   # has a handful of live tuples
+        cache[key] = (tuple(host_arrays), dev)  # [0] is the id pin
+        return dev
+
+    def _classes_leaf(self):
+        """``classes_`` cast to the serving label dtype (int32 for integer
+        classes — exact to 2^31 where float32 corrupts past 2^24 — else
+        float32), cached by the identity of ``classes_`` so repeat predict
+        calls reuse one host object and therefore one device transfer."""
+        import numpy as np
+        cached = getattr(self, "_classes_cast_cache", None)
+        if cached is None or cached[0] is not self.classes_:
+            dt = np.int32 if np.issubdtype(self.classes_.dtype, np.integer) \
+                else np.float32
+            self._classes_cast_cache = (self.classes_,
+                                        self.classes_.astype(dt))
+        return self._classes_cast_cache[1]
+
     def __repr__(self):
         params = ", ".join(f"{k}={v!r}" for k, v in self.get_params().items())
         return f"{type(self).__name__}({params})"

@@ -25,7 +25,7 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array
+from dislib_tpu.data.array import Array, fused_kernel
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
@@ -282,11 +282,15 @@ class GaussianMixture(BaseEstimator):
         return self.fit(x).predict(x)
 
     def predict(self, x: Array) -> Array:
+        """Component index per row — a fusion-graph node, so a scaler →
+        predict pipeline is ONE cached dispatch (the serving hot path)."""
         self._check_fitted()
-        labels = _gm_predict(x._data, x.shape, jnp.asarray(self.weights_),
-                             jnp.asarray(self.means_), jnp.asarray(self.covariances_),
-                             self.covariance_type)
-        return Array._from_logical_padded(labels, (x.shape[0], 1))
+        weights, means, covs = self._predict_leaves(
+            self.weights_, self.means_, self.covariances_)
+        return fused_kernel(
+            _gm_predict_kernel, (x.shape, self.covariance_type),
+            (x, weights, means, covs), (x.shape[0], 1), jnp.int32,
+            out_pshape=(x._pshape[0], 1))
 
     def _check_fitted(self):
         if not hasattr(self, "means_"):
@@ -453,9 +457,9 @@ def _gm_loglik(xp, shape, weights, means, covs, cov_type):
     return jnp.sum(lse * w) / m
 
 
-@partial(jax.jit, static_argnames=("shape", "cov_type"))
-@precise
-def _gm_predict(xp, shape, weights, means, covs, cov_type):
+def _gm_predict_kernel(cfg, xp, weights, means, covs):
+    """`predict` as a fusion-node body (cfg = (shape, cov_type))."""
+    shape, cov_type = cfg
     m, n = shape
     xv = xp[:, :n]
     prec = _chol_precisions(covs, cov_type, n)

@@ -36,7 +36,7 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.data.array import Array, _repad, fused_kernel
 from dislib_tpu.data.sparse import SparseArray, _spmm
 from dislib_tpu.ops import distances_sq as _distances_sq
 from dislib_tpu.parallel import mesh as _mesh
@@ -258,6 +258,9 @@ class KMeans(BaseEstimator):
         return self.fit(x).predict(x)
 
     def predict(self, x) -> Array:
+        """Cluster index per row.  Dense inputs build a fusion-graph node
+        (`data.array.fused_kernel`): a scaler → predict pipeline runs as
+        ONE cached XLA dispatch end-to-end — the serving-layer hot path."""
         self._check_fitted()
         if isinstance(x, SparseArray):
             d = _sparse_distances(x._bcoo, x.row_norms_sq(),
@@ -265,8 +268,10 @@ class KMeans(BaseEstimator):
             labels = jnp.argmin(d, axis=1).astype(jnp.int32)[:, None]
             return Array._from_logical_padded(_repad(labels, (x.shape[0], 1)),
                                               (x.shape[0], 1))
-        labels = _kmeans_predict(x._data, x.shape, jnp.asarray(self.centers_))
-        return Array._from_logical_padded(labels, (x.shape[0], 1))
+        (centers,) = self._predict_leaves(self.centers_)
+        return fused_kernel(
+            _kmeans_predict_kernel, (x.shape,), (x, centers),
+            (x.shape[0], 1), jnp.int32, out_pshape=(x._pshape[0], 1))
 
     def score(self, x, y=None) -> float:
         """Negative inertia on x (sklearn convention)."""
@@ -335,9 +340,7 @@ def _kmeans_fit(xp, shape, centers0, max_iter, tol, fast=False):
     return centers, n_iter, inertia, shift, hist, hvec
 
 
-@partial(_pjit, static_argnames=("shape",), name="kmeans_predict")
-@precise
-def _kmeans_predict(xp, shape, centers):
+def _kmeans_predict_core(xp, shape, centers):
     m, n = shape
     xv = xp[:, :n]
     d = _distances_sq(xv, centers)
@@ -348,6 +351,18 @@ def _kmeans_predict(xp, shape, centers):
     valid = lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m
     labels = jnp.where(valid, labels, 0)
     return labels[:, None]
+
+
+def _kmeans_predict_kernel(cfg, xp, centers):
+    """`predict` as a fusion-node body (cfg = (logical shape,)) — the ONE
+    E-step distance + argmin, riding whatever op chain feeds it."""
+    return _kmeans_predict_core(xp, cfg[0], centers)
+
+
+@partial(_pjit, static_argnames=("shape",), name="kmeans_predict")
+@precise
+def _kmeans_predict(xp, shape, centers):
+    return _kmeans_predict_core(xp, shape, centers)
 
 
 def _sparse_distances(bcoo, rowsq, centers):

@@ -270,6 +270,22 @@ def _instr_dist(static, a, b):
     return _place_region(d, out_pshape)
 
 
+def _instr_kernel(static, *args):
+    """Round-9 serving PR: an arbitrary traced kernel body as ONE fusion
+    node.  ``static`` is ``(body, cfg)``: ``body`` a module-level pure
+    function (hashable by identity, stable across calls — a lambda or
+    closure would defeat both the jit cache and the fusion-program
+    dedup), ``cfg`` its hashable config tuple.  The body receives the
+    PADDED operand arrays exactly as the graph stores them and must
+    return an array of the declared padded output shape with the
+    region outside the logical shape zeroed (the Array invariant) —
+    estimator predict kernels already satisfy this, which is what lets
+    a whole scaler → estimator → argmax pipeline linearize into one
+    cached XLA program."""
+    body, cfg = static
+    return body(cfg, *args)
+
+
 _INSTRS = {
     "ew2": _instr_ew2,
     "ew1": _instr_ew1,
@@ -278,7 +294,33 @@ _INSTRS = {
     "reduce": _instr_reduce,
     "matmul": _instr_matmul,
     "dist": _instr_dist,
+    "kernel": _instr_kernel,
 }
+
+
+def fused_kernel(body, cfg, args, out_shape, dtype, out_pshape=None,
+                 reg_shape=None, sparse=False):
+    """Defer ``body(cfg, *operands)`` as a fusion-graph node and wrap it
+    as an :class:`Array` — the estimator-predict entry into the dispatch
+    fusion layer (round-9 serving PR).
+
+    ``body`` must be a module-level traced function taking its hashable
+    ``cfg`` tuple first, then one padded device array per entry of
+    ``args`` (each an :class:`Array`, a deferred node via
+    ``arr._node()``, or a concrete ``jax.Array``/ndarray leaf such as
+    model parameters).  It must return the padded ``out_pshape`` result,
+    zero outside ``out_shape``.  Under ``DSLIB_EAGER=1`` the node is
+    forced immediately — the same single-instruction program runs as its
+    own dispatch, preserving per-op debugging semantics."""
+    if out_pshape is None:
+        out_pshape = _padded_shape(out_shape, _mesh.pad_quantum())
+    ops = tuple(a._node() if isinstance(a, Array) else a for a in args)
+    expr = _LazyExpr("kernel", (body, tuple(cfg)), ops,
+                     tuple(out_pshape), dtype)
+    arr = _lazy_array(expr, out_shape, reg_shape, sparse)
+    if _eager_mode():
+        arr.force()
+    return arr
 
 
 @partial(_pjit, static_argnames=("program",), name="fused_chain")

@@ -63,6 +63,16 @@ class StandardScaler(BaseEstimator):
     def fit_transform(self, x: Array, y=None) -> Array:
         return self.fit(x).transform(x)
 
+    def _scale_array(self) -> Array:
+        """`_safe_sqrt(var_)` cached by var_ identity: the derived array
+        costs a pad kernel + eager sqrt program to build — once per fit,
+        not once per transform (the serving hot path calls transform per
+        request batch, where the rebuild was a hidden per-call dispatch)."""
+        cached = getattr(self, "_scale_cache", None)
+        if cached is None or cached[0] is not self.var_:
+            self._scale_cache = (self.var_, _safe_sqrt(self.var_))
+        return self._scale_cache[1]
+
     def transform(self, x: Array) -> Array:
         self._check_fitted()
         if _is_sparse(x):
@@ -75,7 +85,7 @@ class StandardScaler(BaseEstimator):
         if self.with_mean:
             out = out - self.mean_
         if self.with_std:
-            out = out / _safe_sqrt(self.var_)
+            out = out / self._scale_array()
         return out
 
     def inverse_transform(self, x: Array) -> Array:
@@ -88,7 +98,7 @@ class StandardScaler(BaseEstimator):
             return x.scale_cols(_sqrt_vec(self.var_))
         out = x
         if self.with_std:
-            out = out * _safe_sqrt(self.var_)
+            out = out * self._scale_array()
         if self.with_mean:
             out = out + self.mean_
         return out
@@ -115,18 +125,27 @@ class MinMaxScaler(BaseEstimator):
     def fit_transform(self, x: Array, y=None) -> Array:
         return self.fit(x).transform(x)
 
+    def _range_array(self) -> Array:
+        """`_nonzero(max - min)` cached by the (min_, max_) identities —
+        same per-transform rebuild cost story as StandardScaler's scale."""
+        cached = getattr(self, "_range_cache", None)
+        key = (self.data_min_, self.data_max_)
+        if cached is None or cached[0][0] is not key[0] \
+                or cached[0][1] is not key[1]:
+            self._range_cache = (key,
+                                 _nonzero(self.data_max_ - self.data_min_))
+        return self._range_cache[1]
+
     def transform(self, x: Array) -> Array:
         self._check_fitted()
         lo, hi = self.feature_range
-        rng = self.data_max_ - self.data_min_
-        scaled = (x - self.data_min_) / _nonzero(rng)
+        scaled = (x - self.data_min_) / self._range_array()
         return scaled * (hi - lo) + float(lo)
 
     def inverse_transform(self, x: Array) -> Array:
         self._check_fitted()
         lo, hi = self.feature_range
-        rng = self.data_max_ - self.data_min_
-        return (x - float(lo)) / (hi - lo) * _nonzero(rng) + self.data_min_
+        return (x - float(lo)) / (hi - lo) * self._range_array() + self.data_min_
 
     def _check_fitted(self):
         if not hasattr(self, "data_min_"):

@@ -72,8 +72,15 @@ class Lasso(BaseEstimator):
     def predict(self, x: Array) -> Array:
         self._check_fitted()
         from dislib_tpu.math import matmul
-        w = Array._from_logical(np.asarray(self.coef_, np.float32).reshape(-1, 1))
-        return matmul(x, w)
+        # the weight Array is cached by coef_ identity: matmul already
+        # fuses, but rebuilding the ds-array per call paid a pad kernel +
+        # transfer per predict (visible on the serving hot path)
+        cached = getattr(self, "_w_cache", None)
+        if cached is None or cached[0] is not self.coef_:
+            w = Array._from_logical(
+                np.asarray(self.coef_, np.float32).reshape(-1, 1))
+            self._w_cache = (self.coef_, w)
+        return matmul(x, self._w_cache[1])
 
     def score(self, x: Array, y: Array) -> float:
         """R² (sklearn convention); computed on device."""

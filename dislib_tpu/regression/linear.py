@@ -17,7 +17,7 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array
+from dislib_tpu.data.array import Array, fused_kernel
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
 
@@ -40,10 +40,14 @@ class LinearRegression(BaseEstimator):
         return self
 
     def predict(self, x: Array) -> Array:
+        """ŷ = x @ coef + intercept as a fusion-graph node — one cached
+        dispatch for a whole scaler → predict chain (serving hot path)."""
         self._check_fitted()
-        out = _linreg_predict(x._data, x.shape, jnp.asarray(self.coef_),
-                              jnp.asarray(self.intercept_))
-        return Array._from_logical_padded(out, (x.shape[0], self.coef_.shape[1]))
+        coef, intercept = self._predict_leaves(self.coef_, self.intercept_)
+        return fused_kernel(
+            _linreg_predict_kernel, (x.shape,), (x, coef, intercept),
+            (x.shape[0], self.coef_.shape[1]), jnp.float32,
+            out_pshape=(x._pshape[0], self.coef_.shape[1]))
 
     def score(self, x: Array, y: Array) -> float:
         """R² score (sklearn convention); computed on device."""
@@ -122,10 +126,9 @@ def _r2_score(xp, yp, x_shape, y_shape, coef, intercept):
     return 1.0 - resid / jnp.maximum(total, 1e-12)
 
 
-@partial(jax.jit, static_argnames=("shape",))
-@precise
-def _linreg_predict(xp, shape, coef, intercept):
-    m, n = shape
+def _linreg_predict_kernel(cfg, xp, coef, intercept):
+    """`predict` as a fusion-node body (cfg = (logical shape,))."""
+    m, n = cfg[0]
     xv = xp[:, :n]
     out = xv @ coef + intercept[None, :]
     valid = lax.broadcasted_iota(jnp.int32, (xv.shape[0], 1), 0) < m

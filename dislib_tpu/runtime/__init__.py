@@ -17,7 +17,11 @@ the survival story is built from four pieces that compose (SURVEY §6
 - **health** — the round-8 *internal*-fault layer: fused numerical-health
   guards on every chunked fit loop, a chunk watchdog, snapshot writes
   gated on healthy chunks, and rollback-to-last-good remediation
-  (``health.py``).
+  (``health.py``);
+- **adoption** — the round-9 read-side hot-swap gate: serve checkpoint
+  generation N while N+1 trains; a reader adopts a new generation only
+  after the checksum-verified load AND a health-gated warmup predict
+  (``adoption.py``; the serving layer is lint-bound to it).
 
 Crash-consistent rotating snapshots live with the checkpoint format in
 ``dislib_tpu.utils.checkpoint``; the deterministic fault-injection harness
@@ -26,6 +30,8 @@ driving ``tests/test_resilience.py`` is ``dislib_tpu.utils.faults``.
 
 from dislib_tpu.runtime import xla_flags  # noqa: F401
 from dislib_tpu.runtime import health  # noqa: F401
+from dislib_tpu.runtime.adoption import (Adoption, AdoptionRejected,
+                                         adopt_latest, generation_token)
 from dislib_tpu.runtime.elastic import AsyncFetch, fetch, repad_rows
 from dislib_tpu.runtime.health import (ChunkGuard, HealthPolicy,
                                        NumericalDivergence, WatchdogTimeout)
@@ -42,5 +48,6 @@ __all__ = [
     "Retry", "retry_call", "is_transient_error",
     "repad_rows", "fetch", "AsyncFetch",
     "HealthPolicy", "ChunkGuard", "NumericalDivergence", "WatchdogTimeout",
+    "Adoption", "AdoptionRejected", "adopt_latest", "generation_token",
     "health", "xla_flags",
 ]

@@ -1,0 +1,47 @@
+"""dislib_tpu.serving — the low-latency predict path (ROADMAP item 1:
+the "millions of users" serving mode).
+
+Training hardened (PRs 1–3), `predict` was still a per-call afterthought:
+every request paid tracing/compile risk for its exact shape, per-op
+dispatch RTT (~70 ms/dispatch on the reference rig, BENCH_local_r05), and
+there was no way to serve a model while its successor trains.  This
+package makes one served batch cost **one cached XLA dispatch
+end-to-end**, from four pieces that compose:
+
+- **padded batch buckets** (``buckets.py``) — requests pad to a small
+  ladder of fixed row counts (default 1/8/64/512,
+  ``DSLIB_SERVE_BUCKETS``), so the entire serving lifetime touches a
+  handful of program shapes, all compiled at warmup.  Predict is
+  row-independent, so padded rows can never affect real rows' results;
+  their outputs are sliced away before the response.
+- **one-dispatch pipelines** (``pipeline.py``) — a scaler → estimator →
+  argmax/decision chain linearizes through the round-7 fusion layer
+  (every estimator predict is a ``fused_kernel`` graph node since this
+  round) into ONE cached XLA program per bucket.
+- **program cache + AOT warmup** (``cache.py``) — the (model generation,
+  bucket shape) ledger over XLA's executable cache: a generation serves
+  only after every bucket is warmed and health-gated, so the request hot
+  path never compiles and never meets an unvalidated model.
+- **micro-batching + hot-swap** (``server.py`` / ``hotswap.py``) — queued
+  requests coalesce into the smallest covering bucket under a latency
+  deadline (``DSLIB_SERVE_DEADLINE_MS``), and the served model follows a
+  rotating ``FitCheckpoint`` through the ``runtime.adoption`` gate: serve
+  generation N while N+1 trains, adopting N+1 only after its checksum
+  verifies and its warmup predict passes the health guard.
+
+See the user guide's "Serving & hot-swap" section for the end-to-end
+story and `bench.py::bench_serving` for the regression-gated numbers.
+"""
+
+from dislib_tpu.serving.buckets import (DEFAULT_BUCKETS, bucket_for,
+                                        bucket_ladder, split_rows)
+from dislib_tpu.serving.cache import ProgramCache
+from dislib_tpu.serving.hotswap import ModelPool
+from dislib_tpu.serving.pipeline import ServePipeline
+from dislib_tpu.serving.server import PredictServer, ServeResponse
+
+__all__ = [
+    "DEFAULT_BUCKETS", "bucket_ladder", "bucket_for", "split_rows",
+    "ProgramCache", "ServePipeline", "PredictServer", "ServeResponse",
+    "ModelPool",
+]

@@ -1,0 +1,89 @@
+"""Padded batch buckets — the fixed shape ladder served programs compile
+for.
+
+XLA rewards ahead-of-time compilation of whole programs to FIXED shapes
+(arXiv:1810.09868); a serving path that compiled per request row-count
+would pay a fresh trace+compile for every new batch size it meets.  The
+ladder quantizes every request batch up to a handful of row counts, so
+the WHOLE serving lifetime touches ``len(ladder)`` program shapes — all
+compiled once at warmup, none on the request path.
+
+Pad correctness: predict is row-independent for every served estimator
+(labels/decisions/votes are computed per row), and ds-array padding is
+zero-filled, so a padded row is just a zero-row prediction that the
+response slicing drops — padded rows can never affect real rows.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+DEFAULT_BUCKETS = (1, 8, 64, 512)
+
+
+def bucket_ladder(buckets=None):
+    """Normalised, ascending bucket ladder.  ``None`` reads
+    ``DSLIB_SERVE_BUCKETS`` (comma-separated row counts) and falls back
+    to :data:`DEFAULT_BUCKETS`."""
+    if buckets is None:
+        env = os.environ.get("DSLIB_SERVE_BUCKETS", "")
+        buckets = [int(b) for b in env.split(",") if b.strip()] \
+            if env.strip() else DEFAULT_BUCKETS
+    ladder = tuple(sorted({int(b) for b in buckets}))
+    if not ladder or ladder[0] < 1:
+        raise ValueError(f"bucket ladder must be positive row counts, got "
+                         f"{buckets!r}")
+    return ladder
+
+
+def bucket_for(n_rows: int, ladder) -> int | None:
+    """Smallest bucket covering ``n_rows``, or None when it exceeds the
+    largest bucket (the caller splits via :func:`split_rows`)."""
+    for b in ladder:
+        if n_rows <= b:
+            return b
+    return None
+
+
+def split_rows(n_rows: int, ladder):
+    """Chunk an oversize request into full largest-bucket pieces plus one
+    remainder piece (itself bucketed by the caller) — e.g. 1100 rows on
+    (1, 8, 64, 512) serves as pieces of 512 + 512 + 76, the last padding
+    into its covering 512 bucket.  Each piece costs one dispatch."""
+    top = ladder[-1]
+    sizes = []
+    left = int(n_rows)
+    while left > top:
+        sizes.append(top)
+        left -= top
+    if left:
+        sizes.append(left)
+    return sizes
+
+
+class BucketTemplate:
+    """Preallocated zeroed host staging buffer for one bucket's padded
+    shape.  ``fill`` writes the request rows and re-zeroes only the rows
+    the PREVIOUS batch dirtied (high-water tracking) — the hot path
+    never re-allocates or re-zeroes the whole canvas."""
+
+    def __init__(self, pshape, dtype=np.float32):
+        self.pshape = tuple(int(s) for s in pshape)
+        self.buf = np.zeros(self.pshape, dtype)
+        self._dirty_rows = 0
+        self._dirty_cols = 0
+
+    def fill(self, rows: np.ndarray) -> np.ndarray:
+        k, n = rows.shape
+        if k > self.pshape[0] or n > self.pshape[1]:
+            raise ValueError(f"batch {rows.shape} exceeds bucket canvas "
+                             f"{self.pshape}")
+        if self._dirty_rows > k:
+            self.buf[k:self._dirty_rows, : self._dirty_cols] = 0.0
+        if self._dirty_cols > n:        # never runs in serving use — the
+            self.buf[:k, n:self._dirty_cols] = 0.0  # pipeline pins one
+        self.buf[:k, :n] = rows                     # feature width
+        self._dirty_rows, self._dirty_cols = k, n
+        return self.buf

@@ -1,0 +1,98 @@
+"""ProgramCache — the (model generation, bucket shape) ledger over XLA's
+executable cache, plus the AOT warmup that fills it.
+
+The compiled executables themselves live in jax's jit cache, keyed by
+(fusion program, operand shapes): two generations of the SAME pipeline
+share one executable per bucket (their parameters are dynamic operands),
+which is what makes hot-swap free of recompiles.  What jax does NOT
+track is whether a given generation has been compiled-and-validated for
+a given bucket — that is this ledger.  It is ACCOUNTING, consulted by
+tests and operators (``stats()``/``is_warm()``); the actual never-
+compile-on-the-hot-path guarantees are structural: a static server
+warms its whole ladder in ``start()``, a ModelPool warms every bucket
+inside the adoption probe BEFORE the swap, and ``PredictServer``
+refuses at construction a ladder wider than its pool's.
+
+``warm()`` also records the trace-count delta per bucket from the
+``utils.profiling`` counters: the FIRST generation compiles each bucket
+once (delta ≥ 1), every later generation must re-use (delta 0) — the
+serving soak and `tests/test_serving.py` pin that invariant.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from dislib_tpu.utils import profiling as _prof
+
+
+class _Entry:
+    __slots__ = ("warm_wall_s", "traces", "hits")
+
+    def __init__(self, warm_wall_s, traces):
+        self.warm_wall_s = warm_wall_s
+        self.traces = traces
+        self.hits = 0
+
+
+class ProgramCache:
+    """Warmed-program ledger; one per server (or per standalone pipeline
+    user).  Keys are ``(generation_token, bucket_rows)``."""
+
+    def __init__(self):
+        self._entries: dict[tuple, _Entry] = {}
+
+    def is_warm(self, generation, bucket: int) -> bool:
+        return (generation, int(bucket)) in self._entries
+
+    def record_hit(self, generation, bucket: int) -> None:
+        e = self._entries.get((generation, int(bucket)))
+        if e is not None:
+            e.hits += 1
+
+    def warm(self, pipeline, generation, buckets) -> np.ndarray:
+        """AOT-warm ``pipeline`` for every bucket under ``generation``:
+        run one zero batch per bucket (compiling any program shape not
+        yet in the jit cache) and return the concatenated flat outputs —
+        the caller feeds them to the adoption health gate, so warmup and
+        the non-finite check are the same pass over the same programs.
+
+        Re-warming an already-warm (generation, bucket) is a cheap no-op
+        probe (one dispatch, zero traces)."""
+        outs = []
+        for b in buckets:
+            b = int(b)
+            t0 = time.perf_counter()
+            traces0 = _prof.trace_count()
+            out = pipeline.predict_bucket(
+                np.zeros((b, pipeline.n_features), np.float32), b)
+            self._entries[(generation, b)] = _Entry(
+                time.perf_counter() - t0, _prof.trace_count() - traces0)
+            outs.append(np.asarray(out, np.float64).ravel())
+        return np.concatenate(outs) if outs else np.zeros((0,))
+
+    def rekey(self, old_generation, new_generation) -> None:
+        """Move every bucket entry from a provisional generation key to
+        the real one (hot-swap warms a candidate before its adoption
+        token exists — see ``ModelPool._warm_probe``) and EVICT every
+        other generation's entries: one generation serves at a time, and
+        a long-running pool following a frequently-checkpointing trainer
+        would otherwise grow the ledger (and every ``stats()`` snapshot)
+        without bound."""
+        self._entries = {
+            (new_generation, b): e
+            for (g, b), e in self._entries.items()
+            if g in (old_generation, new_generation)}
+
+    def stats(self) -> dict:
+        """Per-entry ledger snapshot: ``{(generation, bucket): {...}}``
+        flattened to string keys for JSON-friendliness."""
+        return {f"gen={g!r}/bucket={b}": {
+                    "warm_wall_s": round(e.warm_wall_s, 6),
+                    "traces_at_warm": e.traces, "hits": e.hits}
+                for (g, b), e in self._entries.items()}
+
+    def __len__(self):
+        return len(self._entries)

@@ -1,0 +1,115 @@
+"""ServePipeline — a fitted preprocessing + estimator chain as ONE
+cached XLA dispatch per served bucket.
+
+The whole predict pipeline (scaler transform → estimator predict →
+argmax/decision/class lookup) linearizes through the round-7 dispatch
+fusion layer: every transform is an elementwise graph node and every
+estimator predict is a ``fused_kernel`` node since this round, so the
+first force point compiles and runs the chain as one ``_exec_program``
+executable, cached by (program, bucket shape).  The hot path is
+
+    host staging buffer → device_put → one fused dispatch → device_get
+
+with zero per-request tracing, zero pad kernels (the staging buffer is
+pre-padded on host), and zero model-parameter transfers (leaves are
+device-cached per generation via ``BaseEstimator._predict_leaves``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dislib_tpu.data.array import Array, _padded_shape
+from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.serving.buckets import BucketTemplate
+
+# attributes probed, in order, to infer the feature width of a fitted
+# model when the caller does not pass n_features explicitly
+_FEATURE_ATTRS = ("centers_", "means_", "_sv_x")
+
+
+def _infer_features(model, transforms):
+    for t in transforms:
+        # explicit None checks — `or` would probe ndarray truthiness on
+        # duck-typed (sklearn-style) scalers and raise
+        m = getattr(t, "mean_", None)
+        if m is None:
+            m = getattr(t, "data_min_", None)
+        if m is not None and hasattr(m, "shape"):
+            return int(np.shape(m)[-1])
+    for attr in _FEATURE_ATTRS:
+        v = getattr(model, attr, None)
+        if v is not None:
+            return int(np.shape(v)[1])
+    coef = getattr(model, "coef_", None)
+    if coef is not None:
+        return int(np.shape(coef)[0])
+    nf = getattr(model, "n_features_", None)
+    if nf is not None:
+        return int(nf)
+    raise ValueError(
+        "could not infer the pipeline's feature width — pass "
+        "n_features= to ServePipeline")
+
+
+class ServePipeline:
+    """A fitted chain ``transforms → model.<method>`` executable per
+    bucket as one fused dispatch.
+
+    Parameters
+    ----------
+    model : fitted estimator — its ``method`` (default ``"predict"``)
+        must return a ds-array (all library estimators do).
+    transforms : sequence of fitted transformers applied in order
+        (``.transform``), e.g. a StandardScaler.
+    method : str — the model entry point: ``"predict"``,
+        ``"predict_proba"``, ``"decision_function"``, ...
+    n_features : int — request feature width; inferred from the fitted
+        attributes when omitted.
+
+    Not thread-safe: the serving worker (or one caller) drives it.
+    """
+
+    def __init__(self, model, transforms=(), method="predict",
+                 n_features=None):
+        self.model = model
+        self.transforms = tuple(transforms)
+        self.method = method
+        self.n_features = int(n_features) if n_features is not None \
+            else _infer_features(model, self.transforms)
+        self._templates: dict[int, BucketTemplate] = {}
+        self.out_cols: int | None = None    # discovered at first execute
+
+    def __call__(self, x: Array) -> Array:
+        for t in self.transforms:
+            x = t.transform(x)
+        return getattr(self.model, self.method)(x)
+
+    def _template(self, bucket: int) -> BucketTemplate:
+        tmpl = self._templates.get(bucket)
+        if tmpl is None:
+            pshape = _padded_shape((bucket, self.n_features),
+                                   _mesh.pad_quantum())
+            tmpl = self._templates[bucket] = BucketTemplate(pshape)
+        return tmpl
+
+    def predict_bucket(self, rows: np.ndarray, bucket: int) -> np.ndarray:
+        """Serve one batch padded into ``bucket``: returns the logical
+        (n_rows, out_cols) host result.  This is the one-dispatch hot
+        path — stage, transfer, force the fused chain, fetch, slice."""
+        import jax
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim == 1:
+            rows = rows.reshape(1, -1)
+        if rows.shape[1] != self.n_features:
+            raise ValueError(f"request has {rows.shape[1]} features, "
+                             f"pipeline serves {self.n_features}")
+        if rows.shape[0] > bucket:
+            raise ValueError(f"{rows.shape[0]} rows exceed bucket {bucket}")
+        buf = self._template(bucket).fill(rows)
+        dev = jax.device_put(buf, _mesh.data_sharding())
+        out = self(Array(dev, (bucket, self.n_features)))
+        host = _fetch(out)                  # force: ONE fused dispatch
+        self.out_cols = out.shape[1]
+        return host[: rows.shape[0], : out.shape[1]]

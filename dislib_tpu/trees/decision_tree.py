@@ -193,9 +193,10 @@ def _leaf_stats(node, w, stats, n_leaves):
     return leaves, _health.health_vec(carries=(leaves,))
 
 
-@partial(_pjit, static_argnames=("depth", "q_shape"), name="forest_apply")
-def _forest_apply(qp, q_shape, edges, feats, tbins, depth):
-    """Leaf index of every query row in every tree: (T, mq_pad)."""
+def _forest_apply_core(qp, q_shape, edges, feats, tbins, depth):
+    """Leaf index of every query row in every tree: (T, mq_pad).  Plain
+    traced body — shared by the jitted `_forest_apply`, the score
+    kernels, and the fused predict nodes in `forest.py`."""
     bq = _bin_data(qp, q_shape, edges)                # (mq_pad, n)
 
     def one_tree(feat_l, tbin_l):
@@ -208,6 +209,11 @@ def _forest_apply(qp, q_shape, edges, feats, tbins, depth):
         return node
 
     return jax.vmap(one_tree)(feats, tbins)
+
+
+@partial(_pjit, static_argnames=("depth", "q_shape"), name="forest_apply")
+def _forest_apply(qp, q_shape, edges, feats, tbins, depth):
+    return _forest_apply_core(qp, q_shape, edges, feats, tbins, depth)
 
 
 # ---------------------------------------------------------------------------

@@ -13,9 +13,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.data.array import (Array, _padded_dim, _place_region,
+                                   fused_kernel)
+from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.trees.decision_tree import (_BaseTreeEnsemble,
-                                            _forest_apply, _pack_levels)
+                                            _forest_apply, _forest_apply_core,
+                                            _pack_levels)
 
 
 def _cls_enc(counts, hard):
@@ -60,28 +63,31 @@ class _ClassifierMixin:
 
     def predict_proba(self, x: Array) -> Array:
         self._check_fitted()
-        leaf = self._apply(x)                               # (T, mq_pad)
-        counts = jnp.take_along_axis(
-            self._leaves, leaf[:, :, None], axis=1)         # (T, mq_pad, K)
-        probs = counts / jnp.maximum(
-            jnp.sum(counts, axis=2, keepdims=True), 1e-12)
-        mean = jnp.mean(probs, axis=0)                      # (mq_pad, K)
         k = len(self.classes_)
-        out = _repad(mean[: x.shape[0]], (x.shape[0], k))
-        return Array._from_logical_padded(out, (x.shape[0], k))
+        out_pshape = (x._pshape[0], _padded_dim(k, _mesh.pad_quantum()))
+        edges, feats, tbins, leaves = self._predict_leaves(
+            self._edges, self._feats, self._tbins, self._leaves)
+        return fused_kernel(
+            _forest_proba_kernel, (x.shape, self._depth, out_pshape),
+            (x, edges, feats, tbins, leaves),
+            (x.shape[0], k), jnp.float32, out_pshape=out_pshape)
 
     def predict(self, x: Array) -> Array:
+        """Class label per row — one fusion node: the gather-walk apply,
+        the vote, AND the class-value lookup all on device (the old host
+        round-trip between vote and label selection was a hidden
+        per-predict sync; integer classes stay int32, exact to 2^31 where
+        float32 corrupts past 2^24 — VERDICT r1 weak #8)."""
         self._check_fitted()
-        leaf = self._apply(x)
-        counts = jnp.take_along_axis(self._leaves, leaf[:, :, None], axis=1)
-        enc = _cls_enc(counts, getattr(self, "hard_vote", False))
-        labels = self.classes_[np.asarray(jax.device_get(enc))[: x.shape[0]]]
-        # integer class values stay integral (int32 is exact to 2^31;
-        # float32 corrupts labels past 2^24 — VERDICT r1 weak #8)
-        dt = np.int32 if np.issubdtype(labels.dtype, np.integer) else np.float32
-        out = jnp.asarray(labels.astype(dt)[:, None])
-        return Array._from_logical_padded(_repad(out, (x.shape[0], 1)),
-                                          (x.shape[0], 1))
+        classes = self._classes_leaf()
+        edges, feats, tbins, leaves, classes_dev = self._predict_leaves(
+            self._edges, self._feats, self._tbins, self._leaves, classes)
+        return fused_kernel(
+            _forest_cls_predict_kernel,
+            (x.shape, self._depth, bool(getattr(self, "hard_vote", False))),
+            (x, edges, feats, tbins, leaves, classes_dev),
+            (x.shape[0], 1), classes_dev.dtype,
+            out_pshape=(x._pshape[0], 1))
 
     def score(self, x: Array, y: Array) -> float:
         pred = self.predict(x).collect().ravel()
@@ -119,11 +125,12 @@ class _RegressorMixin:
 
     def predict(self, x: Array) -> Array:
         self._check_fitted()
-        leaf = self._apply(x)                               # (T, mq_pad)
-        stats = jnp.take_along_axis(self._leaves, leaf[:, :, None], axis=1)
-        pred = _reg_mean(stats)[:, None]                    # (mq_pad, 1)
-        return Array._from_logical_padded(
-            _repad(pred[: x.shape[0]], (x.shape[0], 1)), (x.shape[0], 1))
+        edges, feats, tbins, leaves = self._predict_leaves(
+            self._edges, self._feats, self._tbins, self._leaves)
+        return fused_kernel(
+            _forest_reg_predict_kernel, (x.shape, self._depth),
+            (x, edges, feats, tbins, leaves),
+            (x.shape[0], 1), jnp.float32, out_pshape=(x._pshape[0], 1))
 
     def score(self, x: Array, y: Array) -> float:
         """R² (sklearn convention)."""
@@ -226,6 +233,46 @@ class DecisionTreeRegressor(_RegressorMixin, _BaseTreeEnsemble):
 
     def _fit_spec(self):
         return 1, False
+
+
+# ---------------------------------------------------------------------------
+# fused predict bodies (data.array.fused_kernel nodes — one dispatch for a
+# whole scaler → forest pipeline; round-9 serving PR)
+# ---------------------------------------------------------------------------
+
+def _forest_votes(qp, q_shape, edges, feats, tbins, leaves, depth):
+    """apply + per-tree leaf-stat gather: (T, mq_pad, S)."""
+    leaf = _forest_apply_core(qp, q_shape, edges, feats, tbins, depth)
+    return jnp.take_along_axis(leaves, leaf[:, :, None], axis=1)
+
+
+def _mask_rows(vals, m):
+    """Zero rows at or past the logical row count (padded rows walk the
+    trees too and land in SOME leaf — their votes must not escape)."""
+    valid = lax.broadcasted_iota(jnp.int32, (vals.shape[0], 1), 0) < m
+    return jnp.where(valid, vals, jnp.zeros((), vals.dtype))
+
+
+def _forest_cls_predict_kernel(cfg, qp, edges, feats, tbins, leaves, classes):
+    q_shape, depth, hard = cfg
+    counts = _forest_votes(qp, q_shape, edges, feats, tbins, leaves, depth)
+    enc = _cls_enc(counts, hard)
+    return _mask_rows(classes[enc][:, None], q_shape[0])
+
+
+def _forest_proba_kernel(cfg, qp, edges, feats, tbins, leaves):
+    q_shape, depth, out_pshape = cfg
+    counts = _forest_votes(qp, q_shape, edges, feats, tbins, leaves, depth)
+    probs = counts / jnp.maximum(
+        jnp.sum(counts, axis=2, keepdims=True), 1e-12)
+    mean = _mask_rows(jnp.mean(probs, axis=0), q_shape[0])  # (mq_pad, K)
+    return _place_region(mean, out_pshape)
+
+
+def _forest_reg_predict_kernel(cfg, qp, edges, feats, tbins, leaves):
+    q_shape, depth = cfg
+    stats = _forest_votes(qp, q_shape, edges, feats, tbins, leaves, depth)
+    return _mask_rows(_reg_mean(stats)[:, None], q_shape[0])
 
 
 # ---------------------------------------------------------------------------

@@ -78,10 +78,19 @@ def _fsync_dir(path: str) -> None:
 
 def _load_verified(path: str) -> dict:
     """Read one generation, verifying npz integrity AND the embedded
-    checksum; raises :class:`SnapshotCorrupt` on any damage."""
+    checksum; raises :class:`SnapshotCorrupt` on any damage.  A
+    ``FileNotFoundError`` propagates UNWRAPPED: the file vanishing
+    between the caller's ``exists()`` and the open here means a
+    concurrent ``save`` is mid-rotation (hot-swap readers poll live
+    checkpoints) — that is "look at the next generation", not
+    corruption, and it must never reach the corrupt-file cleanup, which
+    would otherwise ``os.remove`` the name a racing writer has just
+    re-pointed at a brand-new good generation."""
     try:
         with np.load(path, allow_pickle=False) as z:
             state = {k: z[k] for k in z.files}
+    except FileNotFoundError:
+        raise
     except (zipfile.BadZipFile, EOFError, OSError, ValueError, KeyError) as e:
         raise SnapshotCorrupt(
             f"snapshot {path} is truncated or corrupt ({e})") from e
@@ -234,19 +243,27 @@ class FitCheckpoint:
         self.flush()                    # never read around an in-flight write
         seen = 0
         first_err: SnapshotCorrupt | None = None
-        bad: list[str] = []
+        bad: list[tuple[str, tuple]] = []
         for i in range(self.keep):
             p = self._gen_path(i)
             if not os.path.exists(p):
                 continue
-            seen += 1
             try:
+                read_stat = os.stat(p)
                 state = _load_verified(p)
+            except FileNotFoundError:
+                # vanished between exists() and open(): a concurrent
+                # save's rotation is in flight (hot-swap reader on a live
+                # checkpoint).  Not corruption and not "seen" — the next
+                # generation (or the next poll) holds a complete file.
+                continue
             except SnapshotCorrupt as e:
+                seen += 1
                 if first_err is None:
                     first_err = e
-                bad.append(p)
+                bad.append((p, (read_stat.st_ino, read_stat.st_mtime_ns)))
                 continue
+            seen += 1
             if first_err is not None:
                 warnings.warn(
                     f"checkpoint {self.path}: newest snapshot unusable "
@@ -256,10 +273,14 @@ class FitCheckpoint:
                 # next save() would rotate a known-corrupt file over this
                 # good one, and a crash mid-save would then leave nothing
                 # usable — exactly the >1-generation loss save() promises
-                # never to cause
-                for b in bad:
+                # never to cause.  Guard: only remove the exact inode we
+                # read as corrupt — a racing writer may have re-pointed
+                # the name at a brand-new good generation since.
+                for b, (ino, mt) in bad:
                     try:
-                        os.remove(b)
+                        st = os.stat(b)
+                        if (st.st_ino, st.st_mtime_ns) == (ino, mt):
+                            os.remove(b)
                     except OSError:
                         pass
             return state

@@ -50,12 +50,26 @@ class TestBenchOrchestrator:
     def test_probe_failure_emits_stale_fallback(self):
         """Round-5 (r4 VERDICT weak #8): a wedged/failed probe re-emits the
         last green local capture marked stale — rc stays 2 for the driver,
-        but the artifact is informative instead of one error line."""
+        but the artifact is informative instead of one error line.
+
+        Round-9 satellite (ROADMAP item 5 follow-up — BENCH_r05.json's
+        stale chip rows read like fresh evidence): the fallback must ALSO
+        lead with an explicit ``stale_carryover`` record, mark every
+        replayed row ``stale_carryover: true``, and shout on stderr."""
         res = _run({"JAX_PLATFORMS": "bogus_platform",
                     "DSLIB_BENCH_PROBE_S": "30"})
         assert res.returncode == 2
         lines = _lines(res.stdout)
         stale = [l for l in lines if l.get("stale")]
+        # the leading top-level flag record precedes every replayed row
+        flags = [i for i, l in enumerate(lines)
+                 if l.get("metric") == "stale_carryover"]
+        assert flags, "no leading stale_carryover record"
+        assert lines[flags[0]]["stale_carryover"] is True
+        assert all(i > flags[0] for i, l in enumerate(lines)
+                   if l.get("stale"))
+        assert all(l.get("stale_carryover") for l in stale)
+        assert "STALE CARRYOVER" in res.stderr
         # BENCH_local_r05.jsonl is committed in-repo, so the fallback has
         # a capture to replay; every replayed row is flagged + attributed
         assert stale, "no stale fallback rows emitted"

@@ -35,6 +35,10 @@ ESTIMATOR_DIRS = (
     "dislib_tpu/neighbors",
     "dislib_tpu/optimization",
     "dislib_tpu/model_selection",
+    # round-9: the serving hot path — ONE fetch per served batch is the
+    # whole design; a stray per-request sync here is the regression the
+    # lint exists for
+    "dislib_tpu/serving",
 )
 
 # (file, enclosing function) pairs allowed to host-sync inside a loop,
@@ -59,6 +63,10 @@ ALLOWLIST = {
     ("dislib_tpu/model_selection/search.py", "_block_tree"),
     ("dislib_tpu/model_selection/search.py", "_dispatch_fold"),
     ("dislib_tpu/model_selection/search.py", "fit"),
+    # serving AOT warmup: one sync per BUCKET at warm time (adoption /
+    # server start), never on the request path — the hot path's only
+    # sync is the blessed runtime.fetch inside predict_bucket
+    ("dislib_tpu/serving/cache.py", "warm"),
 }
 
 _RAW_SYNC_ATTRS = ("device_get", "collect", "block_until_ready")

@@ -1,0 +1,600 @@
+"""Serving layer (round-9 tentpole): padded batch buckets, one-dispatch
+pipelines, the micro-batching server, and checkpoint hot-swap through the
+adoption gate.
+
+Compile-budget note (tier-1 discipline, see ROADMAP): every jitted
+program in this file uses ONE feature width (8), ONE bucket ladder
+(1/8/64) and module-cached fitted models, so the serving programs
+compile once for the whole file.
+"""
+
+import ast
+import os
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.runtime import AdoptionRejected, adopt_latest, \
+    generation_token
+from dislib_tpu.serving import (ModelPool, PredictServer, ProgramCache,
+                                ServePipeline, bucket_for, bucket_ladder,
+                                split_rows)
+from dislib_tpu.serving.buckets import BucketTemplate
+from dislib_tpu.utils import profiling as prof
+from dislib_tpu.utils.checkpoint import FitCheckpoint
+from dislib_tpu.utils.faults import corrupt_snapshot
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BUCKETS = (1, 8, 64)
+NF = 8
+
+_ctx = {}
+
+
+def ctx():
+    """Module-cached data + fitted models (one compile set per file)."""
+    if not _ctx:
+        rng = np.random.RandomState(7)
+        x = rng.rand(200, NF).astype(np.float32)
+        a = ds.array(x)
+        _ctx["x"] = x
+        _ctx["a"] = a
+        _ctx["scaler"] = ds.StandardScaler().fit(a)
+        _ctx["km"] = ds.KMeans(n_clusters=3, max_iter=4,
+                               random_state=0).fit(a)
+    return _ctx
+
+
+def _linreg_state(g):
+    """Generation g of the hot-swap test model: ŷ = x @ 1 + g, so a
+    response's value − row-sum identifies EXACTLY which generation
+    computed it (the torn-handoff oracle)."""
+    return {"coef": np.ones((NF, 1), np.float32),
+            "intercept": np.full(1, float(g), np.float32)}
+
+
+def _build_linreg(state):
+    lr = ds.LinearRegression()
+    lr.coef_ = np.asarray(state["coef"], np.float32)
+    lr.intercept_ = np.asarray(state["intercept"], np.float32)
+    return ServePipeline(lr, n_features=NF)
+
+
+def _gen_of(values, rows):
+    """Recover the generation a response was computed by (see
+    `_linreg_state`); float32 exact for small integers."""
+    g = np.unique(np.round(values.ravel() - rows.sum(axis=1), 3))
+    assert len(g) == 1, f"response mixes generations: {g}"
+    return float(g[0])
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+class TestBuckets:
+    def test_ladder_default_and_env(self, monkeypatch):
+        assert bucket_ladder((64, 1, 8, 8)) == (1, 8, 64)
+        monkeypatch.setenv("DSLIB_SERVE_BUCKETS", "4, 32")
+        assert bucket_ladder() == (4, 32)
+        monkeypatch.delenv("DSLIB_SERVE_BUCKETS")
+        assert bucket_ladder()[0] >= 1
+
+    def test_ladder_rejects_nonpositive(self):
+        with pytest.raises(ValueError):
+            bucket_ladder((0, 8))
+
+    def test_bucket_for(self):
+        assert bucket_for(1, BUCKETS) == 1
+        assert bucket_for(2, BUCKETS) == 8
+        assert bucket_for(8, BUCKETS) == 8
+        assert bucket_for(64, BUCKETS) == 64
+        assert bucket_for(65, BUCKETS) is None
+
+    def test_split_rows(self):
+        assert split_rows(5, BUCKETS) == [5]
+        assert split_rows(64, BUCKETS) == [64]
+        assert split_rows(150, BUCKETS) == [64, 64, 22]
+
+    def test_template_rezeroes_only_dirty_rows(self):
+        t = BucketTemplate((8, 4))
+        t.fill(np.ones((5, 4), np.float32) * 3.0)
+        buf = t.fill(np.ones((2, 4), np.float32))
+        assert np.all(buf[:2] == 1.0)
+        assert np.all(buf[2:] == 0.0)       # rows 2:5 were dirty
+
+    def test_template_rejects_oversize(self):
+        with pytest.raises(ValueError):
+            BucketTemplate((8, 4)).fill(np.ones((9, 4), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# one-dispatch predict pipelines
+# ---------------------------------------------------------------------------
+
+class TestOneDispatchPipelines:
+    def test_scaler_kmeans_chain_is_one_dispatch(self):
+        c = ctx()
+        pred = c["km"].predict(c["scaler"].transform(c["a"]))
+        assert pred.is_lazy                  # nothing dispatched yet
+        pred.force()                         # warm/compile
+        prof.reset_counters()
+        c["km"].predict(c["scaler"].transform(c["a"])).force()
+        assert prof.dispatch_count() == 1
+        assert prof.counters()["dispatch_by"] == {"fused_chain": 1}
+
+    def test_warm_predict_adds_zero_traces(self):
+        c = ctx()
+        c["km"].predict(c["scaler"].transform(c["a"])).force()
+        t0 = prof.trace_count()
+        c["km"].predict(c["scaler"].transform(c["a"])).force()
+        assert prof.trace_count() == t0
+
+    def test_fused_chain_matches_eager(self, monkeypatch):
+        c = ctx()
+        got = c["km"].predict(c["scaler"].transform(c["a"])).collect()
+        monkeypatch.setenv("DSLIB_EAGER", "1")
+        eager = c["km"].predict(c["scaler"].transform(c["a"]))
+        assert not eager.is_lazy
+        np.testing.assert_array_equal(got, eager.collect())
+
+    def test_bucket_predict_matches_direct(self):
+        c = ctx()
+        pipe = ServePipeline(c["km"], transforms=(c["scaler"],),
+                             n_features=NF)
+        rows = c["x"][:5]
+        direct = c["km"].predict(
+            c["scaler"].transform(ds.array(rows))).collect()
+        np.testing.assert_array_equal(pipe.predict_bucket(rows, 8), direct)
+
+    def test_bucket_hot_path_is_one_dispatch_zero_traces(self):
+        c = ctx()
+        pipe = ServePipeline(c["km"], transforms=(c["scaler"],),
+                             n_features=NF)
+        pipe.predict_bucket(c["x"][:3], 8)   # warm
+        prof.reset_counters()
+        t0 = prof.trace_count()
+        pipe.predict_bucket(c["x"][10:14], 8)
+        assert prof.dispatch_count() == 1
+        assert prof.trace_count() == t0
+
+    def test_generation_swap_costs_zero_traces(self):
+        """Two model generations of identical shapes share one compiled
+        executable per bucket — the hot-swap no-recompile invariant."""
+        c = ctx()
+        km2 = ds.KMeans(n_clusters=3, max_iter=4, random_state=1) \
+            .fit(c["a"])
+        pipe1 = ServePipeline(c["km"], transforms=(c["scaler"],),
+                              n_features=NF)
+        pipe2 = ServePipeline(km2, transforms=(c["scaler"],),
+                              n_features=NF)
+        pipe1.predict_bucket(c["x"][:3], 8)  # warm generation 1
+        t0 = prof.trace_count()
+        pipe2.predict_bucket(c["x"][:3], 8)  # generation 2: cache hit
+        assert prof.trace_count() == t0
+
+    def test_pipeline_rejects_bad_requests(self):
+        c = ctx()
+        pipe = ServePipeline(c["km"], transforms=(c["scaler"],),
+                             n_features=NF)
+        with pytest.raises(ValueError, match="features"):
+            pipe.predict_bucket(np.ones((2, NF + 1), np.float32), 8)
+        with pytest.raises(ValueError, match="exceed"):
+            pipe.predict_bucket(np.ones((9, NF), np.float32), 8)
+
+    def test_infers_feature_width(self):
+        c = ctx()
+        assert ServePipeline(c["km"]).n_features == NF
+        assert ServePipeline(c["km"],
+                             transforms=(c["scaler"],)).n_features == NF
+
+    def test_program_cache_ledger(self):
+        c = ctx()
+        pipe = ServePipeline(c["km"], n_features=NF)
+        cache = ProgramCache()
+        out = cache.warm(pipe, "g0", BUCKETS)
+        assert np.all(np.isfinite(out))
+        assert len(cache) == len(BUCKETS)
+        assert cache.is_warm("g0", 8) and not cache.is_warm("g1", 8)
+        cache.rekey("g0", "g1")
+        assert cache.is_warm("g1", 8) and not cache.is_warm("g0", 8)
+        # rekey evicts superseded generations — the ledger is bounded by
+        # one live generation however many adoptions a pool performs
+        cache.warm(pipe, "warming", BUCKETS)
+        cache.rekey("warming", "g2")
+        assert len(cache) == len(BUCKETS)
+        assert cache.is_warm("g2", 8) and not cache.is_warm("g1", 8)
+
+
+# ---------------------------------------------------------------------------
+# the micro-batching server
+# ---------------------------------------------------------------------------
+
+def _km_server(deadline_ms=5):
+    c = ctx()
+    pipe = ServePipeline(c["km"], transforms=(c["scaler"],), n_features=NF)
+    return PredictServer(pipeline=pipe, buckets=BUCKETS,
+                         deadline_ms=deadline_ms)
+
+
+class TestPredictServer:
+    def test_single_request_flushes_on_deadline(self):
+        c = ctx()
+        with _km_server(deadline_ms=5) as srv:
+            r = srv.submit(c["x"][0]).result(timeout=30)
+            assert r.values.shape == (1, 1)
+            assert srv.stats()["batches"] == 1
+
+    def test_burst_coalesces_one_dispatch_per_batch(self):
+        c = ctx()
+        with _km_server(deadline_ms=10) as srv:
+            futs = [srv.submit(c["x"][i:i + 2]) for i in range(0, 80, 2)]
+            outs = [f.result(timeout=30) for f in futs]
+            st = srv.stats()
+        assert st["requests"] == 40 and st["rows"] == 80
+        assert st["batches"] < st["requests"]     # coalescing happened
+        assert st["dispatches_per_batch_max"] == 1
+        ref = c["km"].predict(
+            c["scaler"].transform(c["a"])).collect().ravel()
+        for i, o in zip(range(0, 80, 2), outs):
+            np.testing.assert_array_equal(o.values.ravel(), ref[i:i + 2])
+
+    def test_oversize_request_splits_across_buckets(self):
+        c = ctx()
+        with _km_server() as srv:
+            r = srv.submit(c["x"][:150]).result(timeout=30)
+            st = srv.stats()
+        assert r.values.shape == (150, 1)
+        # 150 rows over (1, 8, 64): three pieces, one dispatch each
+        assert st["dispatches_per_batch_max"] == len(split_rows(150, BUCKETS))
+
+    def test_bad_request_fails_its_future_not_the_server(self):
+        c = ctx()
+        with _km_server() as srv:
+            bad = srv.submit(np.ones((2, NF + 3), np.float32))
+            with pytest.raises(ValueError):
+                bad.result(timeout=30)
+            good = srv.submit(c["x"][:2]).result(timeout=30)
+            assert good.values.shape == (2, 1)
+
+    def test_bad_request_does_not_poison_its_cobatched_peers(self):
+        """A malformed request coalesced into the same deadline window
+        as valid ones must fail ITS future only."""
+        c = ctx()
+        with _km_server(deadline_ms=50) as srv:
+            good1 = srv.submit(c["x"][:2])
+            bad = srv.submit(np.ones((2, NF + 3), np.float32))
+            good2 = srv.submit(c["x"][2:4])
+            with pytest.raises(ValueError, match="features"):
+                bad.result(timeout=30)
+            assert good1.result(timeout=30).values.shape == (2, 1)
+            assert good2.result(timeout=30).values.shape == (2, 1)
+
+    def test_submit_outside_lifecycle_raises(self):
+        srv = _km_server()
+        with pytest.raises(RuntimeError):
+            srv.submit(np.ones((1, NF), np.float32))
+        with srv:
+            pass
+        with pytest.raises(RuntimeError):
+            srv.submit(np.ones((1, NF), np.float32))
+
+    def test_queue_backpressure_rejects_not_oom(self):
+        """A client outrunning the device hits a typed queue-full error
+        instead of growing the queue without bound; already-accepted
+        requests still drain at stop()."""
+        c = ctx()
+        pipe = ServePipeline(c["km"], transforms=(c["scaler"],),
+                             n_features=NF)
+        srv = PredictServer(pipeline=pipe, buckets=BUCKETS,
+                            deadline_ms=2000, max_queue_rows=4)
+        with srv:
+            futs = [srv.submit(c["x"][i:i + 2]) for i in (0, 2)]
+            with pytest.raises(RuntimeError, match="queue full"):
+                srv.submit(c["x"][:1])
+        for f in futs:                      # stop() drained the queue
+            assert f.result(timeout=10).values.shape == (2, 1)
+
+    def test_pool_server_bucket_mismatch_rejected(self, tmp_path):
+        """A served bucket the pool never warms/health-gates would pay a
+        hot-path compile and dodge the adoption gate — constructor error."""
+        pool = ModelPool(FitCheckpoint(str(tmp_path / "g.npz"), keep=2),
+                         _build_linreg, buckets=(1, 8))
+        with pytest.raises(ValueError, match="warmed ladder"):
+            PredictServer(pool=pool, buckets=(1, 8, 64))
+        PredictServer(pool=pool, buckets=(1,))      # subset is fine
+
+    def test_predict_leaf_cache_stable_across_methods(self):
+        """predict ↔ predict_proba alternate different leaf tuples; the
+        device cache must hold one entry per tuple, not thrash (a thrash
+        re-uploads the whole model per call)."""
+        c = ctx()
+        y = ds.array((c["x"][:, 0] > 0.5).astype(np.float32)[:, None])
+        rf = ds.RandomForestClassifier(n_estimators=2, max_depth=3,
+                                       random_state=0).fit(c["a"], y)
+        rf.predict(c["a"]).force()
+        rf.predict_proba(c["a"]).force()
+        leaves_a = rf._predict_leaves(rf._edges, rf._feats, rf._tbins,
+                                      rf._leaves)
+        rf.predict(c["a"]).force()                  # alternation...
+        leaves_b = rf._predict_leaves(rf._edges, rf._feats, rf._tbins,
+                                      rf._leaves)
+        assert all(a is b for a, b in zip(leaves_a, leaves_b)), \
+            "leaf cache thrashed across method alternation"
+
+    def test_stats_shape(self):
+        with _km_server() as srv:
+            srv.predict(np.zeros((2, NF), np.float32))
+            st = srv.stats()
+        for key in ("p50_ms", "p99_ms", "requests", "rows", "batches",
+                    "dispatches_per_batch_max", "queue_depth"):
+            assert key in st
+
+
+# ---------------------------------------------------------------------------
+# checkpoint hot-swap through the adoption gate
+# ---------------------------------------------------------------------------
+
+class TestHotSwap:
+    def test_adopt_latest_gates_and_tokens(self, tmp_path):
+        path = str(tmp_path / "gen.npz")
+        writer = FitCheckpoint(path, keep=2)
+        reader = FitCheckpoint(path, keep=2)
+        assert generation_token(reader) is None
+        assert adopt_latest(reader, _build_linreg) is None
+        writer.save(_linreg_state(1))
+        ad = adopt_latest(reader, _build_linreg,
+                          probe=lambda p: p.predict_bucket(
+                              np.zeros((1, NF), np.float32), 1))
+        assert ad is not None
+        # same generation again: no-op
+        assert adopt_latest(reader, _build_linreg,
+                            last_token=ad.token) is None
+        writer.save(_linreg_state(2))
+        ad2 = adopt_latest(reader, _build_linreg, last_token=ad.token)
+        assert ad2 is not None and ad2.token != ad.token
+        assert float(ad2.state["intercept"][0]) == 2.0
+
+    def test_unhealthy_generation_raises_typed(self, tmp_path):
+        path = str(tmp_path / "gen.npz")
+        writer = FitCheckpoint(path, keep=2)
+        writer.save({"coef": np.full((NF, 1), np.nan, np.float32),
+                     "intercept": np.zeros(1, np.float32)})
+        with pytest.raises(AdoptionRejected):
+            adopt_latest(FitCheckpoint(path, keep=2), _build_linreg,
+                         probe=lambda p: p.predict_bucket(
+                             np.zeros((1, NF), np.float32), 1))
+
+    def test_nan_state_rejected_even_behind_integer_labels(self, tmp_path):
+        """The probe alone is blind to NaN parameters when predict emits
+        int labels (argmin over all-NaN distances is a finite int32) —
+        the STATE gate must refuse the generation anyway."""
+        path = str(tmp_path / "gen.npz")
+        FitCheckpoint(path, keep=2).save(
+            {"centers": np.full((3, NF), np.nan, np.float32)})
+
+        def build(state):
+            km = ds.KMeans(n_clusters=3)
+            km.centers_ = np.asarray(state["centers"], np.float32)
+            return ServePipeline(km, n_features=NF)
+
+        probe = lambda p: p.predict_bucket(  # noqa: E731
+            np.zeros((1, NF), np.float32), 1)
+        out = probe(build({"centers": np.full((3, NF), np.nan,
+                                              np.float32)}))
+        assert np.all(np.isfinite(out))      # the blindness being tested
+        with pytest.raises(AdoptionRejected, match="non-finite state"):
+            adopt_latest(FitCheckpoint(path, keep=2), build, probe=probe)
+
+    def test_writer_rotation_never_yields_torn_state(self, tmp_path):
+        """Satellite 3: a writer rotating keep=2 generations at full speed
+        while a reader adopt-loops — every adoption must observe a
+        complete, internally-consistent generation (the per-response
+        oracle: coef all-ones AND an integer intercept the writer
+        actually wrote)."""
+        path = str(tmp_path / "gen.npz")
+        writer = FitCheckpoint(path, keep=2)
+        reader = FitCheckpoint(path, keep=2)
+        n_gens = 25
+        stop = threading.Event()
+
+        def write():
+            for g in range(1, n_gens + 1):
+                writer.save(_linreg_state(g))
+            stop.set()
+
+        t = threading.Thread(target=write)
+        t.start()
+        seen = []
+        last = None
+        try:
+            while not stop.is_set() or not seen:
+                ad = adopt_latest(reader, _build_linreg, last_token=last)
+                if ad is None:
+                    continue
+                last = ad.token
+                assert np.array_equal(ad.state["coef"],
+                                      np.ones((NF, 1), np.float32)), \
+                    "torn generation: coef not the written value"
+                g = float(ad.state["intercept"][0])
+                assert g == int(g) and 1 <= g <= n_gens, \
+                    f"torn generation: intercept {g}"
+                seen.append(g)
+        finally:
+            t.join()
+        assert seen == sorted(seen), "adoptions went backwards"
+
+    def test_live_reader_never_misreads_rotation_as_corruption(
+            self, tmp_path):
+        """Verify-drive regression: a reader polling a LIVE checkpoint
+        can hit the rotation gap (path renamed away between exists() and
+        open()).  That transient FileNotFoundError must read as "try the
+        next generation", NOT as corruption — the corrupt-fallback
+        warning path would misdiagnose (and its cleanup could delete a
+        racing writer's brand-new generation)."""
+        path = str(tmp_path / "gen.npz")
+        w = FitCheckpoint(path, keep=2)
+        w.save(_linreg_state(1))
+        reader = FitCheckpoint(path, keep=2)
+        stop = threading.Event()
+
+        def churn():
+            g = 2
+            while not stop.is_set():
+                w.save(_linreg_state(g))
+                g += 1
+
+        t = threading.Thread(target=churn)
+        t.start()
+        try:
+            with warnings.catch_warnings():
+                # ANY corrupt-fallback warning under pure rotation churn
+                # is the misdiagnosis this test pins
+                warnings.simplefilter("error", RuntimeWarning)
+                end = time.time() + 1.5
+                while time.time() < end:
+                    state = reader.load()
+                    assert state is not None
+                    g = float(state["intercept"][0])
+                    assert g == int(g) and g >= 1
+        finally:
+            stop.set()
+            t.join()
+
+    def test_pool_swaps_skips_unhealthy_and_survives_corruption(
+            self, tmp_path):
+        path = str(tmp_path / "gen.npz")
+        writer = FitCheckpoint(path, keep=2)
+        pool = ModelPool(FitCheckpoint(path, keep=2), _build_linreg,
+                         buckets=BUCKETS, poll_interval_s=0.0)
+        writer.save(_linreg_state(1))
+        assert pool.poll(force=True)
+        rows = ctx()["x"][:4]
+
+        def served_gen():
+            _, pipe = pool.current()
+            return _gen_of(pipe.predict_bucket(rows, 8), rows)
+
+        assert served_gen() == 1.0
+        # unhealthy generation: health gate refuses, old gen stays live
+        writer.save({"coef": np.full((NF, 1), np.nan, np.float32),
+                     "intercept": np.zeros(1, np.float32)})
+        assert not pool.poll(force=True)
+        assert pool.rejections == 1 and served_gen() == 1.0
+        # a rejected token is remembered — no re-gating storm
+        assert not pool.poll(force=True)
+        assert pool.rejections == 1
+        # a good successor adopts
+        writer.save(_linreg_state(3))
+        assert pool.poll(force=True)
+        assert served_gen() == 3.0
+        # corrupt the newest file (PR-1 injector): the verified load falls
+        # back to the previous good generation instead of serving garbage
+        with pytest.warns(RuntimeWarning):
+            writer.save(_linreg_state(4))
+            corrupt_snapshot(path)
+            pool.poll(force=True)
+        g = served_gen()
+        assert g in (3.0, 4.0) and g == int(g)   # SOME complete generation
+        assert np.all(np.isfinite(pool.current()[1].predict_bucket(rows, 8)))
+
+    def test_server_over_pool_serves_across_swaps(self, tmp_path):
+        path = str(tmp_path / "gen.npz")
+        writer = FitCheckpoint(path, keep=2)
+        writer.save(_linreg_state(1))
+        pool = ModelPool(FitCheckpoint(path, keep=2), _build_linreg,
+                         buckets=BUCKETS, poll_interval_s=0.0)
+        rows = ctx()["x"][:4]
+        with PredictServer(pool=pool, deadline_ms=1) as srv:
+            r1 = srv.submit(rows).result(timeout=30)
+            assert _gen_of(r1.values, rows) == 1.0
+            writer.save(_linreg_state(2))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                r = srv.submit(rows).result(timeout=30)
+                assert _gen_of(r.values, rows) in (1.0, 2.0)
+                if r.generation != r1.generation:
+                    break
+                time.sleep(0.005)
+            assert r.generation != r1.generation, "swap never served"
+            assert _gen_of(r.values, rows) == 2.0
+            assert srv.stats()["swaps"] == 2    # initial adoption + swap
+
+
+# ---------------------------------------------------------------------------
+# adoption-gate lint: serving may only reach checkpoints via the gate
+# ---------------------------------------------------------------------------
+
+SERVING_DIR = "dislib_tpu/serving"
+ADOPTION = "dislib_tpu/runtime/adoption.py"
+
+# raw snapshot-read spellings forbidden anywhere under serving/ — every
+# model read must flow through runtime.adoption.adopt_latest (checksum
+# verify + health-gated warmup), the read-side analog of the PR-3
+# "writes go through guard.save_async" lint
+_FORBIDDEN_ATTR_CALLS = ("load",)
+_FORBIDDEN_NP_CALLS = ("load", "savez")
+
+
+def _serving_files():
+    d = os.path.join(REPO, SERVING_DIR)
+    for fn in sorted(os.listdir(d)):
+        if fn.endswith(".py"):
+            yield f"{SERVING_DIR}/{fn}", os.path.join(d, fn)
+
+
+class TestAdoptionGateLint:
+    def test_serving_never_reads_snapshots_directly(self):
+        offenders = []
+        for rel, full in _serving_files():
+            tree = ast.parse(open(full, encoding="utf-8").read())
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr in _FORBIDDEN_ATTR_CALLS:
+                        offenders.append(f"{rel}:{node.lineno}: .{f.attr}()")
+                    elif isinstance(f.value, ast.Name) \
+                            and f.value.id in ("np", "numpy", "zipfile") \
+                            and f.attr in _FORBIDDEN_NP_CALLS:
+                        offenders.append(
+                            f"{rel}:{node.lineno}: {f.value.id}.{f.attr}()")
+                elif isinstance(f, ast.Name) and f.id == "open":
+                    offenders.append(f"{rel}:{node.lineno}: open()")
+        assert not offenders, (
+            "serving code reading checkpoint/model state around the "
+            "adoption gate — route it through runtime.adoption."
+            "adopt_latest:\n  " + "\n  ".join(offenders))
+
+    def test_serving_imports_the_gate(self):
+        src = open(os.path.join(REPO, SERVING_DIR, "hotswap.py"),
+                   encoding="utf-8").read()
+        assert "adopt_latest" in src, \
+            "hotswap no longer routes through runtime.adoption"
+
+    def test_adoption_module_uses_verified_load_and_probe_gate(self):
+        """The gate itself must (1) read via checkpoint.load() — the
+        checksum-verified, fallback-capable reader — and (2) judge the
+        probe output through the health layer before returning."""
+        tree = ast.parse(open(os.path.join(REPO, ADOPTION),
+                              encoding="utf-8").read())
+        fn = next(n for n in ast.walk(tree)
+                  if isinstance(n, ast.FunctionDef)
+                  and n.name == "adopt_latest")
+        calls = [n.func for n in ast.walk(fn) if isinstance(n, ast.Call)]
+        attrs = {f.attr for f in calls if isinstance(f, ast.Attribute)}
+        assert "load" in attrs, "adopt_latest no longer calls " \
+            "checkpoint.load() (the verified reader)"
+        assert "check_host" in attrs, "adopt_latest dropped the health " \
+            "gate on the warmup probe"
+        # and no raw np.load / _load_verified bypass
+        names = {f.attr for f in calls if isinstance(f, ast.Attribute)
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id in ("np", "numpy")}
+        assert "load" not in names

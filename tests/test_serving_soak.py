@@ -1,0 +1,128 @@
+"""Serving soak (slow tier, `tools/serving_soak.sh`): a sustained
+concurrent request stream across live generation hot-swaps.
+
+Invariants asserted over the whole run (the round-9 acceptance bar):
+
+- zero failed requests — every submitted request resolves to a response;
+- zero TORN responses — each response decodes to exactly ONE generation
+  the writer actually wrote (the linear-model oracle: ŷ − Σx == g);
+- zero stale-after-adoption responses — per client, the served
+  generation never goes backwards;
+- ≥ 2 swaps observed under load, one-dispatch warm batches throughout,
+  and a mid-stream corruption of the newest generation file neither
+  fails a request nor serves garbage.
+
+Knobs: DSLIB_SOAK_GENS (default 6), DSLIB_SOAK_CLIENTS (3),
+DSLIB_SOAK_SECONDS (6).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.serving import ModelPool, PredictServer, ServePipeline
+from dislib_tpu.utils.checkpoint import FitCheckpoint
+from dislib_tpu.utils.faults import corrupt_snapshot
+
+NF = 8
+BUCKETS = (1, 8, 64)
+
+
+def _state(g):
+    return {"coef": np.ones((NF, 1), np.float32),
+            "intercept": np.full(1, float(g), np.float32)}
+
+
+def _build(state):
+    lr = ds.LinearRegression()
+    lr.coef_ = np.asarray(state["coef"], np.float32)
+    lr.intercept_ = np.asarray(state["intercept"], np.float32)
+    return ServePipeline(lr, n_features=NF)
+
+
+@pytest.mark.slow
+def test_serving_soak_across_hot_swaps(tmp_path):
+    n_gens = int(os.environ.get("DSLIB_SOAK_GENS", "6"))
+    n_clients = int(os.environ.get("DSLIB_SOAK_CLIENTS", "3"))
+    seconds = float(os.environ.get("DSLIB_SOAK_SECONDS", "6"))
+    path = str(tmp_path / "gen.npz")
+    writer = FitCheckpoint(path, keep=2)
+    writer.save(_state(1))
+    pool = ModelPool(FitCheckpoint(path, keep=2), _build,
+                     buckets=BUCKETS, poll_interval_s=0.02)
+    rng = np.random.RandomState(0)
+    x = rng.rand(4096, NF).astype(np.float32)
+    written = [1.0]
+    stop = threading.Event()
+    errors = []
+
+    def trainer():
+        """Rotate generations (keep=2) under the live stream; one of the
+        rotations is immediately corrupted — the PR-1 injector — so the
+        soak also covers the verified-load fallback path."""
+        gap = seconds / (n_gens + 1)
+        for g in range(2, n_gens + 2):
+            if stop.wait(gap):
+                return
+            writer.save(_state(g))
+            written.append(float(g))
+            if g == 3:
+                corrupt_snapshot(path)
+
+    def client(cid, srv, seen):
+        crng = np.random.RandomState(cid)
+        last_gen_val = 0.0
+        while not stop.is_set():
+            k = int(crng.randint(1, 9))
+            start = int(crng.randint(0, len(x) - k))
+            rows = x[start:start + k]
+            try:
+                r = srv.submit(rows).result(timeout=60)
+            except Exception as e:  # noqa: BLE001 — any failure fails soak
+                errors.append(f"client {cid}: {type(e).__name__}: {e}")
+                return
+            vals = np.round(r.values.ravel() - rows.sum(axis=1), 3)
+            gens = np.unique(vals)
+            if len(gens) != 1:
+                errors.append(f"client {cid}: TORN response {gens}")
+                return
+            g = float(gens[0])
+            if g != int(g):
+                errors.append(f"client {cid}: non-generation value {g}")
+                return
+            if g < last_gen_val:
+                errors.append(f"client {cid}: stale after adoption "
+                              f"({g} after {last_gen_val})")
+                return
+            last_gen_val = g
+            seen.add(g)
+
+    with PredictServer(pool=pool, deadline_ms=2) as srv:
+        seen_sets = [set() for _ in range(n_clients)]
+        threads = [threading.Thread(target=client, args=(i, srv, s))
+                   for i, s in enumerate(seen_sets)]
+        tr = threading.Thread(target=trainer)
+        for t in threads:
+            t.start()
+        tr.start()
+        time.sleep(seconds)
+        stop.set()
+        tr.join()
+        for t in threads:
+            t.join()
+        stats = srv.stats()
+
+    assert not errors, "soak failures:\n  " + "\n  ".join(errors)
+    seen = set().union(*seen_sets)
+    assert seen <= set(written), f"served generations {seen} " \
+        f"never written {written}"
+    assert pool.adoptions >= 3, (  # initial + >=2 swaps under load
+        f"only {pool.adoptions} adoptions in {seconds}s "
+        f"(stats: {stats}, pool: {pool.stats()})")
+    assert len(seen) >= 3, f"request stream only saw generations {seen}"
+    assert stats["dispatches_per_batch_max"] == 1, stats
+    assert stats["requests"] > 50, stats

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Serving soak: a sustained concurrent request stream across live
+# checkpoint hot-swaps (slow tier — excluded from tier-1; the fast
+# handoff coverage lives in tests/test_serving.py).
+#
+#   tools/serving_soak.sh [GENS] [SECONDS] [CLIENTS]
+#
+# Asserted invariants (see tests/test_serving_soak.py): zero failed
+# requests, zero torn responses, zero stale-after-adoption responses,
+# >= 2 swaps under load, one fused dispatch per warm batch, and a
+# mid-stream corrupted generation neither failing a request nor serving
+# garbage.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export DSLIB_SOAK_GENS="${1:-6}"
+export DSLIB_SOAK_SECONDS="${2:-6}"
+export DSLIB_SOAK_CLIENTS="${3:-3}"
+
+exec env JAX_PLATFORMS=cpu python -m pytest tests/test_serving_soak.py \
+    -q -m slow -p no:cacheprovider -rs
