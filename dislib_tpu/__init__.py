@@ -59,7 +59,7 @@ from dislib_tpu.data.io import (
     QuarantineReport, last_quarantine_report,
 )
 from dislib_tpu.data.sparse import SparseArray
-from dislib_tpu.math import matmul, kron, svd, qr
+from dislib_tpu.math import matmul, kron, svd, qr, polar
 from dislib_tpu.decomposition import tsqr, random_svd, lanczos_svd, PCA
 from dislib_tpu.utils.base import shuffle, train_test_split
 from dislib_tpu.utils.saving import save_model, load_model
@@ -97,7 +97,7 @@ __all__ = [
     "eye", "apply_along_axis", "concat_rows", "concat_cols", "SparseArray",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
     "save_txt",
-    "matmul", "kron", "svd", "qr",
+    "matmul", "kron", "svd", "qr", "polar",
     "tsqr", "random_svd", "lanczos_svd", "PCA",
     "shuffle", "train_test_split", "save_model", "load_model",
     "KMeans", "GaussianMixture", "DBSCAN", "Daura",

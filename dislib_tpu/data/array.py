@@ -211,14 +211,18 @@ def _place_region(v, pshape):
     return lax.with_sharding_constraint(v, _mesh.data_sharding())
 
 
-def _matmul_body(a, b, ta, tb):
+def _matmul_body(a, b, ta, tb, policy=None):
     """The ONE GEMM body shared by the eager `math.matmul` kernel and the
-    fused "matmul" instruction (zero padding ⇒ padded == logical dot)."""
+    fused "matmul" instruction (zero padding ⇒ padded == logical dot).
+    ``policy`` is a precision policy (None → float32-faithful): the
+    contraction runs at the policy's compute dtype with f32 accumulation
+    (`ops/precision.pdot`)."""
+    from dislib_tpu.ops import precision as px
     if ta:
         a = a.T
     if tb:
         b = b.T
-    out = jnp.dot(a, b, preferred_element_type=jnp.float32)
+    out = px.pdot(a, b, policy if policy is not None else px.FLOAT32)
     return lax.with_sharding_constraint(out, _mesh.data_sharding())
 
 
@@ -250,7 +254,8 @@ def _instr_reduce(static, a):
 
 
 def _instr_matmul(static, a, b):
-    ta, tb = static
+    ta, tb, policy_name = static
+    from dislib_tpu.ops import precision as px
     inner_a = a.shape[0] if ta else a.shape[1]
     inner_b = b.shape[1] if tb else b.shape[0]
     pad_to = max(inner_a, inner_b)
@@ -260,7 +265,7 @@ def _instr_matmul(static, a, b):
     if inner_b < pad_to:
         grow = pad_to - inner_b
         b = jnp.pad(b, ((0, 0), (0, grow)) if tb else ((0, grow), (0, 0)))
-    return _matmul_body(a, b, ta, tb)
+    return _matmul_body(a, b, ta, tb, px.of_name(policy_name))
 
 
 def _instr_dist(static, a, b):

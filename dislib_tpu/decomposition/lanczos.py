@@ -15,33 +15,41 @@ import jax.numpy as jnp
 from jax import lax
 
 from dislib_tpu.data.array import Array
+from dislib_tpu.ops import precision as px
 
 
 def lanczos_svd(a: Array, k: int = 6, bs: int | None = None, rank: int | None = None,
                 num_iterations: int | None = None, tol: float = 1e-8,
                 epsilon: float | None = None, max_num_iterations: int | None = None,
                 singular_values: int | None = None, random_state=None,
-                verbose: bool = False):
+                verbose: bool = False, precision=None):
     """Truncated SVD via Golub–Kahan–Lanczos bidiagonalisation.
 
     Returns (U, S, V): U (m, k), S (1, k), V (n, k).  ``singular_values`` /
     ``rank`` are reference-parity aliases for ``k``.
+
+    ``precision``: mixed-precision policy (None → the
+    ``DSLIB_MATMUL_PRECISION`` default) for the A·v / Aᵀ·u products (the
+    O(mn) work per step); reorthogonalisation and the bidiagonal solve
+    stay float32 — bounds in ``ops/precision.ERROR_BOUNDS``.
     """
+    policy = px.resolve(precision)
     k = singular_values or rank or k
     m, n = a.shape
     steps = min(num_iterations or max(2 * k, k + 8), min(m, n))
     # run on the padded sharded backing (pad rows/cols are zero, so GEMVs
     # are exact and the operand never gathers; the Lanczos vector v is
     # masked once at init and its pad entries stay exactly zero)
-    u, s, v = _gkl(a._data.astype(jnp.float32), n, steps,
-                   jnp.uint32(0 if random_state is None else random_state))
+    u, s, v = _gkl(px.f32(a._data), n, steps,
+                   jnp.uint32(0 if random_state is None else random_state),
+                   policy)
     return (Array._from_logical(u[:m, :k]),
             Array._from_logical(s[:k].reshape(1, -1)),
             Array._from_logical(v[:n, :k]))
 
 
-@partial(jax.jit, static_argnames=("n_valid", "steps"))
-def _gkl(a, n_valid, steps, seed):
+@partial(jax.jit, static_argnames=("n_valid", "steps", "policy"))
+def _gkl(a, n_valid, steps, seed, policy=px.FLOAT32):
     m, n = a.shape
     key = jax.random.PRNGKey(seed)
     v0 = jax.random.normal(key, (n,), dtype=jnp.float32)
@@ -51,7 +59,7 @@ def _gkl(a, n_valid, steps, seed):
     def body(j, carry):
         vs, us, alphas, betas, v, u, beta = carry
         vs = vs.at[:, j].set(v)
-        u = a @ v - beta * u
+        u = px.pdot(a, v, policy) - beta * u
         # full reorthogonalisation against previous U (unfilled cols are
         # zero and contribute nothing)
         u = u - us @ (us.T @ u)
@@ -60,7 +68,7 @@ def _gkl(a, n_valid, steps, seed):
         us = us.at[:, j].set(u)
         alphas = alphas.at[j].set(alpha)
 
-        w = a.T @ u - alpha * v
+        w = px.pdot(a.T, u, policy) - alpha * v
         w = w - vs @ (vs.T @ w)
         beta = jnp.linalg.norm(w)
         betas = betas.at[j].set(beta)

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array
+from dislib_tpu.ops import precision as px
 from dislib_tpu.ops.base import precise
 
 
@@ -29,6 +30,9 @@ class PCA(BaseEstimator):
     arity : int — accepted for reference API parity; ignored (reduction
         topology is XLA's).
     method : 'eig' | 'svd' — covariance+eigh path or SVD path.
+    precision : mixed-precision policy for the scatter-matrix GEMM (the
+        O(mn²) work); None → the ``DSLIB_MATMUL_PRECISION`` default.  The
+        (n, n) eigh/SVD stays float32.
 
     Attributes
     ----------
@@ -37,11 +41,13 @@ class PCA(BaseEstimator):
     mean_ : Array (1, n_features)
     """
 
-    def __init__(self, n_components=None, arity=50, method="eig", eps=1e-9):
+    def __init__(self, n_components=None, arity=50, method="eig", eps=1e-9,
+                 precision=None):
         self.n_components = n_components
         self.arity = arity
         self.method = method
         self.eps = eps
+        self.precision = precision
 
     def fit(self, x: Array, y=None):
         m, n = x.shape
@@ -49,7 +55,8 @@ class PCA(BaseEstimator):
         if self.method not in ("eig", "svd"):
             raise ValueError(f"unknown method {self.method!r}")
         xv = x._data  # padded; zero rows don't perturb sums
-        mean, comps, var = _pca_fit(xv, x.shape, self.method == "svd")
+        mean, comps, var = _pca_fit(xv, x.shape, self.method == "svd",
+                                    px.resolve(self.precision))
         self.mean_ = Array._from_logical(mean.reshape(1, -1))
         self.components_ = Array._from_logical(comps[:k])
         self.explained_variance_ = Array._from_logical(var[:k].reshape(1, -1))
@@ -71,16 +78,16 @@ class PCA(BaseEstimator):
 from functools import partial
 
 
-@partial(jax.jit, static_argnames=("shape", "use_svd"))
+@partial(jax.jit, static_argnames=("shape", "use_svd", "policy"))
 @precise
-def _pca_fit(xp, shape, use_svd):
+def _pca_fit(xp, shape, use_svd, policy=px.FLOAT32):
     m, n = shape
     xv = xp[:, :n]  # crop cols; padded rows are zero
     total = jnp.sum(xv, axis=0)
     mean = total / m
     # centered scatter without materialising centered X for padded rows:
     # Σ (x-μ)(x-μ)ᵀ over logical rows = XᵀX - m μμᵀ   (padded zero rows add 0 to XᵀX)
-    scatter = xv.T @ xv - m * jnp.outer(mean, mean)
+    scatter = px.pdot(xv.T, xv, policy) - m * jnp.outer(mean, mean)
     cov = scatter / (m - 1)
     if use_svd:
         # SVD of covariance (symmetric PSD): singular values = eigenvalues

@@ -30,17 +30,27 @@ from dislib_tpu.math import matmul
 from dislib_tpu.decomposition.tsqr import (tsqr, _tsqr_shardmap,
                                            _use_cholqr)
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops import precision as px
 from dislib_tpu.ops.base import precise
 
 
 def random_svd(a: Array, iters: int = 2, epsilon: float | None = None,
                tol: float = 1e-3, nsv: int | None = None, k: int | None = None,
-               oversample: int = 10, random_state=None, verbose: bool = False):
+               oversample: int = 10, random_state=None, verbose: bool = False,
+               precision=None):
     """Truncated randomized SVD of ``a``.
 
     Returns (U, S, V) with U (m, k), S (1, k), V (n, k); ``k`` defaults to
     ``nsv`` (number of singular values) + oversampling, truncated to nsv.
+
+    ``precision``: mixed-precision policy (None → the
+    ``DSLIB_MATMUL_PRECISION`` default).  The policy governs the sketch /
+    power-iteration / projection / back-multiplication GEMMs (all the
+    O(mn·sketch) FLOPs); the tsQR re-orthonormalisations and the small
+    (sketch, n) SVD stay float32 — bounds in
+    ``ops/precision.ERROR_BOUNDS``.
     """
+    policy = px.resolve(precision)
     m, n = a.shape
     nsv = nsv if nsv is not None else (k if k is not None else min(m, n, 6))
     sketch = min(n, nsv + oversample)
@@ -55,25 +65,32 @@ def random_svd(a: Array, iters: int = 2, epsilon: float | None = None,
         p = mesh.shape[_mesh.ROWS]
         u_log, s, vt = _random_svd_fused(
             a._data, jax.random.PRNGKey(seed), a.shape, iters, sketch,
-            nsv, mesh, p, cholqr=_use_cholqr())
+            nsv, mesh, p, cholqr=_use_cholqr(), policy=policy)
         u = Array._from_logical_padded(_repad(u_log, (m, nsv)), (m, nsv))
         v = Array._from_logical(vt.T[:, :nsv])
         return u, Array._from_logical(s[:nsv].reshape(1, -1)), v
 
     omega = Array._from_logical(_omega_of(jax.random.PRNGKey(seed), n, sketch))
 
-    y = matmul(a, omega)                     # (m, sketch) sharded GEMM
-    q, _ = tsqr(y) if m >= sketch else _qr_fallback(y)
+    # the orthonormalisations are PINNED f32 (matching the fused path and
+    # the docstring contract) — explicitly, so an ambient
+    # DSLIB_MATMUL_PRECISION can never leak into them when the caller
+    # asked for float32 (review-found env-leak)
+    y = matmul(a, omega, precision=policy)   # (m, sketch) sharded GEMM
+    q, _ = tsqr(y, precision=px.FLOAT32) if m >= sketch else _qr_fallback(y)
     for _ in range(iters):
-        z = matmul(a, q, transpose_a=True)   # (n, sketch)
-        qz, _ = tsqr(z) if n >= sketch else _qr_fallback(z)
-        y = matmul(a, qz)
-        q, _ = tsqr(y) if m >= sketch else _qr_fallback(y)
+        z = matmul(a, q, transpose_a=True, precision=policy)   # (n, sketch)
+        qz, _ = tsqr(z, precision=px.FLOAT32) if n >= sketch \
+            else _qr_fallback(z)
+        y = matmul(a, qz, precision=policy)
+        q, _ = tsqr(y, precision=px.FLOAT32) if m >= sketch \
+            else _qr_fallback(y)
 
-    b = matmul(q, a, transpose_a=True)       # (sketch, n) small projected matrix
+    b = matmul(q, a, transpose_a=True,
+               precision=policy)             # (sketch, n) small projected matrix
     bv = b._data[: b.shape[0], : b.shape[1]]
     ub, s, vt = jnp.linalg.svd(bv, full_matrices=False)
-    u = matmul(q, Array._from_logical(ub))
+    u = matmul(q, Array._from_logical(ub), precision=policy)
     u = u[:, :nsv]
     v = Array._from_logical(vt.T[:, :nsv])
     s_arr = Array._from_logical(s[:nsv].reshape(1, -1))
@@ -81,11 +98,11 @@ def random_svd(a: Array, iters: int = 2, epsilon: float | None = None,
 
 
 @partial(jax.jit, static_argnames=("a_shape", "iters", "sketch", "nsv",
-                                   "cholqr",
+                                   "cholqr", "policy",
                                    "mesh", "p"))
 @precise
 def _random_svd_fused(a_pad, key, a_shape, iters, sketch, nsv, mesh, p,
-                      *, cholqr):
+                      *, cholqr, policy=px.FLOAT32):
     """Sketch + power iterations + projection + SVD as one XLA program.
 
     Quantum-padded rows/cols of ``a_pad`` are zero, so they contribute
@@ -93,7 +110,7 @@ def _random_svd_fused(a_pad, key, a_shape, iters, sketch, nsv, mesh, p,
     full-column-rank sketch (Q_i R = 0 with R invertible ⇒ Q_i = 0), which
     keeps the returned U's logical crop exact."""
     m, n = a_shape
-    av = a_pad[:, :n].astype(jnp.float32)
+    av = px.f32(a_pad[:, :n])
     av = lax.with_sharding_constraint(av, _mesh.row_sharding())
 
     def ortho(y):
@@ -106,14 +123,14 @@ def _random_svd_fused(a_pad, key, a_shape, iters, sketch, nsv, mesh, p,
         q, _ = _tsqr_shardmap(y, mesh, p, cholqr=cholqr)
         return q[:rows]
 
-    q = ortho(av @ _omega_of(key, n, sketch))
+    q = ortho(px.pdot(av, _omega_of(key, n, sketch), policy))
     for _ in range(iters):
-        qz = ortho(av.T @ q)
-        q = ortho(av @ qz)
+        qz = ortho(px.pdot(av.T, q, policy))
+        q = ortho(px.pdot(av, qz, policy))
 
-    b = q.T @ av                             # (sketch, n), replicated
+    b = px.pdot(q.T, av, policy)             # (sketch, n), replicated
     ub, s, vt = jnp.linalg.svd(b, full_matrices=False)
-    u = q @ ub[:, :nsv]                      # (M_pad, nsv)
+    u = px.pdot(q, ub[:, :nsv], policy)      # (M_pad, nsv)
     return u[:m], s, vt
 
 
@@ -125,4 +142,5 @@ def _omega_of(key, n, sketch):
 
 def _qr_fallback(y: Array):
     from dislib_tpu.math.qr import qr as _qr
-    return _qr(y, mode="economic")
+    # pinned f32 like the tsqr orthonormalisations (env must not leak in)
+    return _qr(y, mode="economic", precision=px.FLOAT32)

@@ -26,31 +26,40 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dislib_tpu.data.array import Array
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops import precision as px
 from dislib_tpu.ops.base import precise
 
 
-def tsqr(a: Array, mode: str = "reduced", indexes=None):
+def tsqr(a: Array, mode: str = "reduced", indexes=None, precision=None):
     """Tall-skinny QR.
 
     mode='reduced' → (Q (m,n), R (n,n));  mode='r' → R only.
     ``indexes`` (reference parity): restrict the returned Q to these column
     indices after factorisation.
+
+    ``precision``: mixed-precision policy (None → the
+    ``DSLIB_MATMUL_PRECISION`` default).  The policy governs the Q
+    assembly/application GEMMs (the FLOP-dominant tall products); the
+    local panel factorisations and the R-stack merge stay float32 —
+    bounds in ``ops/precision.ERROR_BOUNDS``.
     """
     if mode not in ("reduced", "r"):
         raise ValueError(f"unsupported mode {mode!r}")
+    policy = px.resolve(precision)
     m, n = a.shape
     if m < n:
         raise ValueError("tsqr requires a tall-skinny array (m >= n)")
     mesh = _mesh.get_mesh()
     p = mesh.shape[_mesh.ROWS]
-    av = a._data[:, :n].astype(jnp.float32)  # keep padded rows (zeros), crop cols
+    av = px.f32(a._data[:, :n])  # keep padded rows (zeros), crop cols
     # each shard must be at least n tall for its local R to be (n, n);
     # grow with zero rows if not (zero rows leave Q's logical rows and R exact)
     if av.shape[0] // p < n:
         extra = p * n - av.shape[0]
         av = jnp.pad(av, ((0, extra), (0, 0)))
         av = jax.device_put(av, _mesh.row_sharding())
-    q_pad, r = _tsqr_shardmap(av, mesh, p, cholqr=_use_cholqr())
+    q_pad, r = _tsqr_shardmap(av, mesh, p, cholqr=_use_cholqr(),
+                              policy=policy)
     if mode == "r":
         return Array._from_logical(r)
     q = Array._from_logical_padded(_col_repad(q_pad), (m, n), a._reg_shape)
@@ -110,20 +119,22 @@ def _cholqr2(a):
     return q2, r, ok
 
 
-def _local_qr(a, cholqr):
+def _local_qr(a, cholqr, policy=px.FLOAT32):
     """Shard-local tall-skinny QR: CholeskyQR2 when ``cholqr`` (with an
     in-program fallback to the Householder tree on Cholesky breakdown),
     the batched Householder reduction tree otherwise.  ``cholqr`` is a
     trace-time static (threaded from `_use_cholqr()` through the jit cache
-    key, so flipping the env var retraces instead of being ignored)."""
+    key, so flipping the env var retraces instead of being ignored).
+    ``policy`` governs only the reduction tree's batched Q-apply GEMMs;
+    the Householder/Cholesky factorisations themselves are pinned f32."""
     if not cholqr:
-        return _local_tsqr(a)
+        return _local_tsqr(a, policy)
     q_c, r_c, ok = _cholqr2(a)
     # tuple(): jnp.linalg.qr yields a QRResult NamedTuple — a different
     # pytree type than the true branch's plain tuple
     return lax.cond(ok,
                     lambda op: (q_c, r_c),
-                    lambda op: tuple(_local_tsqr(op)),
+                    lambda op: tuple(_local_tsqr(op, policy)),
                     a)
 
 
@@ -135,7 +146,7 @@ def _split_count(rows: int, n: int, target: int = 8) -> int:
     return s
 
 
-def _local_tsqr(a):
+def _local_tsqr(a, policy=px.FLOAT32):
     """Shard-LOCAL tall-skinny QR as a batched reduction tree.
 
     A single Householder QR of an (M, n) panel is a column-sequential
@@ -155,14 +166,14 @@ def _local_tsqr(a):
     if s == 1:
         return jnp.linalg.qr(a, mode="reduced")
     q0, r0 = jnp.linalg.qr(a.reshape(s, rows // s, n), mode="reduced")
-    q1, r = _local_tsqr(r0.reshape(s * n, n))
-    q = q0 @ q1.reshape(s, n, n)                             # batched GEMM
+    q1, r = _local_tsqr(r0.reshape(s * n, n), policy)
+    q = px.pdot(q0, q1.reshape(s, n, n), policy)             # batched GEMM
     return q.reshape(rows, n), r
 
 
-@partial(jax.jit, static_argnames=("mesh", "p", "cholqr"))
+@partial(jax.jit, static_argnames=("mesh", "p", "cholqr", "policy"))
 @precise
-def _tsqr_shardmap(av, mesh, p, *, cholqr):
+def _tsqr_shardmap(av, mesh, p, *, cholqr, policy=px.FLOAT32):
     """``cholqr`` is REQUIRED (no default): every caller must resolve
     `_use_cholqr()` at its own trace boundary and thread it through its
     jit cache key, otherwise an env flip after the first trace would be
@@ -170,10 +181,10 @@ def _tsqr_shardmap(av, mesh, p, *, cholqr):
     n = av.shape[1]
 
     def local(a_shard):
-        q1, r1 = _local_qr(a_shard, cholqr)                  # (m/p, n), (n, n)
+        q1, r1 = _local_qr(a_shard, cholqr, policy)          # (m/p, n), (n, n)
         r_stack = lax.all_gather(r1, _mesh.ROWS)             # (p, n, n) — ICI
         r_stack = r_stack.reshape(p * n, n)
-        q2, r = _local_qr(r_stack, cholqr)                   # redundant per shard
+        q2, r = _local_qr(r_stack, cholqr, policy)           # redundant per shard
         idx = lax.axis_index(_mesh.ROWS)
         q2_i = lax.dynamic_slice(q2, (idx * n, 0), (n, n))
         # R is computed identically on every shard, but the static
@@ -182,7 +193,7 @@ def _tsqr_shardmap(av, mesh, p, *, cholqr):
         # (SURVEY §6 race-detection row: shard_map replication checking is
         # the collective-correctness sanitizer).  Cost: one (n, n) psum.
         r = lax.psum(r, _mesh.ROWS) / p
-        return q1 @ q2_i, r
+        return px.pdot(q1, q2_i, policy), r
 
     q, r = jax.shard_map(
         local, mesh=mesh,

@@ -1,4 +1,5 @@
 from dislib_tpu.math.base import matmul, kron, svd
+from dislib_tpu.math.polar import polar
 from dislib_tpu.math.qr import qr
 
-__all__ = ["matmul", "kron", "svd", "qr"]
+__all__ = ["matmul", "kron", "svd", "qr", "polar"]

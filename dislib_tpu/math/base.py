@@ -27,11 +27,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import os
+
 from dislib_tpu.data.array import (
     Array, _LazyExpr, _eager_mode, _lazy_array, _matmul_body, _repad,
 )
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops import precision as px
 from dislib_tpu.ops.base import precise
+from dislib_tpu.ops.summa import summa_matmul, summa_supported
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
 
@@ -39,23 +43,77 @@ from dislib_tpu.utils.profiling import profiled_jit as _pjit
 # matmul
 # ---------------------------------------------------------------------------
 
-@partial(_pjit, static_argnames=("ta", "tb", "a_shape", "b_shape"),
+@partial(_pjit, static_argnames=("ta", "tb", "a_shape", "b_shape", "policy"),
          name="matmul")
 @precise
-def _matmul_kernel(a, b, ta, tb, a_shape, b_shape):
+def _matmul_kernel(a, b, ta, tb, a_shape, b_shape, policy):
     del a_shape, b_shape
     # zero-padding invariant ⇒ padded contraction == logical contraction
-    return _matmul_body(a, b, ta, tb)
+    return _matmul_body(a, b, ta, tb, policy)
+
+
+# auto-SUMMA size gate: below this min logical dimension an explicit
+# panel schedule buys nothing over the partitioner's fused dot, and a
+# small product is usually mid-chain where leaving the fusion graph would
+# cost a whole extra dispatch (module-level so tests can shrink it)
+_SUMMA_MIN_DIM = 256
+
+
+def _pick_algorithm(algorithm, a, b, a_shape, b_shape, dense,
+                    transpose_a, transpose_b):
+    """The matmul routing rule: which schedule owns this product.
+
+    - explicit ``algorithm=`` wins; ``"auto"`` consults ``DSLIB_MATMUL_ALGO``
+      and then the mesh shape AND operand layout;
+    - ``"summa"`` = the explicit panel-broadcast schedule (``ops/summa``),
+      picked automatically on a genuinely 2-D mesh (both axes > 1) for
+      dense, untransposed, CONCRETE operands at paper-scale sizes (every
+      logical dim ≥ ``_SUMMA_MIN_DIM``) — a standalone big product.
+      Lazy (fusion-graph) operands stay on the XLA path under auto: the
+      PR-2/PR-4 one-dispatch-per-chain contracts hold on every mesh, and
+      routing a mid-chain GEMM to an eager kernel would force the chain
+      (review-found: estimator predict pipelines must not silently gain
+      dispatches when the mesh goes 2-D);
+    - ``"xla"`` = one sharded dot, schedule owned by the SPMD partitioner
+      (optimal on 1-D meshes, and a fusion-graph node).
+    """
+    if algorithm not in ("auto", "summa", "xla"):
+        raise ValueError(f"unknown matmul algorithm {algorithm!r}: "
+                         "expected 'auto', 'summa' or 'xla'")
+    if algorithm == "auto":
+        env = os.environ.get("DSLIB_MATMUL_ALGO", "auto")
+        if env not in ("auto", "summa", "xla"):
+            raise ValueError(f"bad DSLIB_MATMUL_ALGO={env!r}")
+        algorithm = env
+    if algorithm == "auto":
+        big = min(a_shape[0], a_shape[1], b_shape[1]) >= _SUMMA_MIN_DIM
+        standalone = dense and not (a.is_lazy or b.is_lazy)
+        return "summa" if (standalone and big and summa_supported()
+                           and not (transpose_a or transpose_b)) else "xla"
+    return algorithm
 
 
 def matmul(a: Array, b: Array, transpose_a: bool = False,
-           transpose_b: bool = False) -> Array:
+           transpose_b: bool = False, *, algorithm: str = "auto",
+           precision=None) -> Array:
     """Distributed GEMM (reference: dislib.math.matmul, `_multiply` task).
 
-    One XLA dot over the 2-D-sharded operands; the partitioner owns the
-    communication schedule the reference expressed as O(p^3) COMPSs tasks.
-    On dense ds-array operands this is a fusion-graph node: the dot joins
-    the operands' deferred chains and dispatches with the first force."""
+    One entry, two schedules, picked from the mesh shape (override with
+    ``algorithm=`` or ``DSLIB_MATMUL_ALGO``):
+
+    - 2-D mesh (both axes > 1): an explicit SUMMA panel-broadcast schedule
+      (``ops/summa``) — the arXiv:2112.09017 regime, one dispatch;
+    - 1-D mesh / single device: one XLA dot over the 2-D-sharded operands;
+      the partitioner owns the communication schedule the reference
+      expressed as O(p^3) COMPSs tasks.  On dense ds-array operands this
+      is a fusion-graph node: the dot joins the operands' deferred chains
+      and dispatches with the first force.
+
+    ``precision``: the mixed-precision policy (None → the
+    ``DSLIB_MATMUL_PRECISION`` default) — ``"bfloat16"`` contracts
+    bf16-compute / f32-accumulate with the documented error bounds
+    (``ops/precision.ERROR_BOUNDS``); the default is float32-faithful."""
+    policy = px.resolve(precision)
     a_shape = (a.shape[1], a.shape[0]) if transpose_a else a.shape
     b_shape = (b.shape[1], b.shape[0]) if transpose_b else b.shape
     if a_shape[1] != b_shape[0]:
@@ -64,17 +122,57 @@ def matmul(a: Array, b: Array, transpose_a: bool = False,
     reg = (a._reg_shape[1] if transpose_a else a._reg_shape[0],
            b._reg_shape[0] if transpose_b else b._reg_shape[1])
     dense = type(a) is Array and type(b) is Array
+    algo = _pick_algorithm(algorithm, a, b, a_shape, b_shape, dense,
+                           transpose_a, transpose_b)
+    if algo == "summa":
+        if not dense:
+            raise ValueError("algorithm='summa' needs dense ds-array "
+                             "operands")
+        return _matmul_summa(a, b, transpose_a, transpose_b, policy,
+                             out_shape, reg)
     if dense and not _eager_mode():
         pa, pb = a._pshape, b._pshape
         out_pshape = (pa[1] if transpose_a else pa[0],
                       pb[0] if transpose_b else pb[1])
         dtype = jnp.promote_types(jnp.promote_types(a.dtype, b.dtype),
                                   jnp.float32)
-        expr = _LazyExpr("matmul", (transpose_a, transpose_b),
+        expr = _LazyExpr("matmul", (transpose_a, transpose_b, policy.name),
                          (a._node(), b._node()), out_pshape, dtype)
         return _lazy_array(expr, out_shape, reg, False)
     # padded inner dims must agree for the padded dot; repad if quantum differs
     ad, bd = a._data, b._data
+    ad, bd = _match_inner(ad, bd, transpose_a, transpose_b)
+    out = _matmul_kernel(ad, bd, transpose_a, transpose_b, a_shape, b_shape,
+                         policy)
+    return Array(_crop_or_keep(out, out_shape), out_shape, reg, False)
+
+
+def _matmul_summa(a, b, transpose_a, transpose_b, policy, out_shape, reg):
+    """The SUMMA route: canonical (rows, cols)-sharded operands through the
+    explicit panel schedule.  Requested transposes materialise first (one
+    extra dispatch each — the auto policy never picks SUMMA for transposed
+    operands; an explicit ``algorithm='summa'`` accepts the cost)."""
+    if transpose_a:
+        a = a.transpose()
+    if transpose_b:
+        b = b.transpose()
+    ad, bd = a._data, b._data
+    # operands built under an OLDER mesh can carry a pad quantum the
+    # current grid doesn't divide — the panel loop would silently drop the
+    # K tail (and shard_map reject the row/col split); repad to the
+    # current quantum first
+    q = _mesh.pad_quantum()
+    if any(s % q for s in (*ad.shape, *bd.shape)):
+        ad = _repad(ad, a.shape)
+        bd = _repad(bd, b.shape)
+    ad, bd = _match_inner(ad, bd, False, False)
+    out = summa_matmul(ad, bd, _mesh.get_mesh(), policy)
+    return Array(_crop_or_keep(out, out_shape), out_shape, reg, False)
+
+
+def _match_inner(ad, bd, transpose_a, transpose_b):
+    """Equalize the padded contraction dims of the two backings (quantum
+    mismatch between operands built under different meshes/paddings)."""
     inner_a = ad.shape[0] if transpose_a else ad.shape[1]
     inner_b = bd.shape[1] if transpose_b else bd.shape[0]
     if inner_a != inner_b:
@@ -87,14 +185,34 @@ def matmul(a: Array, b: Array, transpose_a: bool = False,
             bd = _grow(bd, (bd.shape[0], pad_to))
         else:
             bd = _grow(bd, (pad_to, bd.shape[1]))
-    out = _matmul_kernel(ad, bd, transpose_a, transpose_b, a_shape, b_shape)
-    return Array(_crop_or_keep(out, out_shape), out_shape, reg, False)
+    return ad, bd
 
 
 def _grow(data, shape):
-    return jax.device_put(
-        jnp.pad(data, ((0, shape[0] - data.shape[0]), (0, shape[1] - data.shape[1]))),
-        _mesh.data_sharding())
+    """Host-level grow to a larger padded canvas: the traced zero-fill
+    core (:func:`grow_canvas`) + the canonical resharding device_put."""
+    return jax.device_put(grow_canvas(data, shape), _mesh.data_sharding())
+
+
+def grow_canvas(data, shape, valid=None):
+    """THE shared pad/crop-helper core (traced): place ``data`` on a zero
+    canvas of ``shape`` and — when ``valid`` = (rows, cols) is given —
+    re-zero everything outside the valid region.  Every blocked-linalg
+    kernel that grows an operand (blocked QR panels, block-Jacobi column
+    blocks, matmul quantum repads) routes through here so a padded tail
+    can never enter a reduced-precision accumulation as garbage: the
+    canvas is zero by construction and zero is exact in every policy
+    dtype (pinned by tests/test_precision.py)."""
+    grown = data
+    if tuple(data.shape) != tuple(shape):
+        canvas = jnp.zeros(shape, data.dtype)
+        grown = lax.dynamic_update_slice(
+            canvas, data[: shape[0], : shape[1]], (0, 0))
+    if valid is not None:
+        r = lax.broadcasted_iota(jnp.int32, grown.shape, 0) < valid[0]
+        c = lax.broadcasted_iota(jnp.int32, grown.shape, 1) < valid[1]
+        grown = jnp.where(r & c, grown, jnp.zeros((), grown.dtype))
+    return grown
 
 
 def _crop_or_keep(padded, logical_shape):
@@ -182,11 +300,21 @@ def svd(a: Array, compute_uv: bool = True, sort: bool = True,
             "clamping to 1e-6 (the 1e-9-style defaults presume float64 "
             "blocks)", RuntimeWarning, stacklevel=2)
     eps = max(float(eps), 1e-6)
-    if a._data.shape[1] >= 2 * _JACOBI_BLOCK:
-        u, s, v = _jacobi_svd_block(a._data.astype(jnp.float32), n, sort,
+    # shared pad/crop helper at ingest: re-assert the zero-pad invariant
+    # before ANY rotation math — a garbage padded tail would otherwise mix
+    # into valid columns through the pair rotations (and at reduced
+    # precision a large tail swamps small singular values outright);
+    # pinned by tests/test_precision.py::test_poisoned_pad_tail_cannot_leak
+    av = grow_canvas(px.f32(a._data), a._data.shape, valid=(m, n))
+    # the block tier factors (m, 2b) pair panels with a reduced QR — for
+    # m < 2b that QR is rank-limited and the pair update shapes collapse
+    # (found by the round-10 precision suite at (80, 130)); short-wide
+    # inputs take the scalar tier, which has no such constraint
+    if av.shape[1] >= 2 * _JACOBI_BLOCK and av.shape[0] >= 2 * _JACOBI_BLOCK:
+        u, s, v = _jacobi_svd_block(av, n, sort,
                                     eps, max_sweeps)
     else:
-        u, s, v = _jacobi_svd(a._data.astype(jnp.float32), n, sort, eps,
+        u, s, v = _jacobi_svd(av, n, sort, eps,
                               max_sweeps)
     s_arr = Array._from_logical(s[:n].reshape(1, -1))
     if not compute_uv:
@@ -292,7 +420,10 @@ def _jacobi_svd_block(a, n_valid, sort, eps, max_sweeps):
     b = _JACOBI_BLOCK
     nb = -(-n_in // b)
     n = nb * b
-    u0 = jnp.pad(a, ((0, 0), (0, n - n_in)))
+    # shared pad/crop helper: the grown column tail is zero BY CONSTRUCTION
+    # and columns ≥ n_valid are re-zeroed — a padded tail can never enter
+    # the rotation Grams as garbage (tests/test_precision.py pins this)
+    u0 = grow_canvas(a, (m, n), valid=(m, n_valid))
     col_ok0 = lax.broadcasted_iota(jnp.int32, (n,), 0) < n_valid
     v0 = jnp.eye(n, dtype=a.dtype) * col_ok0[None, :].astype(a.dtype)
     pairs = _round_robin_pairs(nb)            # (rounds, width, 2) block ids

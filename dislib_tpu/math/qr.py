@@ -41,6 +41,8 @@ from jax import lax
 from dislib_tpu.data.array import Array
 from dislib_tpu.decomposition.tsqr import (_tsqr_shardmap,
                                            _use_cholqr)
+from dislib_tpu.math.base import grow_canvas
+from dislib_tpu.ops import precision as px
 from dislib_tpu.ops.base import precise
 from dislib_tpu.parallel import mesh as _mesh
 
@@ -54,15 +56,24 @@ def _qr_kernel(a, mode, shape):
     return jnp.linalg.qr(a, mode=mode)
 
 
-def qr(a: Array, mode: str = "full", overwrite_a: bool = False):
+def qr(a: Array, mode: str = "full", overwrite_a: bool = False,
+       precision=None):
     """QR factorisation of a ds-array.
 
     mode='full':     returns (Q, R) with Q (m, m), R (m, n)
     mode='economic': returns (Q, R) with Q (m, k), R (k, n), k=min(m,n)
     mode='r':        returns R (k, n)
+
+    ``precision``: mixed-precision policy (None → the
+    ``DSLIB_MATMUL_PRECISION`` default).  The policy governs the blocked
+    path's FLOP-dominant GEMMs (re-orthogonalisation projections,
+    trailing updates); panel factorisations stay float32 — error bounds
+    in ``ops/precision.ERROR_BOUNDS``.  The small/short-wide fallback is
+    a native f32 Householder QR and ignores the policy.
     """
     if mode not in ("full", "economic", "r"):
         raise ValueError(f"unsupported mode {mode!r}")
+    policy = px.resolve(precision)
     m, n = a.shape
     mesh = _mesh.get_mesh()
     p = mesh.shape[_mesh.ROWS]
@@ -70,14 +81,14 @@ def qr(a: Array, mode: str = "full", overwrite_a: bool = False):
     blocked_ok = m >= n and n > _PANEL and mp // p >= _PANEL and mp % p == 0
     if mode in ("economic", "r") and blocked_ok:
         q_pad, r = _qr_blocked(a._data, (m, n), mesh, p, _PANEL,
-                            cholqr=_use_cholqr())
+                            cholqr=_use_cholqr(), policy=policy)
         if mode == "r":
             return Array._from_logical(r[:n, :n])
         return (Array._from_logical_padded(q_pad, (m, n), a._reg_shape),
                 Array._from_logical(r[:n, :n]))
     if mode == "full" and blocked_ok and m - n > _PANEL:
-        return _qr_full_distributed(a, m, n, mesh, p)
-    av = a._data[:m, :n].astype(jnp.float32)
+        return _qr_full_distributed(a, m, n, mesh, p, policy)
+    av = px.f32(a._data[:m, :n])
     if mode == "full":
         q, r = _qr_kernel(av, "complete", (m, n))
         return Array._from_logical(q), Array._from_logical(r)
@@ -87,27 +98,27 @@ def qr(a: Array, mode: str = "full", overwrite_a: bool = False):
     return Array._from_logical(q), Array._from_logical(r)
 
 
-def _qr_full_distributed(a: Array, m, n, mesh, p):
+def _qr_full_distributed(a: Array, m, n, mesh, p, policy=px.FLOAT32):
     """mode='full' without gathering: Q₁ from the economic panel loop, then
     an orthonormal complement Q₂ from a deterministic random block projected
     against Q₁ (twice) and blocked-QR-factored.  Everything row-sharded; the
     only replicated object is the (n, n) R.  Rank-deficient A carries the
     same conditioning caveat as the economic path (Gram–Schmidt panels)."""
     q1, r = _qr_blocked(a._data, (m, n), mesh, p, _PANEL,
-                            cholqr=_use_cholqr())
+                            cholqr=_use_cholqr(), policy=policy)
     k = m - n
-    g = _qr_complement_seed(q1, (m, n), k, mesh)
+    g = _qr_complement_seed(q1, (m, n), k, mesh, policy)
     q2, _ = _qr_blocked(g, (m, k), mesh, p, _PANEL,
-                         cholqr=_use_cholqr())
+                         cholqr=_use_cholqr(), policy=policy)
     q_full = jnp.concatenate([q1[:, :n], q2[:, :k]], axis=1)[:m]
     r_full = jnp.zeros((m, n), jnp.float32).at[:n, :n].set(r[:n, :n])
     return (Array._from_logical(q_full, a._reg_shape),
             Array._from_logical(r_full))
 
 
-@partial(jax.jit, static_argnames=("shape", "k", "mesh"))
+@partial(jax.jit, static_argnames=("shape", "k", "mesh", "policy"))
 @precise
-def _qr_complement_seed(q1, shape, k, mesh):
+def _qr_complement_seed(q1, shape, k, mesh, policy=px.FLOAT32):
     """Row-sharded (mp, k) Gaussian block orthogonal to q1's columns up to
     roundoff: two projection passes I − Q₁Q₁ᵀ ("twice is enough").  q1's
     padded columns (≥ n) are zero, so they drop out of the projections."""
@@ -118,14 +129,14 @@ def _qr_complement_seed(q1, shape, k, mesh):
     g = jnp.where(row < m, g, 0.0)
     g = lax.with_sharding_constraint(g, _mesh.row_sharding(mesh))
     for _ in range(2):
-        g = g - q1 @ (q1.T @ g)
+        g = g - px.pdot(q1, px.pdot(q1.T, g, policy), policy)
     return g
 
 
 @partial(jax.jit, static_argnames=("shape", "mesh", "p", "panel",
-                                   "cholqr"))
+                                   "cholqr", "policy"))
 @precise
-def _qr_blocked(ap, shape, mesh, p, panel, *, cholqr):
+def _qr_blocked(ap, shape, mesh, p, panel, *, cholqr, policy=px.FLOAT32):
     """Right-looking blocked QR over the row-sharded padded operand.
 
     Invariants inside the loop (panel j, offset off = j·panel):
@@ -139,31 +150,32 @@ def _qr_blocked(ap, shape, mesh, p, panel, *, cholqr):
     n_panels = -(-n // b)
     n_pad = n_panels * b
     mp = ap.shape[0]
-    if ap.shape[1] < n_pad:
-        av = jnp.pad(ap, ((0, 0), (0, n_pad - ap.shape[1])))
-    else:
-        av = ap[:, :n_pad]
-    # logical col padding beyond n must be zero for the zero-panel algebra
+    # shared pad/crop helper (math/base.grow_canvas): the panel canvas is
+    # zero-grown AND re-masked past the logical columns in one audited
+    # place — the zero-panel algebra (and any reduced-precision
+    # accumulation under the policy) can never see a garbage tail
+    av = grow_canvas(ap, (mp, n_pad), valid=(mp, n))
     col = lax.broadcasted_iota(jnp.int32, (1, n_pad), 1)
-    av = jnp.where(col < n, av, 0.0)
     av = lax.with_sharding_constraint(av, _mesh.row_sharding(mesh))
 
     def step(j, carry):
         t, q, r = carry
         off = j * b
         p_blk = lax.dynamic_slice(t, (0, off), (mp, b))
-        # re-orthogonalisation pass against accumulated Q (cols ≥ off zero)
-        c = q.T @ p_blk                          # (n_pad, b), row-axis psum
-        p_blk = p_blk - q @ c
+        # re-orthogonalisation pass against accumulated Q (cols ≥ off
+        # zero); the projections are the policy-routed GEMMs
+        c = px.pdot(q.T, p_blk, policy)          # (n_pad, b), row-axis psum
+        p_blk = p_blk - px.pdot(q, c, policy)
         r = lax.dynamic_update_slice(
             r, lax.dynamic_slice(r, (0, off), (n_pad, b)) + c, (0, off))
         # panel factorisation: shard-local QR + all_gather(R) over ICI
         qs, rs = _tsqr_shardmap(p_blk, mesh, p, cholqr=cholqr)  # (mp, b), (b, b)
-        # trailing update as sharded GEMMs: G = Qsᵀ T, T -= Qs G (cols > off+b)
-        g = qs.T @ t                             # (b, n_pad)
+        # trailing update as policy-routed sharded GEMMs:
+        # G = Qsᵀ T, T -= Qs G (cols > off+b)
+        g = px.pdot(qs.T, t, policy)             # (b, n_pad)
         trailing = col >= off + b
         g_trail = jnp.where(trailing, g, 0.0)
-        t = t - qs @ g_trail
+        t = t - px.pdot(qs, g_trail, policy)
         # R row block [off:off+b) = [Rs at panel cols | G on trailing cols]
         row_blk = lax.dynamic_update_slice(g_trail, rs, (0, off))
         r = lax.dynamic_update_slice(r, row_blk, (off, 0))

@@ -8,27 +8,13 @@ numerical fixes land in one place.
 
 from __future__ import annotations
 
-import functools
-
-import jax
 import jax.numpy as jnp
 
-
-def precise(fn):
-    """Trace-time matmul-precision scope for library kernels.
-
-    TPU matmuls default to bfloat16 inputs; the reference's per-block kernels
-    are NumPy float64, so dislib_tpu's own GEMMs run float32-faithful
-    ('highest') — bf16 cross-term error (~‖x‖²/256) breaks distance
-    thresholds, QR orthogonality and normal-equation solves outright.
-    Scoped here (under each kernel's ``jax.jit``, active during tracing)
-    rather than via the global ``jax_default_matmul_precision`` flag so user
-    code's own precision configuration is never touched."""
-    @functools.wraps(fn)
-    def wrapped(*args, **kwargs):
-        with jax.default_matmul_precision("highest"):
-            return fn(*args, **kwargs)
-    return wrapped
+# the f32-faithful trace scope lives in the precision-policy module (the
+# one place compute precision is decided — see ops/precision.py and the
+# precision-policy lint); re-exported here for the package-wide import
+# path every kernel already uses
+from dislib_tpu.ops.precision import precise  # noqa: F401
 
 
 def distances_sq(a, b, precision=None):

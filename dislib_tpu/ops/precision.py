@@ -1,0 +1,219 @@
+"""Mixed-precision policy — the ONE place library kernels get compute dtypes.
+
+The paper regime ("Large Scale Distributed Linear Algebra With TPUs",
+arXiv:2112.09017) runs the MXU at its native bf16 input throughput
+(~2x f32 per chip; this rig's r05 capture measured 2.6x) while
+accumulating partial sums in float32 — "bf16-compute / f32-accumulate".
+dislib_tpu exposes that as a *policy*:
+
+- ``float32`` (default): operands contract at float32-faithful precision
+  (``'highest'`` — on TPU a 6-pass bf16 decomposition, exactly the
+  pre-policy behavior of every library kernel).
+- ``bfloat16``: GEMM operands are rounded to bfloat16 and contracted with
+  float32 accumulation (``preferred_element_type``).  Input rounding is
+  2^-9 relative per operand, so results carry ~0.2-2% relative error —
+  the documented bounds live in :data:`ERROR_BOUNDS` and are asserted by
+  ``tests/test_precision.py``.
+
+Selection order: an explicit ``precision=`` kwarg on the public entry
+points (``math.matmul``, ``math.qr``, ``math.polar``, ``tsqr``,
+``random_svd``, ``lanczos_svd``, ``PCA``) wins; otherwise the
+``DSLIB_MATMUL_PRECISION`` env var; otherwise ``float32``.  Policies are
+hashable named tuples and ride the jit cache key as static arguments, so
+flipping the env var retraces instead of being silently ignored (the
+``_use_cholqr`` precedent).
+
+Scope of a policy inside composite factorisations (QR, tsQR, randomized
+SVD, Lanczos, PCA): the FLOP-dominant applied GEMMs (panel updates, Q
+assembly/application, power-iteration products, Gram/scatter products)
+follow the policy; the small dense factorisations (Householder QR of a
+panel, Cholesky of a Gram, the (sketch x sketch) SVD) are ALWAYS pinned
+float32 — rounding a factorisation's interior would destroy its
+backward stability for no meaningful FLOP win.  Pure-GEMM kernels
+(matmul, SUMMA, Newton-Schulz polar, distances) follow the policy end to
+end.
+
+Lint contract (``tests/test_precision_lint.py``): library kernels under
+``dislib_tpu/{math,ops,decomposition}`` may not hardcode compute dtypes
+(``.astype(jnp.float32)`` and friends), call
+``jax.default_matmul_precision`` directly, or pass literal ``precision=``
+strings to dots — they route through :func:`f32` / :func:`to_compute` /
+:func:`pdot` / :func:`precise` here, so a precision decision is a greppable
+one-module audit instead of a per-kernel archaeology dig.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Policy(NamedTuple):
+    """A compute/accumulate precision pair for library GEMMs.
+
+    Hashable (strings only) so it can thread through ``jax.jit`` static
+    arguments — a kernel traced under one policy retraces under another.
+    """
+
+    name: str             # canonical policy name ("float32" | "bfloat16")
+    compute: str          # dtype operands are rounded to for GEMM passes
+    accum: str            # accumulation dtype (always float32)
+    dot_precision: str | None  # lax precision for f32-operand dots
+
+
+FLOAT32 = Policy("float32", "float32", "float32", "highest")
+BFLOAT16 = Policy("bfloat16", "bfloat16", "float32", None)
+
+_POLICIES = {"float32": FLOAT32, "bfloat16": BFLOAT16}
+_ALIASES = {
+    "float32": "float32", "f32": "float32", "fp32": "float32",
+    "highest": "float32",
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+}
+
+# Documented relative-error bounds of the bfloat16 policy vs the float32
+# reference, asserted by tests/test_precision.py and quoted in the user
+# guide.  bf16 unit roundoff is 2^-9 ~= 2e-3 per operand; with f32
+# accumulation the dominant term is input rounding, so well-conditioned
+# results sit at a few 1e-3 and the bounds below carry ~4-8x headroom for
+# shape/conditioning spread (measured on this rig across the test grid).
+ERROR_BOUNDS = {
+    # max_ij |C - C_ref| / (||A||_F ||B||_F / sqrt(k)) — normalized entry error
+    ("matmul", "bfloat16"): 2e-2,
+    # ||Q^T Q - I||_max of the assembled Q (policy applies to panel
+    # updates + Q assembly; panel factorisations stay f32)
+    ("qr_orth", "bfloat16"): 4e-2,
+    # ||A - Q R||_F / ||A||_F
+    ("qr_resid", "bfloat16"): 2e-2,
+    ("tsqr_orth", "bfloat16"): 4e-2,
+    ("tsqr_resid", "bfloat16"): 2e-2,
+    # top singular values, relative: |s - s_ref| / s_ref[0]
+    ("randomsvd_values", "bfloat16"): 2e-2,
+    # the GKL recurrence AMPLIFIES matvec rounding (each step feeds the
+    # next), so Lanczos carries a wider bound than the one-shot sketches;
+    # prefer random_svd when running the bfloat16 policy
+    ("lanczos_values", "bfloat16"): 1e-1,
+    # polar orthogonality floor: ||U^T U - I||_max (Newton-Schulz is
+    # self-correcting down to the compute dtype's roundoff)
+    ("polar_orth", "bfloat16"): 5e-2,
+    ("polar_resid", "bfloat16"): 3e-2,
+    # float32 policy: the f32-faithful reference itself; listed so the
+    # test grid exercises both policies through one table
+    ("matmul", "float32"): 1e-6,
+    ("qr_orth", "float32"): 1e-4,
+    ("qr_resid", "float32"): 1e-5,
+    ("tsqr_orth", "float32"): 1e-4,
+    ("tsqr_resid", "float32"): 1e-5,
+    ("randomsvd_values", "float32"): 1e-4,
+    # Lanczos at float32 is TRUNCATION-dominated (k singular values from
+    # ~2k GKL steps), not rounding-dominated — the bound reflects the
+    # solver's approximation error at the tested depth, same as the
+    # reference's tolerance semantics
+    ("lanczos_values", "float32"): 1e-2,
+    ("polar_orth", "float32"): 1e-4,
+    ("polar_resid", "float32"): 1e-4,
+}
+
+
+def resolve(precision=None) -> Policy:
+    """The library's ONE precision-selection rule.
+
+    ``precision`` may be a :class:`Policy`, a name/alias (``"float32"``,
+    ``"f32"``, ``"bfloat16"``, ``"bf16"``), or None — None reads
+    ``DSLIB_MATMUL_PRECISION`` (same aliases) and falls back to float32.
+    """
+    if precision is None:
+        precision = os.environ.get("DSLIB_MATMUL_PRECISION") or "float32"
+    if isinstance(precision, Policy):
+        return precision
+    key = _ALIASES.get(str(precision).lower())
+    if key is None:
+        raise ValueError(
+            f"unknown precision policy {precision!r}: expected one of "
+            f"{sorted(set(_ALIASES))} (or a dislib_tpu.ops.precision.Policy)")
+    return _POLICIES[key]
+
+
+def of_name(name: str) -> Policy:
+    """Policy from its canonical name (fused-instruction statics store the
+    name, not the tuple, to keep program cache keys minimal)."""
+    return _POLICIES[name]
+
+
+def compute_dtype(policy: Policy):
+    return jnp.dtype(policy.compute)
+
+
+def accum_dtype(policy: Policy):
+    return jnp.dtype(policy.accum)
+
+
+def to_compute(x, policy: Policy = FLOAT32):
+    """Round an operand to the policy's GEMM compute dtype (the ONE place
+    library kernels cast operand precision).  Zero is exact in every
+    policy dtype, so the pad-and-mask invariant survives the cast.
+
+    The float32 policy is a *floor*, not a ceiling: float64 operands on
+    an x64-mode rig pass through untouched (narrowing full-precision user
+    data is never implicit — the ``ds.array`` dtype-policy precedent).
+    The bfloat16 policy is an explicit opt-in to reduced precision and
+    rounds every float input, float64 included."""
+    dt = jnp.dtype(policy.compute)
+    if policy.name == "float32" and x.dtype == jnp.float64:
+        return x
+    return x if x.dtype == dt else x.astype(dt)
+
+
+def f32(x):
+    """Pin an operand to exactly float32 — the ingest cast for
+    panel/small-matrix factorisations that stay f32 under EVERY policy
+    (see module docstring), and for integral inputs entering float
+    kernels.  Unlike :func:`to_compute`'s float32 policy this IS a
+    ceiling: the f32 kernels' shapes/numerics assume it."""
+    dt = jnp.dtype(jnp.float32)
+    return x if x.dtype == dt else x.astype(dt)
+
+
+def pdot(a, b, policy: Policy = FLOAT32):
+    """THE library GEMM: operands rounded to the policy compute dtype,
+    contracted with float32 accumulation.
+
+    float32 policy: ``precision='highest'`` — bit-identical to the
+    pre-policy kernels (f32 @ f32 at float32-faithful precision).
+    bfloat16 policy: operands round to bf16 and the dot accumulates f32
+    via ``preferred_element_type`` — on the MXU that is the native
+    single-pass bf16 systolic contraction, on CPU a bf16-input GEMM
+    (measurably faster on this rig: 2.3x in the r08 smoke capture).
+    Output dtype is the accumulation dtype (float32; float64 on x64-mode
+    float64 operands under the float32-floor policy).  ``jnp.matmul``
+    semantics, so batched (3-D) operands contract per batch."""
+    a = to_compute(a, policy)
+    b = to_compute(b, policy)
+    acc = jnp.promote_types(jnp.dtype(policy.accum),
+                            jnp.promote_types(a.dtype, b.dtype))
+    return jnp.matmul(a, b, precision=policy.dot_precision,
+                      preferred_element_type=acc)
+
+
+def precise(fn):
+    """Trace-time float32-faithful matmul scope for library kernels.
+
+    TPU matmuls default to bfloat16 passes; the reference's per-block
+    kernels are NumPy float64, so dislib_tpu's own GEMMs run
+    float32-faithful ('highest') unless a caller explicitly opts a kernel
+    into the bfloat16 policy via ``precision=``/:func:`pdot`.  Scoped
+    here (under each kernel's ``jax.jit``, active during tracing) rather
+    than via the global ``jax_default_matmul_precision`` flag so user
+    code's own precision configuration is never touched.  An explicit
+    ``precision=`` on a dot (what :func:`pdot` passes) overrides the
+    scope, so policy-routed GEMMs inside a ``precise`` kernel behave per
+    their policy while every other dot stays f32-faithful."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.default_matmul_precision("highest"):
+            return fn(*args, **kwargs)
+    return wrapped

@@ -70,6 +70,14 @@ class TestBenchOrchestrator:
                    if l.get("stale"))
         assert all(l.get("stale_carryover") for l in stale)
         assert "STALE CARRYOVER" in res.stderr
+        # round-10 satellite: carryover provenance — the leading record
+        # NAMES every replayed metric, each row is explicitly non-fresh,
+        # and stale_origin survives multi-hop replays (a replayed replay
+        # keeps the capture its number was actually measured in)
+        assert lines[flags[0]]["metrics"] == [l["metric"] for l in stale]
+        assert all(l.get("fresh") is False for l in stale)
+        assert all(l.get("stale_origin", "").startswith("BENCH_local_r")
+                   for l in stale)
         # BENCH_local_r05.jsonl is committed in-repo, so the fallback has
         # a capture to replay; every replayed row is flagged + attributed
         assert stale, "no stale fallback rows emitted"

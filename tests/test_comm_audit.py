@@ -136,8 +136,10 @@ class TestMatmul2DMeshAudit:
         try:
             x = rng.rand(self.DIM, self.DIM).astype(np.float32)
             a = ds_.array(x, block_size=(self.DIM // 4, self.DIM // 2))
+            from dislib_tpu.ops import precision as px
             hlo = _matmul_kernel.lower(a._data, a._data, False, False,
-                                       a.shape, a.shape).compile().as_text()
+                                       a.shape, a.shape,
+                                       px.FLOAT32).compile().as_text()
             full = self.DIM * self.DIM
             for op in ("all-gather", "all-to-all", "collective-permute"):
                 for elems in _collective_sizes(hlo, op):
@@ -161,9 +163,10 @@ class TestMatmul2DMeshAudit:
         try:
             x = rng.rand(self.DIM, self.DIM).astype(np.float32)
             a = ds_.array(x, block_size=(self.DIM // 4, self.DIM // 2))
+            from dislib_tpu.ops import precision as px
             mem = _matmul_kernel.lower(a._data, a._data, False, False,
-                                       a.shape,
-                                       a.shape).compile().memory_analysis()
+                                       a.shape, a.shape,
+                                       px.FLOAT32).compile().memory_analysis()
             if mem is None:
                 pytest.skip("backend reports no memory analysis")
             full = self.DIM * self.DIM * 4

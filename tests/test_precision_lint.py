@@ -1,0 +1,116 @@
+"""Precision-policy lint (round-10 satellite; the xla-flags / host-sync
+lint pattern): library kernels under ``dislib_tpu/{math,ops,decomposition}``
+may not hardcode GEMM compute dtypes or precision — every such decision
+routes through the ONE policy module, ``dislib_tpu/ops/precision.py``
+(:func:`resolve` / :func:`to_compute` / :func:`f32` / :func:`pdot` /
+:func:`precise`), so "what precision does this kernel run at" is a
+one-module audit instead of a per-kernel archaeology dig, and the
+``DSLIB_MATMUL_PRECISION`` env knob can never be silently bypassed.
+
+Flagged spellings, by AST scan:
+
+1. ``x.astype(<float dtype literal>)`` — e.g. ``astype(jnp.float32)``,
+   ``astype(np.bfloat16)``, ``astype("float32")``.  Deriving a dtype from
+   a VALUE (``astype(u.dtype)``, mask casts) is fine — that is layout
+   plumbing, not a precision decision.
+2. any call of ``default_matmul_precision`` — the trace-scope lives in
+   the policy module's ``precise`` only.
+3. a literal string ``precision=`` keyword on any call — policies thread
+   as resolved objects / variables, never as scattered string constants.
+
+The policy module itself is the single allowed site.  Adding a new site
+means consciously extending ALLOW with a reason, the host-sync-lint
+contract.
+"""
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+KERNEL_DIRS = (
+    "dislib_tpu/math",
+    "dislib_tpu/ops",
+    "dislib_tpu/decomposition",
+)
+
+# the ONE module allowed to spell compute dtypes / precision literals
+ALLOW = {
+    "dislib_tpu/ops/precision.py",
+}
+
+_FLOAT_DTYPE_NAMES = {"float32", "float64", "float16", "bfloat16"}
+
+
+def _is_float_dtype_literal(node):
+    """True for jnp.float32 / np.bfloat16 / jax.numpy.float16-style
+    attribute chains and 'float32'-style string constants."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in _FLOAT_DTYPE_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _FLOAT_DTYPE_NAMES
+    return False
+
+
+def _scan(path):
+    tree = ast.parse(open(path, encoding="utf-8").read())
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "astype" and node.args:
+            if _is_float_dtype_literal(node.args[0]):
+                yield node.lineno, "astype(<hardcoded float dtype>)"
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            (f.id if isinstance(f, ast.Name) else None)
+        if name == "default_matmul_precision":
+            yield node.lineno, "default_matmul_precision(...)"
+        for kw in node.keywords:
+            if kw.arg == "precision" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                yield node.lineno, f"precision={kw.value.value!r} literal"
+
+
+def _kernel_files():
+    for d in KERNEL_DIRS:
+        full = os.path.join(REPO, d)
+        for fn in sorted(os.listdir(full)):
+            if fn.endswith(".py"):
+                yield f"{d}/{fn}", os.path.join(full, fn)
+
+
+def test_no_hardcoded_compute_dtypes_in_kernels():
+    offenders = []
+    for rel, full in _kernel_files():
+        if rel in ALLOW:
+            continue
+        for lineno, what in _scan(full):
+            offenders.append(f"{rel}:{lineno}: {what}")
+    assert not offenders, (
+        "hardcoded compute dtype / precision in library kernels — route "
+        "through dislib_tpu/ops/precision (resolve/to_compute/f32/pdot/"
+        "precise), or consciously extend the lint ALLOW with a reason:\n  "
+        + "\n  ".join(offenders))
+
+
+def test_policy_module_is_the_one_scope_site():
+    """The f32-faithful trace scope (default_matmul_precision) must exist
+    in the policy module — if a refactor moves it, the lint's premise
+    (one audited site) needs re-establishing, not silently dropping."""
+    path = os.path.join(REPO, "dislib_tpu/ops/precision.py")
+    hits = [what for _, what in _scan(path)
+            if "default_matmul_precision" in what]
+    assert hits, "ops/precision.py no longer hosts the matmul scope"
+
+
+def test_public_entries_expose_precision_kwarg():
+    """The paper-scale surface must actually accept the policy: matmul,
+    qr, polar, tsqr, random_svd, lanczos_svd take ``precision=`` and PCA
+    takes it as a constructor param — an entry dropping the kwarg would
+    orphan the env knob for that path."""
+    import inspect
+    import dislib_tpu as ds
+    for fn in (ds.matmul, ds.qr, ds.polar, ds.tsqr, ds.random_svd,
+               ds.lanczos_svd):
+        assert "precision" in inspect.signature(fn).parameters, fn
+    assert "precision" in inspect.signature(ds.PCA.__init__).parameters

@@ -32,6 +32,10 @@ MUTATION_ALLOWLIST = {
     "tests/conftest.py",
     "tests/mp_worker.py",
     "examples/multihost_launch.py",
+    # bench smoke children fake a 2-D mesh for the SUMMA tier with
+    # virtual host devices (the conftest bootstrap, applied pre-import
+    # in the per-config subprocess); device-count flag only
+    "bench.py",
 }
 
 _MUTATION = re.compile(

@@ -31,6 +31,14 @@ ROWS = [
      "k=8, 10000×100 ds-array", False),
     ("matmul_4096_f32_gflops_per_chip", "Blocked matmul (f32)",
      "4096×4096 @ 4096×4096", True),
+    ("matmul_mp_4096_bf16_vs_f32_speedup",
+     "Matmul mixed-precision A/B (bf16 policy vs f32)",
+     "4096×4096, 12-GEMM chains, roofline-normalized gate", False),
+    ("polar_16384x1024_gflops_sustained",
+     "Polar (Newton–Schulz, roofline row)",
+     "16384×1024, one dispatch per call", True),
+    ("summa_8192_gflops_per_chip", "SUMMA matmul (2-D mesh)",
+     "8192×8192, explicit panel broadcasts", True),
     ("tsqr_65536x256_wall_s", "tsQR", "65536×256 tall-skinny", False),
     ("randomsvd_32768x1024_nsv64_wall_s", "RandomizedSVD",
      "32768×1024, nsv=64", False),
