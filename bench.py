@@ -745,6 +745,125 @@ def bench_summa(dim, tag, peak_floor=0.05):
     return res
 
 
+def bench_rechunk(m, n, tag, panels=4, min_gbps=0.02, peak_ratio_max=1.5):
+    """On-device collective rechunk (round-11 perf PR, ROADMAP item 4):
+    the explicit masked-psum panel-exchange schedule resharding an (m, n)
+    ds-array between two 2-D mesh layouts of the same devices.
+
+    Gates (all fail the config loudly):
+    - result BIT-EQUAL to the host `repad_rows` oracle, pads exactly zero;
+    - ONE dispatch per reshard, ZERO host transfers (counters);
+    - peak-live-buffer proxy ((out + temp) / in from XLA's own memory
+      analysis of the compiled program) <= ``peak_ratio_max`` — a
+      schedule that gathered a full copy sits >= 2.0, the panel schedule
+      at ~1 + 1/panels (``DSLIB_RECHUNK_PEAK_RATIO_MAX`` overrides);
+    - sustained bytes/s ((in + out) / wall) >= ``min_gbps``
+      (``DSLIB_RECHUNK_GBPS_MIN`` overrides);
+    - a mid-chain rechunk in a fused op chain costs ZERO extra
+      dispatches (the chain still forces as ONE program).
+    The deviceput (runtime-copy) schedule is timed alongside as the
+    baseline ratio — informational, like summa's vs_xla."""
+    import jax
+    import dislib_tpu as ds
+    from dislib_tpu.ops import rechunk as _rc
+    from dislib_tpu.parallel import mesh as _mesh
+    from dislib_tpu.utils import profiling as _prof
+
+    devs = len(jax.devices())
+    if devs < 4:
+        raise RuntimeError(
+            f"rechunk bench needs >= 4 devices for a 2-D mesh, have {devs}")
+    r = int(np.sqrt(devs))
+    while devs % r:
+        r -= 1
+    src, dst = (devs // r, r), (r, devs // r)
+    rng = np.random.RandomState(0)
+    x_host = rng.rand(m, n).astype(np.float32)
+    ds.init(src)
+    a = ds.array(x_host).force()
+    ds.init(dst)
+    q = _mesh.pad_quantum()
+    pshape = (-(-m // q) * q, -(-n // q) * q)
+
+    # correctness gate: a reshard is pure data movement — BIT-equal
+    out = ds.rechunk(a, schedule="panels", panels=panels)
+    got = np.asarray(out._data)
+    from dislib_tpu.runtime import repad_rows
+    oracle = repad_rows(repad_rows(x_host, m, pshape[0], axis=0),
+                        n, pshape[1], axis=1)
+    np.testing.assert_array_equal(got, oracle)
+
+    # dispatch / transfer gate
+    _prof.reset_counters()
+    ds.rechunk(a, schedule="panels", panels=panels)
+    d, tr = _prof.dispatch_count(), _prof.transfer_count()
+    assert d == 1, f"panel rechunk cost {d} dispatches, expected 1"
+    assert tr == 0, f"panel rechunk cost {tr} host transfers, expected 0"
+
+    # peak-live-buffer proxy gate (XLA memory analysis; analytic bound as
+    # the fallback on backends without it)
+    ma = _rc.panel_memory_analysis(a._data, a.shape, _mesh.get_mesh(),
+                                   panels)
+    ratio = ma["peak_live_ratio"] if ma["peak_live_ratio"] is not None \
+        else ma["analytic_ratio"]
+    ratio_max = float(os.environ.get("DSLIB_RECHUNK_PEAK_RATIO_MAX",
+                                     peak_ratio_max))
+    if ratio > ratio_max:
+        msg = (f"RECHUNK MEMORY GATE FAILED: peak-live proxy {ratio:.2f}x "
+               f"the array footprint exceeds the {ratio_max:.2f}x bound "
+               f"(panels={ma['panels']}) — the schedule is materialising "
+               "a gathered copy")
+        print(msg, file=sys.stderr, flush=True)
+        raise AssertionError(msg)
+
+    # fused mid-chain gate: a rechunk NODE adds no dispatch to a chain.
+    # schedule="xla" forces the node onto the graph — the auto path's
+    # metadata fast-path would make this gate vacuous (review-found)
+    b = ds.array(x_host).force()          # canonical under dst mesh
+    def _chain():
+        mid = ds.rechunk(b * 1.0001, (max(1, m // 8), n), schedule="xla")
+        assert mid.is_lazy, "mid-chain rechunk left the fusion graph"
+        (mid + 0.0001).force()
+    _chain()                              # warm
+    _prof.reset_counters()
+    _chain()
+    dc = _prof.dispatch_count()
+    assert dc == 1, f"fused chain with mid-chain rechunk cost {dc} dispatches"
+
+    def run(schedule):
+        y = ds.rechunk(a, schedule=schedule, panels=panels)
+        _sync(y._data)
+
+    run("panels")
+    t = _median_time(lambda: run("panels"))
+    run("deviceput")
+    t_dput = _median_time(lambda: run("deviceput"))
+    moved = (int(np.prod(a._pshape)) + int(np.prod(pshape))) * 4
+    gbps = moved / t / 1e9
+    floor = float(os.environ.get("DSLIB_RECHUNK_GBPS_MIN", min_gbps))
+    res = {"metric": f"rechunk_{tag}_gb_per_sec (baseline: deviceput "
+                     "runtime copy, same relayout)",
+           "value": round(gbps, 3), "unit": "GB/s",
+           "vs_baseline": round(t_dput / t, 2),
+           "wall_s": round(t, 5), "deviceput_wall_s": round(t_dput, 5),
+           "mesh_src": list(src), "mesh_dst": list(dst),
+           "dispatches_per_op": 1, "host_transfers": 0,
+           "peak_live_ratio": ratio, "peak_live_ratio_max": ratio_max,
+           "panel_temp_bytes": ma["temp_bytes"],
+           "analytic_ratio": ma["analytic_ratio"], "panels": ma["panels"],
+           "gbps_floor": floor,
+           "note": "gates: bit-equal to host repad oracle, 1 dispatch / 0 "
+                   "transfers, peak-live proxy, mid-chain rechunk fuses "
+                   "at 0 extra dispatches; vs_baseline = deviceput_wall / "
+                   "panels_wall (informational)"}
+    if gbps < floor:
+        msg = (f"RECHUNK THROUGHPUT GATE FAILED: {gbps:.3f} GB/s below "
+               f"the {floor:.3f} GB/s floor")
+        print(msg, file=sys.stderr, flush=True)
+        raise AssertionError(msg)
+    return res
+
+
 def bench_fused_chain(dim, n_ops, tag):
     """Fused-chain microbench (round-7 fusion PR): ONE user-visible op
     chain — scale/add/transpose rounds ending in a matmul — forced as a
@@ -1701,6 +1820,9 @@ def _configs():
                                                 peak_floor=0.1)),
             ("summa_smoke", lambda: bench_summa(512, "smoke",
                                                 peak_floor=0.1)),
+            # round-11 rechunk tier: collective reshard, memory-bounded
+            ("rechunk_smoke", lambda: bench_rechunk(2048, 256, "smoke",
+                                                    min_gbps=0.02)),
             ("kmeans_smoke_fastdist",
              lambda: bench_kmeans(1000, 20, 4, 5, "smoke_fastdist")),
             ("fused_chain_smoke",
@@ -1752,6 +1874,10 @@ def _configs():
          lambda: bench_polar(16384, 1024, "16384x1024", peak_floor=0.15)),
         ("summa_8192_gflops_per_chip",
          lambda: bench_summa(8192, "8192", peak_floor=0.1)),
+        # round-11 rechunk tier: collective reshard of a paper-scale
+        # operand between 2-D layouts, peak-live proxy <= 1.5x gated
+        ("rechunk_16384x2048_gb_per_sec",
+         lambda: bench_rechunk(16384, 2048, "16384x2048", min_gbps=0.2)),
         # round-7 fusion PR: one forced op chain vs per-op eager dispatch —
         # at 512² the per-dispatch RTT dominates both modes' compute, so
         # the ratio reads the dispatch savings directly
@@ -1822,10 +1948,10 @@ def _run_one(name):
     # the parent's skip-and-continue and two-timeouts-abort paths)
     if name in os.environ.get("DSLIB_BENCH_FAKE_HANG", "").split(","):
         time.sleep(10_000)
-    if name.startswith("summa") and os.environ.get("BENCH_SMOKE") and \
-            (_smoke_wants_cpu()
-             or "cpu" in os.environ.get("JAX_PLATFORMS", "")):
-        # the SUMMA tier needs a 2-D mesh; smoke mode fakes one with
+    if name.startswith(("summa", "rechunk")) and os.environ.get("BENCH_SMOKE") \
+            and (_smoke_wants_cpu()
+                 or "cpu" in os.environ.get("JAX_PLATFORMS", "")):
+        # the SUMMA/rechunk tiers need a 2-D mesh; smoke mode fakes one with
         # virtual host devices — must land in XLA_FLAGS BEFORE the
         # backend initialises (the conftest precedent).  Chip runs use
         # the real device grid and never take this branch.

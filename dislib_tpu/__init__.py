@@ -52,7 +52,7 @@ _ensure_jax_compat()
 from dislib_tpu.parallel.mesh import init, get_mesh, set_mesh
 from dislib_tpu.data.array import (
     Array, array, random_array, zeros, full, ones, identity, eye,
-    apply_along_axis, concat_rows, concat_cols,
+    apply_along_axis, concat_rows, concat_cols, rechunk, ensure_canonical,
 )
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
@@ -94,7 +94,8 @@ __version__ = "0.1.0"
 __all__ = [
     "init", "get_mesh", "set_mesh",
     "Array", "array", "random_array", "zeros", "full", "ones", "identity",
-    "eye", "apply_along_axis", "concat_rows", "concat_cols", "SparseArray",
+    "eye", "apply_along_axis", "concat_rows", "concat_cols", "rechunk",
+    "ensure_canonical", "SparseArray",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
     "save_txt",
     "matmul", "kron", "svd", "qr", "polar",

@@ -26,7 +26,8 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.data.array import Array, _repad, \
+    ensure_canonical as _ensure_canonical
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
@@ -81,6 +82,9 @@ class Daura(BaseEstimator):
             raise ValueError("Daura expects rows of 3*n_atoms coordinates")
         n_atoms = x.shape[1] // 3
         mesh = _mesh.get_mesh()
+        # ring-tier shard_map splits rows over the mesh — an input built
+        # under another mesh re-lays out on device (never a host hop)
+        x = _ensure_canonical(x)
         guard = _health.guard("daura", health, checkpoint)
         if checkpoint is not None:
             labels, medoids = self._fit_checkpointed(x, n_atoms, checkpoint,

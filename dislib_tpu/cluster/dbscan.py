@@ -42,7 +42,8 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.data.array import Array, _repad, \
+    ensure_canonical as _ensure_canonical
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
@@ -104,6 +105,9 @@ class DBSCAN(BaseEstimator):
         guard raises a typed ``NumericalDivergence`` instead (quarantine
         the rows at ingest).  The chunk watchdog covers hung passes."""
         mesh = _mesh.get_mesh()
+        # ring-tier shard_map splits rows over the mesh — an input built
+        # under another mesh re-lays out on device (never a host hop)
+        x = _ensure_canonical(x)
         guard = _health.guard("dbscan", health, checkpoint)
         if checkpoint is not None:
             raw, core = self._fit_checkpointed(x, checkpoint, mesh, guard)

@@ -1,6 +1,6 @@
 from dislib_tpu.data.array import (
     Array, array, random_array, zeros, full, ones, identity, eye,
-    apply_along_axis, concat_rows, concat_cols,
+    apply_along_axis, concat_rows, concat_cols, rechunk, ensure_canonical,
 )
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
@@ -10,7 +10,8 @@ from dislib_tpu.data.sparse import SparseArray
 
 __all__ = [
     "Array", "array", "random_array", "zeros", "full", "ones", "identity",
-    "eye", "apply_along_axis", "concat_rows", "concat_cols",
+    "eye", "apply_along_axis", "concat_rows", "concat_cols", "rechunk",
+    "ensure_canonical",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
     "save_txt", "QuarantineReport", "last_quarantine_report", "SparseArray",
 ]

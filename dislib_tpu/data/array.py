@@ -73,6 +73,8 @@ __all__ = [
     "apply_along_axis",
     "concat_rows",
     "concat_cols",
+    "rechunk",
+    "ensure_canonical",
 ]
 
 
@@ -275,6 +277,28 @@ def _instr_dist(static, a, b):
     return _place_region(d, out_pshape)
 
 
+def _instr_rechunk(static, a):
+    """Round-11 rechunk PR: re-quantize a backing to a new padded canvas
+    INSIDE the fused program (crop/place, re-zero outside the logical
+    region, constrain to the canonical sharding) — a mid-chain reshard
+    costs zero extra dispatches.  Body shared with the eager collective
+    paths in ``ops/rechunk.py``.  The trailing static element is the
+    mesh token: it rides the program cache key so a mesh switch that
+    happens to preserve every shape (e.g. (4,2) → (2,4), same quantum)
+    retraces instead of replaying a constraint to the OLD mesh."""
+    from dislib_tpu.ops.rechunk import requantize_body
+    logical_shape, out_pshape, _mesh_token = static
+    return requantize_body(a, logical_shape, out_pshape)
+
+
+def _mesh_token():
+    """Hashable identity of the current default mesh (shape + device
+    ids) — the cache-key ingredient for mesh-sensitive fused statics."""
+    mesh = _mesh.get_mesh()
+    return (tuple(mesh.devices.shape),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
 def _instr_kernel(static, *args):
     """Round-9 serving PR: an arbitrary traced kernel body as ONE fusion
     node.  ``static`` is ``(body, cfg)``: ``body`` a module-level pure
@@ -300,6 +324,7 @@ _INSTRS = {
     "matmul": _instr_matmul,
     "dist": _instr_dist,
     "kernel": _instr_kernel,
+    "rechunk": _instr_rechunk,
 }
 
 
@@ -546,6 +571,8 @@ class Array:
         devices, so the gather is a `process_allgather` over DCN (every
         host ends with the full logical array, the reference's
         gather-to-master contract)."""
+        from dislib_tpu.utils.profiling import count_transfer
+        count_transfer()
         if not self._data.is_fully_addressable:
             from jax.experimental import multihost_utils
             out = np.asarray(multihost_utils.process_allgather(
@@ -570,16 +597,21 @@ class Array:
                 f"only a (1, 1) ds-array converts to float, got {self._shape}")
         # read the backing directly: collect() of a sparse-flagged array
         # wraps the scalar in a csr_matrix, which float() rejects
+        from dislib_tpu.utils.profiling import count_transfer
+        count_transfer()
         return float(np.asarray(jax.device_get(self._data[0:1, 0:1]))
                      .reshape(()))
 
     # -- layout --------------------------------------------------------------
 
     def rechunk(self, block_size) -> "Array":
-        """Change the block-size hint.  Physical layout is mesh-determined, so
-        this is metadata-only — the reference's data-movement rechunk
-        (SURVEY §3.1) collapses to a no-op on a global jax.Array."""
-        return Array(self._data, self._shape, reg_shape=block_size, sparse=self._sparse)
+        """Change the block-size hint — and, when the backing was laid out
+        under a DIFFERENT mesh quantum (elastic mesh change), reshard it
+        on-device for the current mesh via :func:`rechunk` (round-11
+        collective-rechunk PR).  On an already-canonical backing this
+        stays metadata-only, the reference's data-movement rechunk
+        (SURVEY §3.1) collapsed to a no-op on a global jax.Array."""
+        return rechunk(self, block_size)
 
     def astype(self, dtype) -> "Array":
         return Array(self._data.astype(dtype), self._shape, self._reg_shape, self._sparse)
@@ -1085,31 +1117,198 @@ def _eye_op(pshape, shape, dtype):
     return jnp.where((r == c) & (r < min(shape)), jnp.ones((), dtype), jnp.zeros((), dtype))
 
 
+def rechunk(x: Array, new_blocks=None, mesh=None, *, schedule="auto",
+            panels=None) -> Array:
+    """Reshard a ds-array to a new block-size hint and/or mesh layout —
+    ON DEVICE, via a collective schedule, never a host materialization
+    (round-11 rechunk PR; arXiv:2112.01075 discipline).
+
+    Block size and mesh shape are deployment details, not API
+    constraints: any estimator accepts any block size produced by any
+    other stage, and this is the one primitive that moves a backing
+    between pad quanta / mesh layouts when they DO differ.
+
+    - ``new_blocks``: new block-size hint (metadata; ``None`` keeps the
+      current hint).
+    - ``mesh``: target :class:`jax.sharding.Mesh`; ``None`` = the library
+      default mesh.
+    - ``schedule``: ``"auto"`` | ``"xla"`` | ``"panels"`` |
+      ``"deviceput"`` (see :mod:`dislib_tpu.ops.rechunk`;
+      ``DSLIB_RECHUNK_SCHEDULE`` overrides auto).  Under auto, an
+      already-canonical backing is a metadata-only no-op; a same-layout
+      quantum change joins the dispatch-fusion graph (a mid-chain
+      rechunk costs ZERO extra dispatches); a mesh-layout change over
+      the same devices runs the explicit masked-psum panel exchange in
+      ONE jitted program with peak in-flight bytes ≈ |array| / panels;
+      a device-set change uses the runtime's device-to-device copy.
+    - ``panels``: in-flight panel count for the collective schedule
+      (default ``DSLIB_RECHUNK_PANELS`` = 4).
+
+    The result re-satisfies the pad-and-mask invariant by construction:
+    pad slices are exactly zero after the reshard, whatever the input
+    tail carried."""
+    from dislib_tpu.ops import rechunk as _rc
+    if not isinstance(x, Array):
+        raise TypeError(
+            f"ds.rechunk needs a dense ds-array, got {type(x).__name__} "
+            "(SparseArray backings reshard with their estimator's "
+            "sharded_rows ingest)")
+    reg = _check_block_size(x._shape, new_blocks) if new_blocks is not None \
+        else x._reg_shape
+    target = mesh if mesh is not None else _mesh.get_mesh()
+    out_pshape = _padded_shape(x._shape, _mesh.pad_quantum(target))
+    if target is _mesh.get_mesh() and schedule in ("auto", "xla") \
+            and not _eager_mode():
+        canonical = _mesh.data_sharding(target)
+        if schedule == "auto":
+            # already canonical: the block hint is pure metadata — share
+            # the backing (concrete) or the pending expression (lazy;
+            # chains are built for the current mesh by construction).
+            # (An EXPLICIT schedule="xla" still emits the requantize
+            # node — the user-reachable "re-assert the pad-and-mask
+            # invariant" op, pinned by the poisoned-pad regressions.)
+            if not x.is_lazy and tuple(x._concrete.shape) == out_pshape \
+                    and x._concrete.sharding == canonical:
+                return Array(x._concrete, x._shape, reg, x._sparse)
+            if x.is_lazy and tuple(x._lazy.pshape) == out_pshape:
+                return Array(x._lazy, x._shape, reg, x._sparse)
+        if x.is_lazy or getattr(x._concrete, "sharding", None) == canonical:
+            # same-layout quantum change: a fusion-graph node — the
+            # reshard rides the chain and costs no dispatch of its own
+            expr = _LazyExpr("rechunk",
+                             (x._shape, tuple(out_pshape), _mesh_token()),
+                             (x._node(),), out_pshape, x.dtype)
+            return _lazy_array(expr, x._shape, reg, x._sparse)
+    data, _sched = _rc.reshard(x._data, x._shape, target, schedule, panels)
+    return Array(data, x._shape, reg, x._sparse)
+
+
+def ensure_canonical(x: Array) -> Array:
+    """``x`` unchanged when its backing already matches the current
+    mesh's pad quantum and layout; otherwise an on-device
+    :func:`rechunk`.  The ingest guard for kernels with a hard layout
+    requirement (shard_map row splits, SUMMA panels): estimators accept
+    arrays built under ANY mesh and re-lay them out without a host hop."""
+    pshape = _padded_shape(x._shape, _mesh.pad_quantum())
+    if x.is_lazy:
+        # a pending chain forces under the CURRENT mesh's constraints,
+        # but its canvas shapes were fixed at build time — a chain built
+        # before a quantum-changing mesh switch needs the fused
+        # requantize node appended (review-found with a live repro:
+        # old-quantum lazy operands crashed SUMMA's shard_map split)
+        if tuple(x._lazy.pshape) == pshape:
+            return x
+        return rechunk(x)
+    if tuple(x._concrete.shape) == pshape \
+            and x._concrete.sharding == _mesh.data_sharding():
+        return x
+    return rechunk(x)
+
+
+def _apply_axis_out_shape(out_spec, axis):
+    """Logical 2-D result shape of an apply_along_axis (1-D maps get the
+    reference's row/column-vector orientation)."""
+    if out_spec.ndim == 1:
+        return (1, int(out_spec.shape[0])) if axis == 0 \
+            else (int(out_spec.shape[0]), 1)
+    if out_spec.ndim == 2:
+        return tuple(int(s) for s in out_spec.shape)
+    raise ValueError(
+        f"apply_along_axis: func produced a {out_spec.ndim}-D result; "
+        "ds-arrays are 2-D")
+
+
+def _apply_axis_kernel(cfg, xp):
+    """``apply_along_axis`` as a fusion-node body (round-11 satellite):
+    crop to the logical region, run the traced map, and place the result
+    on its zero padded canvas — ONE dispatch riding whatever chain feeds
+    it, instead of the old eager per-op path."""
+    func, axis, in_shape, out_shape, out_pshape, fargs, fkwargs = cfg
+    xv = xp[: in_shape[0], : in_shape[1]]
+    out = jnp.apply_along_axis(func, axis, xv, *fargs, **dict(fkwargs))
+    if out.ndim == 1:
+        out = out.reshape(1, -1) if axis == 0 else out.reshape(-1, 1)
+    canvas = jnp.zeros(out_pshape, out.dtype)
+    return lax.dynamic_update_slice(canvas, out, (0, 0))
+
+
 def apply_along_axis(func, axis, x: Array, *args, **kwargs) -> Array:
     """Apply ``func`` to 1-D slices of ``x`` along ``axis`` (reference:
     `dislib.data.array.apply_along_axis`, the generic user-level block map).
 
-    ``func`` is first attempted as a JAX-traceable function (vmapped on
-    device, so the map runs sharded); if tracing fails it falls back to
-    ``np.apply_along_axis`` on host — a device→host→device round trip that
-    is orders of magnitude slower, so the fallback WARNS with the original
-    trace error."""
-    logical = x._data[: x._shape[0], : x._shape[1]]
+    Three tiers, fastest first (round-11 rechunk PR satellite):
+
+    1. JAX-traceable ``func`` with hashable extra args: a fusion-graph
+       node (:func:`fused_kernel`) — the whole map is ONE cached XLA
+       dispatch (counter-pinned) and fuses into any surrounding op chain.
+       Traceability is probed with ``jax.eval_shape`` (no execution, no
+       transfer).
+    2. Traceable but unhashable extras: the eager on-device
+       ``jnp.apply_along_axis`` (still no host round trip).
+    3. Not traceable at all: ``np.apply_along_axis`` on host — a
+       device→host→device round trip that is orders of magnitude slower,
+       so this tier WARNS with the original trace error."""
+    logical_shape = x._shape
+    spec = jax.ShapeDtypeStruct(logical_shape, x.dtype)
     try:
-        out = jnp.apply_along_axis(func, axis, logical, *args, **kwargs)
-    except Exception as e:  # noqa: BLE001 — any trace failure falls back
+        out_spec = jax.eval_shape(
+            lambda v: jnp.apply_along_axis(func, axis, v, *args, **kwargs),
+            spec)
+    except Exception as e:  # noqa: BLE001 — any trace failure → host tier
         import warnings
         warnings.warn(
             f"apply_along_axis: {getattr(func, '__name__', func)!r} is not "
             f"JAX-traceable ({type(e).__name__}: {e}); falling back to host "
             "NumPy (device->host->device round trip, far slower)",
             UserWarning, stacklevel=2)
-        out = np.apply_along_axis(func, axis, np.asarray(jax.device_get(logical)),
-                                  *args, **kwargs)
+        from dislib_tpu.utils.profiling import count_transfer
+        logical = x._data[: x._shape[0], : x._shape[1]]
+        count_transfer()
+        out = np.apply_along_axis(
+            func, axis, np.asarray(jax.device_get(logical)), *args, **kwargs)
         out = jnp.asarray(out)
-    if out.ndim == 1:
-        out = out.reshape(1, -1) if axis == 0 else out.reshape(-1, 1)
-    return Array._from_logical(out, reg_shape=None)
+        if out.ndim == 1:
+            out = out.reshape(1, -1) if axis == 0 else out.reshape(-1, 1)
+        return Array._from_logical(out, reg_shape=None)
+    out_shape = _apply_axis_out_shape(out_spec, axis)
+    cfg = (func, axis, logical_shape, out_shape,
+           _padded_shape(out_shape, _mesh.pad_quantum()), tuple(args),
+           tuple(sorted(kwargs.items())))
+    try:
+        stable = _stable_callable(func) and (hash(cfg) is not None)
+    except TypeError:           # unhashable extras
+        stable = False
+    if not stable:
+        # eager on-device tier: correct and host-free, but NOT entered
+        # into the persistent fused-program cache — a fresh lambda per
+        # call would pin a new executable forever (the fusion layer's
+        # module-level-body contract; review-found)
+        logical = x._data[: x._shape[0], : x._shape[1]]
+        out = jnp.apply_along_axis(func, axis, logical, *args, **kwargs)
+        if out.ndim == 1:
+            out = out.reshape(1, -1) if axis == 0 else out.reshape(-1, 1)
+        return Array._from_logical(out, reg_shape=None)
+    return fused_kernel(_apply_axis_kernel, cfg, (x,), out_shape,
+                        out_spec.dtype, out_pshape=cfg[4])
+
+
+def _stable_callable(func) -> bool:
+    """True when ``func`` is a module-level callable whose identity is
+    stable across calls — the ``fused_kernel`` cache-key contract.  A
+    per-call lambda/closure/partial gets a fresh identity every time and
+    would grow the persistent executable cache without bound, so those
+    route to the eager on-device tier instead."""
+    import sys
+    mod = getattr(func, "__module__", None)
+    qual = getattr(func, "__qualname__", None)
+    if not mod or not qual or "<" in qual:   # <lambda>, <locals>
+        return False
+    obj = sys.modules.get(mod)
+    for part in qual.split("."):
+        obj = getattr(obj, part, None)
+        if obj is None:
+            return False
+    return obj is func
 
 
 def concat_rows(arrays) -> Array:

@@ -30,7 +30,8 @@ from jax import lax
 import os
 
 from dislib_tpu.data.array import (
-    Array, _LazyExpr, _eager_mode, _lazy_array, _matmul_body, _repad,
+    Array, _LazyExpr, _eager_mode, _lazy_array, _matmul_body,
+    ensure_canonical as _ensure_canonical,
 )
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops import precision as px
@@ -156,15 +157,13 @@ def _matmul_summa(a, b, transpose_a, transpose_b, policy, out_shape, reg):
         a = a.transpose()
     if transpose_b:
         b = b.transpose()
+    # operands built under an OLDER mesh can carry a pad quantum (or
+    # layout) the current grid doesn't divide — the panel loop would
+    # silently drop the K tail (and shard_map reject the row/col split);
+    # the on-device rechunk ingest guard re-lays them out first
+    a = _ensure_canonical(a)
+    b = _ensure_canonical(b)
     ad, bd = a._data, b._data
-    # operands built under an OLDER mesh can carry a pad quantum the
-    # current grid doesn't divide — the panel loop would silently drop the
-    # K tail (and shard_map reject the row/col split); repad to the
-    # current quantum first
-    q = _mesh.pad_quantum()
-    if any(s % q for s in (*ad.shape, *bd.shape)):
-        ad = _repad(ad, a.shape)
-        bd = _repad(bd, b.shape)
     ad, bd = _match_inner(ad, bd, False, False)
     out = summa_matmul(ad, bd, _mesh.get_mesh(), policy)
     return Array(_crop_or_keep(out, out_shape), out_shape, reg, False)
@@ -259,8 +258,16 @@ def _kron_kernel(ap, bp, shapes, pshape):
 # svd — one-sided block-Jacobi, the reference's algorithm, device-resident
 # ---------------------------------------------------------------------------
 
+# per-policy convergence floors (the polar tol-floor precedent): the
+# off-diagonal measure can't fall below the pair-update GEMMs' own
+# rounding — under the bfloat16 policy that is ~2^-9 per operand, so
+# demanding 1e-6 would burn max_sweeps in full every call
+_SVD_EPS_FLOOR = {"float32": 1e-6, "bfloat16": 5e-3}
+
+
 def svd(a: Array, compute_uv: bool = True, sort: bool = True,
-        copy: bool = True, eps: float = 1e-6, max_sweeps: int = 30):
+        copy: bool = True, eps: float = 1e-6, max_sweeps: int = 30,
+        precision=None):
     """One-sided Jacobi SVD (reference: dislib.math.svd — round-robin
     rotations of column pairs until all pairs are ε-orthogonal; the
     reference pairs column BLOCKS, SURVEY §3.2 svd row).
@@ -283,7 +290,21 @@ def svd(a: Array, compute_uv: bool = True, sort: bool = True,
     float64 blocks): the kernels run float32, whose pairwise-orthogonality
     floor is ~5e-8, so tighter requests are unreachable and are clamped to
     1e-6 with a warning.
+
+    ``precision`` — the mixed-precision policy (None → the
+    ``DSLIB_MATMUL_PRECISION`` default).  Scope follows the round-10
+    policy contract: the FLOP-dominant block-tier PAIR-UPDATE GEMMs (the
+    tall ``Q_w·U_rΣ`` apply and the ``V·V_r`` rotation apply) contract at
+    the policy's compute dtype with f32 accumulation; the pair QR, the
+    small (2b, 2b) SVD and the convergence Gram stay pinned float32
+    (factorisation interiors).  The scalar tier (n < 128) is always
+    float32 — below the block threshold there is no FLOP-dominant GEMM to
+    round.  Under ``bfloat16`` the convergence tolerance has a per-policy
+    floor (``5e-3``, the ``polar`` precedent) and the documented error
+    bounds are ``precision.ERROR_BOUNDS[("svd_values"|"svd_resid",
+    policy)]``.
     """
+    policy = px.resolve(precision)
     m, n = a.shape
     # Operate on the full padded backing: pad rows/cols are zero under the
     # pad-and-mask invariant, so they contribute nothing to column dot
@@ -311,8 +332,13 @@ def svd(a: Array, compute_uv: bool = True, sort: bool = True,
     # (found by the round-10 precision suite at (80, 130)); short-wide
     # inputs take the scalar tier, which has no such constraint
     if av.shape[1] >= 2 * _JACOBI_BLOCK and av.shape[0] >= 2 * _JACOBI_BLOCK:
+        # per-policy convergence floor applies HERE, where the policy
+        # rounds the pair updates (silently: the default eps=1e-6 under
+        # bfloat16 means "as converged as bf16 pair updates get"); the
+        # scalar tier below ignores the policy, so it keeps the f32 floor
+        eps = max(eps, _SVD_EPS_FLOOR.get(policy.name, 1e-6))
         u, s, v = _jacobi_svd_block(av, n, sort,
-                                    eps, max_sweeps)
+                                    eps, max_sweeps, policy)
     else:
         u, s, v = _jacobi_svd(av, n, sort, eps,
                               max_sweeps)
@@ -394,10 +420,10 @@ def _jacobi_svd(a, n_valid, sort, eps, max_sweeps):
 _JACOBI_BLOCK = 64
 
 
-@partial(_pjit, static_argnames=("n_valid", "sort", "max_sweeps"),
+@partial(_pjit, static_argnames=("n_valid", "sort", "max_sweeps", "policy"),
          name="jacobi_svd_block")
 @precise
-def _jacobi_svd_block(a, n_valid, sort, eps, max_sweeps):
+def _jacobi_svd_block(a, n_valid, sort, eps, max_sweeps, policy=px.FLOAT32):
     """One-sided BLOCK Jacobi: round-robin over column blocks of width b.
 
     Per disjoint block pair (I, J), batched over the round's pairs:
@@ -447,9 +473,14 @@ def _jacobi_svd_block(a, n_valid, sort, eps, max_sweeps):
         off_d = jnp.where(jnp.eye(2 * b, dtype=bool)[None],
                           0.0, jnp.abs(g) / denom)
         u_r, s_r, vh = jnp.linalg.svd(r)           # batched (2b, 2b) SVD
-        u_new = jnp.einsum("wmi,wij->mwj", qw, u_r * s_r[:, None, :])
+        # the two FLOP-dominant pair-update GEMMs follow the precision
+        # policy (bf16-compute / f32-accumulate when opted in); the QR,
+        # Gram and small SVD above stay pinned f32 — rounding a
+        # factorisation interior buys no FLOPs and costs stability
+        u_new = px.peinsum("wmi,wij->mwj", qw, u_r * s_r[:, None, :],
+                           policy)
         w_v = jnp.concatenate([vr[:, i], vr[:, j]], axis=-1)
-        v_new = jnp.einsum("nwi,wji->nwj", w_v, vh)              # V · V_r
+        v_new = px.peinsum("nwi,wji->nwj", w_v, vh, policy)      # V · V_r
         # a duplicated (padding) pair in a round recomputes the identical
         # q from the identical pre-round blocks — the duplicate .set
         # writes identical values (idempotent), as in the scalar tier

@@ -29,11 +29,32 @@ __all__ = ["repad_rows", "fetch", "AsyncFetch"]
 
 
 def repad_rows(a, logical: int, target: int, axis: int = 0):
-    """Re-pad snapshot state along ``axis`` for the restoring mesh: keep
-    the first ``logical`` (real) slices, zero-fill out to ``target`` (the
-    restoring mesh's padded extent).  Exact because pad slices carry zeros
-    under the pad-and-mask invariant.  Raises when the snapshot holds
-    fewer than ``logical`` slices (foreign/stale state)."""
+    """Re-pad state along ``axis`` for the restoring mesh: keep the
+    first ``logical`` (real) slices, zero-fill out to ``target`` (the
+    restoring mesh's padded extent).  Exact because pad slices carry
+    zeros under the pad-and-mask invariant.  Raises when the state holds
+    fewer than ``logical`` slices (foreign/stale snapshot).
+
+    Two routes (round-11 rechunk PR): a ``jax.Array`` input — state
+    already ON DEVICE at an elastic mesh change — re-pads in one jitted
+    kernel (``ops/rechunk.repad_axis``) and STAYS on device, no host
+    round trip; anything else takes the original host-NumPy path, kept
+    as the snapshot-restore fallback (checkpoint state arrives as host
+    ndarrays by design)."""
+    if not isinstance(a, np.ndarray):
+        import jax
+        if isinstance(a, jax.Array):
+            if a.shape[axis] < logical:
+                raise ValueError(
+                    f"snapshot state has {a.shape[axis]} rows along axis "
+                    f"{axis} but the logical state needs {logical} — stale "
+                    "or foreign snapshot")
+            if target < logical:
+                raise ValueError(
+                    f"target padded extent {target} is smaller than the "
+                    f"logical extent {logical}")
+            from dislib_tpu.ops.rechunk import repad_axis
+            return repad_axis(a, int(logical), int(target), axis)
     a = np.asarray(a)
     if a.shape[axis] < logical:
         raise ValueError(
@@ -82,6 +103,8 @@ class AsyncFetch:
             import jax
 
             from dislib_tpu.runtime.retry import Retry
+            from dislib_tpu.utils.profiling import count_transfer
+            count_transfer()
             try:
                 self._value = Retry.from_env().call(
                     lambda: np.asarray(jax.device_get(self._x)))
@@ -114,4 +137,6 @@ def fetch(x, blocking: bool = True):
     import jax
 
     from dislib_tpu.runtime.retry import Retry
+    from dislib_tpu.utils.profiling import count_transfer
+    count_transfer()
     return Retry.from_env().call(lambda: np.asarray(jax.device_get(x)))

@@ -69,13 +69,17 @@ def op_graph(fn, *args, **kwargs) -> str:
 # ---------------------------------------------------------------------------
 
 class _Counters:
-    """Process-wide dispatch/trace tallies, total and per kernel name."""
+    """Process-wide dispatch/trace/transfer tallies, total and per kernel
+    name (transfers are total-only: one per host↔device boundary crossing
+    at the blessed sync points)."""
 
-    __slots__ = ("dispatches", "traces", "dispatch_by", "trace_by")
+    __slots__ = ("dispatches", "traces", "transfers", "dispatch_by",
+                 "trace_by")
 
     def __init__(self):
         self.dispatches = 0
         self.traces = 0
+        self.transfers = 0
         self.dispatch_by: dict[str, int] = {}
         self.trace_by: dict[str, int] = {}
 
@@ -123,6 +127,22 @@ def profiled_jit(fn=None, *, name: str | None = None, **jit_kwargs):
     return dispatch
 
 
+def count_transfer(n: int = 1) -> None:
+    """Record ``n`` host↔device transfers.  Called by the library's
+    blessed sync boundaries — ``runtime.fetch``, ``Array.collect``,
+    ``Array.__float__``, the host tiers of ``apply_along_axis`` and
+    ``repad_rows`` — so "this pipeline stage boundary costs ZERO host
+    transfers" is a counter assertion, not prose (round-11 rechunk PR)."""
+    with _COUNTERS_LOCK:
+        _COUNTERS.transfers += n
+
+
+def transfer_count() -> int:
+    """Total host↔device transfers through the library's blessed sync
+    boundaries since the last `reset_counters()`."""
+    return _COUNTERS.transfers
+
+
 def dispatch_count() -> int:
     """Total library-kernel dispatches since the last `reset_counters()`."""
     return _COUNTERS.dispatches
@@ -139,6 +159,7 @@ def counters() -> dict:
     with _COUNTERS_LOCK:
         return {"dispatches": _COUNTERS.dispatches,
                 "traces": _COUNTERS.traces,
+                "transfers": _COUNTERS.transfers,
                 "dispatch_by": dict(_COUNTERS.dispatch_by),
                 "trace_by": dict(_COUNTERS.trace_by)}
 
@@ -148,6 +169,7 @@ def reset_counters() -> None:
     with _COUNTERS_LOCK:
         _COUNTERS.dispatches = 0
         _COUNTERS.traces = 0
+        _COUNTERS.transfers = 0
         _COUNTERS.dispatch_by.clear()
         _COUNTERS.trace_by.clear()
 

@@ -216,6 +216,29 @@ class TestApplyAlongAxis:
             got = ds.apply_along_axis(untraceable, 1, a).collect()
         np.testing.assert_allclose(got.ravel(), x.sum(1), rtol=1e-5)
 
+    def test_traceable_map_is_one_fused_dispatch(self, rng):
+        """Round-11 satellite: a traceable func is a fusion-graph node —
+        the whole map (and any chain feeding it) is ONE dispatch and
+        ZERO host transfers, pinned by the counters."""
+        import jax.numpy as jnp
+        from dislib_tpu.utils import profiling as prof
+        a, x = _mk(rng, (12, 7))
+        a.force()
+        prof.reset_counters()
+        got = ds.apply_along_axis(jnp.sort, 0, a * 2.0)
+        got.force()
+        assert prof.dispatch_count() == 1, prof.counters()
+        assert prof.transfer_count() == 0
+        np.testing.assert_allclose(got.collect(), np.sort(x * 2.0, axis=0),
+                                   rtol=1e-5)
+
+    def test_extra_args_thread_through(self, rng):
+        import jax.numpy as jnp
+        a, x = _mk(rng, (8, 5))
+        got = ds.apply_along_axis(jnp.quantile, 0, a, 0.5).collect()
+        np.testing.assert_allclose(got.ravel(), np.quantile(x, 0.5, axis=0),
+                                   rtol=1e-5)
+
 
 class TestMeshes:
     def test_2d_mesh(self, rng):

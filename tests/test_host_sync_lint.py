@@ -131,6 +131,71 @@ def test_no_unblessed_host_syncs_in_estimator_loops():
         + "\n  ".join(offenders))
 
 
+# ---------------------------------------------------------------------------
+# round-11 rechunk PR: host-numpy RESHARDING lint.  Estimator/pipeline
+# code may not re-pad / re-lay out array data through host numpy —
+# resharding flows through `ds.rechunk` (on-device collective) or
+# `runtime.repad_rows` (the blessed elastic boundary, which itself
+# routes device inputs on-device).  `np.pad` is the telltale spelling of
+# a host reshard; the AST scan covers WHOLE files (not just loops),
+# because a single one-shot host re-pad of a sharded operand still
+# gathers the array through the host.
+# ---------------------------------------------------------------------------
+
+# (file, enclosing function) pairs allowed to np.pad, each a HOST-side
+# ingest/serialization boundary, never a device-array reshard:
+RESHARD_ALLOWLIST = {
+    # cascade labels arrive host-side by design (SURVEY §3.3) and are
+    # padded BEFORE first device_put — ingest, not a reshard
+    ("dislib_tpu/classification/csvm.py", "fit"),
+    # adoption packs ragged per-level host copies into the model's host
+    # attrs (post-device_get serialization, not a layout move)
+    ("dislib_tpu/trees/decision_tree.py", "_pack"),
+}
+
+
+def _np_pad_calls(path):
+    """(enclosing_function, lineno) of every np.pad/numpy.pad call."""
+    tree = ast.parse(open(path, encoding="utf-8").read())
+
+    def walk(node, fname):
+        for child in ast.iter_child_nodes(node):
+            cname = fname
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cname = child.name
+            if isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr == "pad" \
+                    and isinstance(child.func.value, ast.Name) \
+                    and child.func.value.id in ("np", "numpy"):
+                yield fname, child.lineno
+            yield from walk(child, cname)
+
+    yield from walk(tree, "<module>")
+
+
+def test_no_host_numpy_resharding_in_estimators():
+    offenders = []
+    for rel, full in _estimator_files():
+        for fname, lineno in _np_pad_calls(full):
+            if (rel, fname) not in RESHARD_ALLOWLIST:
+                offenders.append(f"{rel}:{lineno} in {fname}()")
+    assert not offenders, (
+        "host-numpy resharding (np.pad) in estimator/pipeline code — "
+        "reshard through ds.rechunk (on-device collective) or "
+        "runtime.repad_rows (elastic boundary), or consciously extend "
+        "RESHARD_ALLOWLIST with a reason:\n  " + "\n  ".join(offenders))
+
+
+def test_reshard_allowlist_entries_still_exist():
+    live = set()
+    for rel, full in _estimator_files():
+        for fname, _ in _np_pad_calls(full):
+            live.add((rel, fname))
+    dead = {site for site in RESHARD_ALLOWLIST if site not in live}
+    assert not dead, f"reshard allowlist entries match no code: {dead}"
+
+
 def test_allowlist_entries_still_exist():
     """A refactor that renames or removes an allowlisted loop must prune
     the list — dead entries would quietly bless future regressions."""

@@ -184,6 +184,49 @@ class TestAccuracyBounds:
         assert resid <= px.ERROR_BOUNDS[("polar_resid", policy)], \
             f"polar {policy} cond={cond}: resid {resid:.2e}"
 
+    @pytest.mark.parametrize("policy", POLICIES)
+    @pytest.mark.parametrize("cond", CONDS)
+    def test_svd_block_tier(self, policy, cond):
+        """Round-11 satellite (ROADMAP item 5 follow-up (b)): the
+        block-Jacobi pair-update GEMMs follow the policy; values and the
+        full-factor residual hold the documented bounds on the block
+        tier (n >= 2*64 engages the column-block pairing)."""
+        m, n = 256, 160
+        x = _conditioned(m, n, cond, seed=11).astype(np.float32)
+        s_ref = np.linalg.svd(x.astype(np.float64), compute_uv=False)
+        u, s, v = ds.svd(ds.array(x), precision=policy)
+        sv = np.asarray(s.collect()).ravel()
+        uh, vh = np.asarray(u.collect()), np.asarray(v.collect())
+        val_err = np.max(np.abs(sv - s_ref) / s_ref[0])
+        resid = np.linalg.norm(x - (uh * sv) @ vh.T) / np.linalg.norm(x)
+        assert val_err <= px.ERROR_BOUNDS[("svd_values", policy)], \
+            f"svd {policy} cond={cond}: values {val_err:.2e}"
+        assert resid <= px.ERROR_BOUNDS[("svd_resid", policy)], \
+            f"svd {policy} cond={cond}: resid {resid:.2e}"
+
+    def test_svd_scalar_tier_pinned_f32(self):
+        """Below the block threshold there is no FLOP-dominant GEMM: the
+        scalar tier ignores the policy (documented), so bf16 and f32
+        requests return bit-identical factors."""
+        x = _conditioned(48, 24, 10.0, seed=12).astype(np.float32)
+        s32 = np.asarray(ds.svd(ds.array(x), compute_uv=False,
+                                precision="float32").collect())
+        sbf = np.asarray(ds.svd(ds.array(x), compute_uv=False,
+                                precision="bfloat16").collect())
+        np.testing.assert_array_equal(s32, sbf)
+
+    def test_svd_bf16_eps_floor_converges(self):
+        """The per-policy eps floor (5e-3) keeps a default-eps bf16 call
+        from burning max_sweeps chasing unreachable 1e-6 orthogonality:
+        the sweep loop must terminate well inside the budget and still
+        meet the documented bounds."""
+        x = _conditioned(256, 160, 10.0, seed=13).astype(np.float32)
+        s_ref = np.linalg.svd(x.astype(np.float64), compute_uv=False)
+        s = np.asarray(ds.svd(ds.array(x), compute_uv=False,
+                              precision="bfloat16").collect()).ravel()
+        err = np.max(np.abs(s - s_ref) / s_ref[0])
+        assert err <= px.ERROR_BOUNDS[("svd_values", "bfloat16")]
+
     def test_pca_policy_close_to_f32(self):
         rng = np.random.RandomState(8)
         x = (rng.standard_normal((512, 32))

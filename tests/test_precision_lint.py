@@ -105,12 +105,12 @@ def test_policy_module_is_the_one_scope_site():
 
 def test_public_entries_expose_precision_kwarg():
     """The paper-scale surface must actually accept the policy: matmul,
-    qr, polar, tsqr, random_svd, lanczos_svd take ``precision=`` and PCA
-    takes it as a constructor param — an entry dropping the kwarg would
-    orphan the env knob for that path."""
+    qr, polar, svd, tsqr, random_svd, lanczos_svd take ``precision=``
+    and PCA takes it as a constructor param — an entry dropping the
+    kwarg would orphan the env knob for that path."""
     import inspect
     import dislib_tpu as ds
-    for fn in (ds.matmul, ds.qr, ds.polar, ds.tsqr, ds.random_svd,
+    for fn in (ds.matmul, ds.qr, ds.polar, ds.svd, ds.tsqr, ds.random_svd,
                ds.lanczos_svd):
         assert "precision" in inspect.signature(fn).parameters, fn
     assert "precision" in inspect.signature(ds.PCA.__init__).parameters

@@ -1,0 +1,423 @@
+"""On-device collective rechunk (round-11 perf PR, ROADMAP item 4).
+
+Five pillars:
+
+1. **Bit-equivalence vs the host path** — every schedule (fused/xla,
+   panels, deviceput) over a (block_size × mesh-pair × dtype) grid,
+   float64/x64 included, must reproduce the `runtime.repad_rows` host
+   oracle EXACTLY (a reshard is pure data movement: zero rounding), and
+   leave the new pad region exactly zero.
+2. **Poisoned-pad regression** — a backing whose pad tail was corrupted
+   upstream comes out of ANY moving schedule with pads re-zeroed (the
+   round-10 `grow_canvas` discipline, extended to resharding).
+3. **Elastic resume** — on-device state re-pads for a new mesh through
+   the same primitive (`repad_rows` device route ≡ host route, bit for
+   bit), and a checkpointed fit resumes onto a different mesh unchanged.
+4. **Dispatch/transfer counters** — a mid-chain rechunk adds ZERO
+   dispatches to a fused chain; the panel exchange is ONE dispatch; a
+   mismatched-block PCA → KMeans (and scaler → CSVM) stage boundary
+   costs ZERO host transfers (counter-asserted AND jax.transfer_guard).
+5. **Ingest guard** — estimators accept arrays laid out under another
+   mesh (`ensure_canonical` re-lays out on device).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import dislib_tpu as ds
+from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.utils import profiling as prof
+from conftest import skip_unless_devices
+
+
+def _host_repad_oracle(x, logical, pshape):
+    """The reference reshard: crop to logical, zero-fill to the target
+    padded canvas — what `runtime.repad_rows` does on host, per axis."""
+    from dislib_tpu.runtime import repad_rows
+    out = repad_rows(np.asarray(x)[: logical[0], : logical[1]],
+                     logical[0], pshape[0], axis=0)
+    return repad_rows(out, logical[1], pshape[1], axis=1)
+
+
+def _mk(shape, dtype, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return rng.randint(-50, 50, size=shape).astype(dtype)
+    return rng.rand(*shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# 1. bit-equivalence grid
+# ---------------------------------------------------------------------------
+
+class TestEquivalenceGrid:
+    MESH_PAIRS = [
+        ((4, 2), (2, 4)),     # 2-D relayout, same 8 devices (panels)
+        ((8, 1), (4, 2)),     # 1-D -> 2-D, same devices (panels)
+        ((2, 2), (8, 1)),     # 4 -> 8 devices (deviceput fallback)
+        ((8, 1), (2, 1)),     # 8 -> 2 devices (shrink)
+    ]
+
+    @pytest.mark.parametrize("src,dst", MESH_PAIRS)
+    @pytest.mark.parametrize("blocks", [(7, 3), (64, 64)])
+    @pytest.mark.parametrize("dtype", [np.float32, np.int32])
+    def test_cross_mesh_bit_equal(self, src, dst, blocks, dtype):
+        skip_unless_devices(8)
+        shape = (50, 12)
+        x = _mk(shape, dtype)
+        ds.init(src)
+        a = ds.array(x, block_size=(9, 5), dtype=dtype).force()
+        ds.init(dst)
+        out = ds.rechunk(a, blocks)
+        pshape = tuple(-(-s // _mesh.pad_quantum()) * _mesh.pad_quantum()
+                       for s in shape)
+        full = np.asarray(out.force()._data)
+        assert full.shape == pshape
+        np.testing.assert_array_equal(full,
+                                      _host_repad_oracle(np.asarray(a._data),
+                                                         shape, pshape))
+        # oversized hints clamp to the logical shape (ds.array contract)
+        assert out.block_size == tuple(min(b, s)
+                                       for b, s in zip(blocks, shape))
+        assert out._data.sharding == _mesh.data_sharding()
+
+    @pytest.mark.parametrize("schedule", ["panels", "xla", "deviceput"])
+    def test_explicit_schedules_agree(self, schedule):
+        skip_unless_devices(8)
+        shape = (37, 10)
+        x = _mk(shape, np.float32, seed=3)
+        ds.init((4, 2))
+        a = ds.array(x).force()
+        ds.init((2, 4))
+        out = ds.rechunk(a, schedule=schedule).force()
+        np.testing.assert_array_equal(out.collect(), x)
+        full = np.asarray(out._data)
+        assert np.all(full[shape[0]:] == 0)
+        assert np.all(full[:, shape[1]:] == 0)
+
+    @pytest.mark.parametrize("panels", [1, 2, 8])
+    def test_panel_count_is_a_tuning_knob_not_semantics(self, panels):
+        skip_unless_devices(8)
+        shape = (48, 16)
+        x = _mk(shape, np.float32, seed=4)
+        ds.init((4, 2))
+        a = ds.array(x).force()
+        ds.init((8, 1))
+        out = ds.rechunk(a, schedule="panels", panels=panels)
+        np.testing.assert_array_equal(out.collect(), x)
+
+    def test_f64_x64_mode(self):
+        skip_unless_devices(8)
+        with jax.enable_x64(True):
+            shape = (21, 9)
+            x = _mk(shape, np.float64, seed=5)
+            ds.init((4, 2))
+            a = ds.array(x, dtype=np.float64).force()
+            assert a.dtype == np.float64
+            ds.init((2, 4))
+            out = ds.rechunk(a)
+            assert out.dtype == np.float64
+            np.testing.assert_array_equal(out.collect(), x)
+
+    def test_same_mesh_is_metadata_only(self):
+        x = _mk((20, 8), np.float32)
+        a = ds.array(x, block_size=(6, 4)).force()
+        b = ds.rechunk(a, (5, 2))
+        assert b._concrete is a._concrete          # zero data movement
+        assert b.block_size == (5, 2)
+        c = a.rechunk((3, 3))                      # method parity
+        assert c._concrete is a._concrete and c.block_size == (3, 3)
+
+    def test_sparse_array_rejected_with_clear_error(self):
+        from dislib_tpu.data.sparse import SparseArray
+        import scipy.sparse as sp
+        s = SparseArray.from_scipy(sp.random(8, 8, 0.5, format="csr",
+                                             random_state=0))
+        with pytest.raises(TypeError, match="dense ds-array"):
+            ds.rechunk(s)
+
+
+# ---------------------------------------------------------------------------
+# 2. poisoned-pad regression
+# ---------------------------------------------------------------------------
+
+class TestPoisonedPad:
+    def _poisoned(self, shape=(20, 6)):
+        x = _mk(shape, np.float32, seed=7)
+        a = ds.array(x).force()
+        bad = a._data.at[shape[0]:, :].set(jnp.nan) \
+                     .at[:, shape[1]:].set(jnp.inf)
+        from dislib_tpu.data.array import Array
+        return Array(bad, shape), x
+
+    def test_fused_requantize_rezeroes(self):
+        a, x = self._poisoned()
+        out = ds.rechunk(a, schedule="xla").force()
+        full = np.asarray(out._data)
+        np.testing.assert_array_equal(full[:20, :6], x)
+        assert np.all(full[20:] == 0) and np.all(full[:, 6:] == 0)
+
+    def test_panel_exchange_rezeroes(self):
+        skip_unless_devices(8)
+        ds.init((4, 2))
+        a, x = self._poisoned()
+        ds.init((2, 4))
+        out = ds.rechunk(a, schedule="panels")
+        full = np.asarray(out._data)
+        np.testing.assert_array_equal(full[:20, :6], x)
+        assert np.all(full[20:] == 0) and np.all(full[:, 6:] == 0)
+
+    def test_deviceput_rezeroes(self):
+        skip_unless_devices(8)
+        ds.init((2, 2))
+        a, x = self._poisoned()
+        ds.init((8, 1))
+        out = ds.rechunk(a, schedule="deviceput")
+        full = np.asarray(out._data)
+        np.testing.assert_array_equal(full[:20, :6], x)
+        assert np.all(full[20:] == 0) and np.all(full[:, 6:] == 0)
+
+
+# ---------------------------------------------------------------------------
+# 3. elastic resume
+# ---------------------------------------------------------------------------
+
+class TestElasticOnDevice:
+    def test_repad_rows_device_route_equals_host_route(self):
+        skip_unless_devices(8)
+        from dislib_tpu.runtime import repad_rows
+        ds.init((8, 1))
+        state = ds.random_array((30, 16), random_state=0).force()._data
+        dev = repad_rows(state, 30, 40)
+        host = repad_rows(np.asarray(state), 30, 40)
+        assert isinstance(dev, jax.Array)          # stayed on device
+        np.testing.assert_array_equal(np.asarray(dev), host)
+        # axis=1, and the validation contract matches the host path's
+        dev1 = repad_rows(state.T, 30, 33, axis=1)
+        np.testing.assert_array_equal(np.asarray(dev1),
+                                      repad_rows(np.asarray(state).T, 30, 33,
+                                                 axis=1))
+        with pytest.raises(ValueError, match="stale or foreign"):
+            repad_rows(state, 100, 120)
+        with pytest.raises(ValueError, match="smaller than the logical"):
+            repad_rows(state, 30, 20)
+
+    def test_on_device_state_reshards_for_new_mesh(self):
+        """The elastic scenario the host path can't serve without a
+        round trip: live device state at a mesh change."""
+        skip_unless_devices(8)
+        ds.init((8, 1))
+        a = ds.random_array((40, 12), random_state=1).force()
+        ref = a.collect()
+        prof.reset_counters()
+        ds.init((2, 2))
+        out = ds.rechunk(a)
+        np.testing.assert_array_equal(out.collect(), ref)
+        assert prof.transfer_count() == 1          # only the final collect
+
+    def test_checkpointed_fit_resumes_on_different_mesh(self, tmp_path):
+        skip_unless_devices(8)
+        from dislib_tpu.utils.checkpoint import FitCheckpoint
+        x = _mk((64, 6), np.float32, seed=9)
+        ds.init((8, 1))
+        ref = ds.cluster.KMeans(n_clusters=3, max_iter=8, random_state=0) \
+            .fit(ds.array(x)).centers_
+        ckpt = FitCheckpoint(str(tmp_path / "km"), every=4)
+        km = ds.cluster.KMeans(n_clusters=3, max_iter=4, random_state=0)
+        km.fit(ds.array(x), checkpoint=ckpt)       # first 4 iterations
+        ds.init((2, 2))                            # elastic mesh change
+        km2 = ds.cluster.KMeans(n_clusters=3, max_iter=8, random_state=0)
+        km2.fit(ds.array(x), checkpoint=FitCheckpoint(str(tmp_path / "km"),
+                                                      every=4))
+        np.testing.assert_allclose(km2.centers_, ref, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 4. dispatch / transfer counters
+# ---------------------------------------------------------------------------
+
+class TestCounters:
+    def test_mid_chain_rechunk_costs_zero_extra_dispatches(self):
+        """schedule="xla" pins the claim for a REAL rechunk node on the
+        graph; the auto metadata fast-path (same pshape → shared expr,
+        no node at all) is asserted separately — both forms of "zero
+        extra dispatches", the second vacuously (review-found: the gate
+        must not rely on the vacuous form alone)."""
+        x = _mk((32, 8), np.float32, seed=11)
+        a = ds.array(x).force()
+        prof.reset_counters()
+        y = (a * 2.0 - 1.0)
+        y = ds.rechunk(y, (16, 4), schedule="xla")   # a genuine node
+        assert y.is_lazy
+        y = (y + 0.5).T
+        y.force()
+        assert prof.dispatch_count() == 1, prof.counters()
+        np.testing.assert_allclose(y.collect(), ((x * 2.0 - 1.0) + 0.5).T,
+                                   rtol=1e-6)
+        # auto fast-path: block-hint-only rechunk shares the pending
+        # expression (no node, no force, no dispatch)
+        prof.reset_counters()
+        z = ds.rechunk(a * 2.0, (16, 4))
+        assert z.is_lazy and prof.dispatch_count() == 0
+
+    def test_ensure_canonical_requantizes_stale_lazy_chain(self):
+        """Review-found repro: a lazy chain built under an old quantum
+        must not reach a shard_map kernel with its stale canvas — the
+        ingest guard appends the fused requantize node."""
+        skip_unless_devices(8)
+        ds.init((4, 2))                    # quantum 4
+        x = _mk((12, 12), np.float32, seed=16)
+        a = ds.array(x).force()
+        c = a * 2.0                        # lazy, canvas (12, 12)
+        ds.init((8, 1))                    # quantum 8
+        cc = ds.ensure_canonical(c)
+        assert cc.is_lazy                  # still on the fusion graph
+        assert cc._pshape == (16, 16)
+        np.testing.assert_allclose(cc.collect(), x * 2.0, rtol=1e-6)
+        full = np.asarray(cc._data)
+        assert np.all(full[12:] == 0) and np.all(full[:, 12:] == 0)
+
+    def test_summa_accepts_stale_lazy_operands(self):
+        """The deleted post-force repad guard's job, now done by
+        ensure_canonical: SUMMA over a LAZY chain whose canvas was built
+        under an older quantum (10 under (2,1); the (4,2) grid needs 12)
+        must requantize instead of crashing the shard_map row/col
+        split (review-found repro)."""
+        skip_unless_devices(8)
+        ds.init((4, 2))                    # quantum 4 → (12, 12) canvas
+        x = _mk((12, 12), np.float32, seed=17)
+        a = ds.array(x).force()
+        c = a * 2.0                        # lazy, stale (12, 12) canvas
+        ds.init((8, 1))                    # quantum 8: 12 % 8 != 0; same
+        b = ds.array(x)                    # device SET (a lazy chain can
+        # only force onto the devices its leaves live on — a device-SET
+        # change with a pending chain is a pre-existing fusion-layer
+        # limit, unchanged by this PR: force before re-initing the mesh)
+        out = ds.matmul(c, b, algorithm="summa")
+        np.testing.assert_allclose(out.collect(), (x * 2.0) @ x,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_panel_exchange_is_one_dispatch(self):
+        skip_unless_devices(8)
+        ds.init((4, 2))
+        a = ds.random_array((48, 16), random_state=2).force()
+        ds.init((2, 4))
+        ds.rechunk(a, schedule="panels")           # warm/compile
+        b = ds.rechunk(a.copy(), schedule="panels")  # cached program
+        prof.reset_counters()
+        ds.rechunk(a, schedule="panels")
+        assert prof.dispatch_count() == 1, prof.counters()
+        assert prof.transfer_count() == 0
+        del b
+
+    def test_rechunk_fuses_into_estimator_predict(self):
+        """A rechunk between a scaler and a predict kernel still yields
+        the serving contract: ONE dispatch end to end."""
+        x = _mk((40, 6), np.float32, seed=12)
+        a = ds.array(x).force()
+        km = ds.cluster.KMeans(n_clusters=3, max_iter=3, random_state=0)
+        km.fit(a)
+        sc = ds.preprocessing.StandardScaler().fit(a)
+        km.predict(ds.rechunk(sc.transform(a), (8, 6))).force()  # warm
+        prof.reset_counters()
+        km.predict(ds.rechunk(sc.transform(a), (8, 6))).force()
+        assert prof.dispatch_count() == 1, prof.counters()
+
+
+class TestPipelineStageBoundaries:
+    """The acceptance rows: mismatched block sizes between stages cost
+    ZERO host transfers at the boundary — counter-asserted and enforced
+    by jax's own transfer guard around the boundary region."""
+
+    def test_pca_to_kmeans_zero_host_transfers(self):
+        skip_unless_devices(8)
+        ds.init((4, 2))
+        x = _mk((96, 16), np.float32, seed=13)
+        a = ds.array(x, block_size=(90, 16))       # stage-1 block size
+        pca = ds.PCA(n_components=8).fit(a)
+        prof.reset_counters()
+        with jax.transfer_guard("disallow"):
+            t = pca.transform(a)                   # inherits matmul blocks
+            t2 = ds.rechunk(t, (32, 8))            # stage-2 block size
+            t2.force()
+        assert prof.transfer_count() == 0, prof.counters()
+        km = ds.cluster.KMeans(n_clusters=4, max_iter=3, random_state=0)
+        km.fit(t2)                                 # stage 2 runs fine
+        assert km.centers_.shape == (4, 8)
+
+    def test_scaler_to_csvm_zero_host_transfers(self):
+        skip_unless_devices(8)
+        ds.init((4, 2))
+        rng = np.random.RandomState(14)
+        x = np.vstack([rng.randn(40, 5) + 2, rng.randn(40, 5) - 2]) \
+            .astype(np.float32)
+        y = np.r_[np.ones(40), np.zeros(40)].astype(np.float32)
+        a = ds.array(x, block_size=(33, 5))
+        sc = ds.preprocessing.StandardScaler().fit(a)
+        sc.transform(a).force()    # warm: builds the scaler's device-side
+        prof.reset_counters()      # scale cache (a one-time scalar upload)
+        with jax.transfer_guard("disallow"):
+            t = ds.rechunk(sc.transform(a), (16, 5))
+            t.force()
+        assert prof.transfer_count() == 0, prof.counters()
+        svm = ds.classification.CascadeSVM(max_iter=2, random_state=0)
+        svm.fit(t, ds.array(y.reshape(-1, 1), block_size=(16, 1)))
+        assert svm.score(t, ds.array(y.reshape(-1, 1))) > 0.8
+
+    def test_cross_mesh_boundary_stays_on_device(self):
+        """Stage-1 output computed under an OLD mesh feeds stage 2 after
+        an elastic mesh change: the reshard is collective, not a host
+        hop."""
+        skip_unless_devices(8)
+        ds.init((8, 1))
+        x = _mk((64, 8), np.float32, seed=15)
+        a = ds.array(x)
+        sc = ds.preprocessing.StandardScaler().fit(a)
+        t = sc.transform(a).force()
+        ds.init((4, 2))
+        prof.reset_counters()
+        t2 = ds.rechunk(t, (16, 8))
+        t2.force()
+        assert prof.transfer_count() == 0, prof.counters()
+        km = ds.cluster.KMeans(n_clusters=3, max_iter=3, random_state=0)
+        km.fit(t2)
+        assert np.isfinite(km.inertia_)
+
+
+# ---------------------------------------------------------------------------
+# 5. ingest guard
+# ---------------------------------------------------------------------------
+
+class TestEnsureCanonical:
+    def test_noop_on_canonical(self):
+        a = ds.random_array((24, 8), random_state=3).force()
+        assert ds.ensure_canonical(a) is a
+
+    def test_relayouts_foreign_backing(self):
+        skip_unless_devices(8)
+        ds.init((4, 2))
+        a = ds.random_array((24, 8), random_state=4).force()
+        ref = a.collect()
+        ds.init((8, 1))
+        b = ds.ensure_canonical(a)
+        assert b is not a
+        assert tuple(b._data.shape) == (24, 8)
+        assert b._data.sharding == _mesh.data_sharding()
+        np.testing.assert_array_equal(b.collect(), ref)
+
+    def test_ring_estimator_accepts_foreign_mesh_input(self):
+        """DBSCAN's ring tier shard_maps rows over the mesh — an input
+        built under another mesh must re-lay out, not crash."""
+        skip_unless_devices(8)
+        rng = np.random.RandomState(5)
+        x = np.vstack([rng.randn(30, 2), rng.randn(30, 2) + 10]) \
+            .astype(np.float32)
+        ds.init((4, 2))
+        a = ds.array(x).force()
+        ds.init((8, 1))
+        labels = ds.cluster.DBSCAN(eps=2.0, min_samples=3).fit_predict(a)
+        lab = labels.collect().ravel()
+        assert len(set(lab[lab >= 0])) == 2
