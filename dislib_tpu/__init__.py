@@ -56,7 +56,8 @@ from dislib_tpu.data.array import (
 )
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
-    QuarantineReport, last_quarantine_report,
+    QuarantineLedger, QuarantineReport, last_quarantine_report,
+    quarantine_ledger,
 )
 from dislib_tpu.data.sparse import SparseArray
 from dislib_tpu.math import matmul, kron, svd, qr, polar
@@ -74,7 +75,8 @@ from dislib_tpu import cluster, classification, regression, neighbors, \
 # estimator classes re-exported at top level so every name in the SURVEY §8
 # parity contract is importable from `dislib_tpu` directly (their canonical
 # homes stay the reference-parity submodules above)
-from dislib_tpu.cluster import KMeans, GaussianMixture, DBSCAN, Daura
+from dislib_tpu.cluster import (KMeans, MiniBatchKMeans, GaussianMixture,
+                                DBSCAN, Daura)
 from dislib_tpu.classification import CascadeSVM, KNeighborsClassifier
 from dislib_tpu.trees import (
     RandomForestClassifier, RandomForestRegressor,
@@ -101,7 +103,7 @@ __all__ = [
     "matmul", "kron", "svd", "qr", "polar",
     "tsqr", "random_svd", "lanczos_svd", "PCA",
     "shuffle", "train_test_split", "save_model", "load_model",
-    "KMeans", "GaussianMixture", "DBSCAN", "Daura",
+    "KMeans", "MiniBatchKMeans", "GaussianMixture", "DBSCAN", "Daura",
     "CascadeSVM", "KNeighborsClassifier",
     "RandomForestClassifier", "RandomForestRegressor",
     "DecisionTreeClassifier", "DecisionTreeRegressor",

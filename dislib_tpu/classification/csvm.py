@@ -54,10 +54,8 @@ from dislib_tpu.data.array import Array, _repad, fused_kernel
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
-from dislib_tpu.runtime import fetch as _fetch, \
-    preemption_requested as _preemption_requested, \
-    raise_if_preempted as _raise_if_preempted
-from dislib_tpu.runtime import health as _health
+from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.runtime import fitloop as _fitloop
 
 
 class CascadeSVM(BaseEstimator):
@@ -174,10 +172,8 @@ class CascadeSVM(BaseEstimator):
         nodes0 = _pack_nodes([np.arange(s, min(s + part, m))
                               for s in range(0, m, part)])
 
-        sv_idx = None            # global SV indices from previous iteration
-        last_w = None
+        box = {"sv_idx": None, "last_w": None}
         self.converged_ = False
-        it = 0
         fp = digest = None
         if checkpoint is not None:
             # fingerprint of everything the fed-back SV state depends on —
@@ -209,33 +205,39 @@ class CascadeSVM(BaseEstimator):
                 # 2^24 rows (a plain f32 iota collides adjacent indices)
                 from dislib_tpu.utils.checkpoint import digest_sums
                 x_sum, x_rowsum = digest_sums(xv)
-            from dislib_tpu.utils.checkpoint import versioned_digest
+            from dislib_tpu.utils.checkpoint import versioned_digest, \
+                validate_snapshot
             digest = versioned_digest(
                 x_sum, x_rowsum, float(y_pm.sum()),
                 float(y_pm @ np.arange(m, dtype=np.float64)))
-            snap = checkpoint.load()
-            if snap is not None:
-                from dislib_tpu.utils.checkpoint import validate_snapshot
-                validate_snapshot(snap, fp, digest)
-                sv_idx = np.asarray(snap["sv_idx"], np.int64)
-                self._sv_alpha = np.asarray(snap["sv_alpha"], np.float32)
-                last_w = float(snap["last_w"])
-                it = int(snap["n_iter"])
-                # a converged snapshot only short-circuits when THIS fit
-                # also checks convergence — resuming with
-                # check_convergence=False means "run the iterations"
-                self.converged_ = bool(snap["converged"]) \
-                    and self.check_convergence
-        guard = _health.guard("csvm", health, checkpoint)
-        start_it = it
-        it = start_it
-        while it < self.max_iter and not self.converged_:
-            nxt = it + 1
-            guard.admit()               # chunk counter (state is host-side)
-            if sv_idx is not None and len(sv_idx):
+        loop = _fitloop.ChunkedFitLoop(
+            "csvm", checkpoint=checkpoint, health=health,
+            max_iter=self.max_iter, chunk_iters=1,
+            save_every=checkpoint.every if checkpoint is not None else 1)
+
+        def init(rem):
+            box.update(sv_idx=None, sv_alpha=None, last_w=None)
+            return _fitloop.LoopState(())   # state is host-side
+
+        def restore(snap, rem):
+            validate_snapshot(snap, fp, digest)
+            box["sv_idx"] = np.asarray(snap["sv_idx"], np.int64)
+            box["sv_alpha"] = np.asarray(snap["sv_alpha"], np.float32)
+            box["last_w"] = float(snap["last_w"])
+            # a converged snapshot only short-circuits when THIS fit also
+            # checks convergence — resuming with check_convergence=False
+            # means "run the iterations"
+            return _fitloop.LoopState((), it=int(snap["n_iter"]),
+                                      done=bool(snap["converged"])
+                                      and self.check_convergence)
+
+        def step(st, chunk):
+            it = st.it + 1
+            if box["sv_idx"] is not None and len(box["sv_idx"]):
                 # feed global SVs back into every level-0 partition
                 # (dedupe: a partition may already own some of them)
-                rows = [np.unique(np.r_[nodes0[i][nodes0[i] >= 0], sv_idx])
+                rows = [np.unique(np.r_[nodes0[i][nodes0[i] >= 0],
+                                        box["sv_idx"]])
                         for i in range(nodes0.shape[0])]
                 nodes = _pack_nodes(rows)
             else:
@@ -252,79 +254,56 @@ class CascadeSVM(BaseEstimator):
                 nodes = self._merge_level(nodes, np.asarray(alphas))
             # top node: global SVs + dual objective
             top_idx, top_alpha = nodes[0], np.asarray(alphas[0])
-            verdict = guard.check_host(
-                {"sv_alpha": top_alpha, "objective": np.asarray(objs[0])},
-                it=nxt)
-            if not verdict.ok:
-                rem = guard.remediate(verdict, it=nxt)
-                del rem                 # no damping/reseed knob: pure retry
-                snap = checkpoint.load()
-                if snap is not None:    # last-good generation (gated writes)
-                    sv_idx = np.asarray(snap["sv_idx"], np.int64)
-                    self._sv_alpha = np.asarray(snap["sv_alpha"], np.float32)
-                    last_w = float(snap["last_w"])
-                    it = int(snap["n_iter"])
-                    self.converged_ = bool(snap["converged"]) \
-                        and self.check_convergence
-                else:                   # nothing written yet: from scratch
-                    sv_idx, last_w, it = None, None, start_it
-                continue
-            it = nxt
-            keep = (top_alpha > 1e-8) & (top_idx >= 0)
-            if not keep.any():
-                # degenerate solve (tiny C / degenerate data): an empty SV
-                # set would make decision_function identically 0 — keep the
-                # max-α sample so the model stays usable, and say so
-                import warnings
-                warnings.warn("CascadeSVM: no support vector exceeded "
-                              "alpha=1e-8; retaining the max-alpha sample",
-                              RuntimeWarning, stacklevel=2)
-                keep = np.zeros_like(keep)
-                keep[int(np.argmax(np.where(top_idx >= 0, top_alpha,
-                                            -np.inf)))] = True
-            sv_idx = top_idx[keep]
-            self._sv_alpha = top_alpha[keep].astype(np.float32)
-            w = float(objs[0])       # top node's dual objective (same solve)
-            from dislib_tpu.utils.dlog import verbose_logger
-            verbose_logger("csvm", self.verbose).info(
-                "iter %d: W=%.6f, SVs=%d", it, w, len(sv_idx))
-            def _snap():
-                # host-side state already — the async offload moves the
-                # checksum+atomic write off the cascade's critical path;
-                # the write is GATED on this iteration's health verdict
-                guard.save_async(checkpoint,
-                                 {"sv_idx": np.asarray(sv_idx, np.int64),
-                                  "sv_alpha": self._sv_alpha,
-                                  "last_w": w, "n_iter": it, "fp": fp,
-                                  "digest": digest,
-                                  "converged": self.converged_})
 
-            if self.check_convergence and last_w is not None:
-                if abs(w - last_w) <= self.tol * max(abs(w), 1e-12):
-                    self.converged_ = True
-                    last_w = w
-                    if checkpoint is not None:
-                        _snap()
-                    break
-            last_w = w
-            if checkpoint is not None:
-                if (it - start_it) % checkpoint.every == 0:
-                    _snap()
-                    if it < self.max_iter:
-                        _raise_if_preempted(checkpoint)
-                elif it < self.max_iter and _preemption_requested():
-                    # preemption notice with iterations left: snapshot
-                    # THIS iteration's state (off the `every` boundary)
-                    # and raise cleanly between cascade iterations, never
-                    # mid-solve — the if/elif keeps a boundary+preempt
-                    # iteration from snapshotting twice and rotating the
-                    # distinct previous generation away
-                    _snap()
-                    _raise_if_preempted(checkpoint)
+            def commit():
+                # deferred behind the verdict: a faulted iteration (or the
+                # typed raise with no rollback budget left) must never
+                # leave its values in the box/attrs — a refit that raises
+                # keeps the previously fitted model usable
+                keep = (top_alpha > 1e-8) & (top_idx >= 0)
+                if not keep.any():
+                    # degenerate solve (tiny C / degenerate data): an
+                    # empty SV set would make decision_function
+                    # identically 0 — keep the max-α sample so the model
+                    # stays usable, and say so
+                    import warnings
+                    warnings.warn("CascadeSVM: no support vector exceeded "
+                                  "alpha=1e-8; retaining the max-alpha "
+                                  "sample", RuntimeWarning, stacklevel=2)
+                    keep[:] = False
+                    keep[int(np.argmax(np.where(top_idx >= 0, top_alpha,
+                                                -np.inf)))] = True
+                w = float(objs[0])   # top node's dual objective (same solve)
+                done = bool(self.check_convergence
+                            and box["last_w"] is not None
+                            and abs(w - box["last_w"])
+                            <= self.tol * max(abs(w), 1e-12))
+                box.update(sv_idx=top_idx[keep], last_w=w,
+                           sv_alpha=top_alpha[keep].astype(np.float32))
+                from dislib_tpu.utils.dlog import verbose_logger
+                verbose_logger("csvm", self.verbose).info(
+                    "iter %d: W=%.6f, SVs=%d", it, w, len(box["sv_idx"]))
+                return _fitloop.LoopState((), it, done)
 
-        if checkpoint is not None:
-            checkpoint.flush()
-        self.iterations_n = self.n_iter_ = it
+            return _fitloop.ChunkOutcome(
+                commit, host_values={"sv_alpha": top_alpha,
+                                     "objective": np.asarray(objs[0])})
+
+        def snapshot(st):
+            # host-side state already — the async offload moves the
+            # checksum+atomic write off the cascade's critical path
+            return {"sv_idx": np.asarray(box["sv_idx"], np.int64),
+                    "sv_alpha": box["sv_alpha"],
+                    "last_w": box["last_w"], "n_iter": st.it, "fp": fp,
+                    "digest": digest, "converged": st.done}
+
+        st = loop.run(init=init, step=step, restore=restore,
+                      snapshot=snapshot)
+        self.iterations_n = self.n_iter_ = st.it
+        self.converged_ = st.done
+        self._sv_alpha = box["sv_alpha"]
+        self.fit_info_ = loop.info
+        sv_idx = box["sv_idx"]
         self._sv_idx = sv_idx
         # gather SV rows only (n_sv × n, never the dataset): from the host
         # CSR on the sparse path, on device for dense inputs

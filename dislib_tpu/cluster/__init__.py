@@ -1,6 +1,8 @@
 from dislib_tpu.cluster.kmeans import KMeans
+from dislib_tpu.cluster.minibatch import MiniBatchKMeans
 from dislib_tpu.cluster.gm import GaussianMixture
 from dislib_tpu.cluster.dbscan import DBSCAN
 from dislib_tpu.cluster.daura import Daura
 
-__all__ = ["KMeans", "GaussianMixture", "DBSCAN", "Daura"]
+__all__ = ["KMeans", "MiniBatchKMeans", "GaussianMixture", "DBSCAN",
+           "Daura"]

@@ -33,8 +33,8 @@ from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
 from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
 from dislib_tpu.parallel import mesh as _mesh
-from dislib_tpu.runtime import fetch as _fetch, \
-    raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.runtime import fitloop as _fitloop
 from dislib_tpu.runtime import health as _health
 
 # padded frame counts above this stream the RMSD adjacency in tiles
@@ -85,25 +85,29 @@ class Daura(BaseEstimator):
         # ring-tier shard_map splits rows over the mesh — an input built
         # under another mesh re-lays out on device (never a host hop)
         x = _ensure_canonical(x)
-        guard = _health.guard("daura", health, checkpoint)
         if checkpoint is not None:
             labels, medoids = self._fit_checkpointed(x, n_atoms, checkpoint,
-                                                     mesh, guard)
+                                                     mesh, health)
         else:
-            guard.admit()
-            if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
-                labels, medoids, hvec = _daura_fit_ring(
-                    x._data, x.shape, float(self.cutoff), n_atoms, mesh)
-            elif x._data.shape[0] <= _DENSE_MAX:
-                labels, medoids, hvec = _daura_fit(
-                    x._data, x.shape, float(self.cutoff), n_atoms)
-            else:
-                labels, medoids, hvec = _daura_fit_tiled(
-                    x._data, x.shape, float(self.cutoff), n_atoms,
-                    _tiled.TILE)
-            verdict = guard.check(hvec, it=0)
-            if not verdict.ok:
-                guard.remediate(verdict, it=0)  # input faults: typed raise
+            def step(st, chunk):
+                if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
+                    labels, medoids, hvec = _daura_fit_ring(
+                        x._data, x.shape, float(self.cutoff), n_atoms, mesh)
+                elif x._data.shape[0] <= _DENSE_MAX:
+                    labels, medoids, hvec = _daura_fit(
+                        x._data, x.shape, float(self.cutoff), n_atoms)
+                else:
+                    labels, medoids, hvec = _daura_fit_tiled(
+                        x._data, x.shape, float(self.cutoff), n_atoms,
+                        _tiled.TILE)
+                return _fitloop.ChunkOutcome(
+                    _fitloop.LoopState((), 0, True, extra=(labels, medoids)),
+                    hvec=hvec)      # input faults: typed raise via the loop
+
+            loop = _fitloop.ChunkedFitLoop("daura", health=health)
+            st = loop.run(init=lambda rem: _fitloop.LoopState(()), step=step)
+            self.fit_info_ = loop.info
+            labels, medoids = st.extra
         labels = np.asarray(jax.device_get(labels))[: x.shape[0]]
         medoids = np.asarray(jax.device_get(medoids))
         self.labels_ = labels.astype(np.int64)
@@ -122,7 +126,7 @@ class Daura(BaseEstimator):
                                           (x.shape[0], 1))
 
     def _fit_checkpointed(self, x: Array, n_atoms, checkpoint, mesh,
-                          guard=None):
+                          health=None):
         """Chunked fit: `every` cluster extractions per dispatch, the
         greedy state snapshotted between chunks.  The ring tier is picked
         by the same policy as the plain fit (scale-out + fault tolerance
@@ -149,53 +153,49 @@ class Daura(BaseEstimator):
                     labels, medoids, cid, max_new=checkpoint.every)
         fp = np.asarray([x.shape[0], x.shape[1], cutoff, mp], np.float64)
         digest = data_digest(x._data)
-        if guard is None:
-            guard = _health.guard("daura", None, checkpoint)
+        loop = _fitloop.ChunkedFitLoop("daura", checkpoint=checkpoint,
+                                       health=health)
 
-        def _reset():
-            return (jnp.arange(mp, dtype=jnp.int32) < x.shape[0],
-                    jnp.full((mp,), -1, jnp.int32),
-                    jnp.full((mp,), -1, jnp.int32), jnp.int32(0))
+        def init(rem):
+            return _fitloop.LoopState(
+                (jnp.full((mp,), -1, jnp.int32),),
+                extra=(jnp.arange(mp, dtype=jnp.int32) < x.shape[0],
+                       jnp.full((mp,), -1, jnp.int32), jnp.int32(0)))
 
-        snap = checkpoint.load()
-        if snap is not None:
+        def restore(snap, rem):
             validate_snapshot(snap, fp, digest)
-            active = jnp.asarray(snap["active"])
-            labels = jnp.asarray(snap["labels"])
-            medoids = jnp.asarray(snap["medoids"])
-            cid = jnp.int32(int(snap["cid"]))
-        else:
-            active, labels, medoids, cid = _reset()
-        while True:
-            (labels,) = guard.admit(labels)
+            return _fitloop.LoopState(
+                (jnp.asarray(snap["labels"]),),
+                extra=(jnp.asarray(snap["active"]),
+                       jnp.asarray(snap["medoids"]),
+                       jnp.int32(int(snap["cid"]))))
+
+        def step(st, chunk):
+            (labels,) = st.carries
+            active, medoids, cid = st.extra
             active, labels, medoids, cid, hvec = extract(active, labels,
                                                          medoids, cid)
-            verdict = guard.check(hvec)     # watchdogged chunk force point
-            if not verdict.ok:
-                guard.remediate(verdict)    # input faults: typed raise
-                snap = checkpoint.load()    # recoverable trip: last good
-                if snap is not None:
-                    active = jnp.asarray(snap["active"])
-                    labels = jnp.asarray(snap["labels"])
-                    medoids = jnp.asarray(snap["medoids"])
-                    cid = jnp.int32(int(snap["cid"]))
-                else:
-                    active, labels, medoids, cid = _reset()
-                continue
-            done = not bool(_fetch(jnp.any(active)))
+            # state deferred: the watchdogged hvec read (the chunk force
+            # point) precedes the active-set convergence fetch
+            return _fitloop.ChunkOutcome(
+                lambda: _fitloop.LoopState(
+                    (labels,), st.it + 1,
+                    not bool(_fetch(jnp.any(active))),
+                    extra=(active, medoids, cid)),
+                hvec=hvec)
+
+        def snapshot(st):
             # blocking fetches (the round's own sync), async file write —
-            # the checksum+atomic rename overlaps the next extract round;
-            # the write is GATED on this chunk's health verdict
-            guard.save_async(checkpoint, {"active": _fetch(active),
-                                          "labels": _fetch(labels),
-                                          "medoids": _fetch(medoids),
-                                          "cid": int(_fetch(cid)),
-                                          "fp": fp, "digest": digest})
-            if done:
-                break
-            _raise_if_preempted(checkpoint)
-        checkpoint.flush()
-        return labels, medoids
+            # the checksum+atomic rename overlaps the next extract round
+            active, medoids, cid = st.extra
+            return {"active": _fetch(active), "labels": _fetch(st.carries[0]),
+                    "medoids": _fetch(medoids), "cid": int(_fetch(cid)),
+                    "fp": fp, "digest": digest}
+
+        st = loop.run(init=init, step=step, restore=restore,
+                      snapshot=snapshot)
+        self.fit_info_ = loop.info
+        return st.carries[0], st.extra[1]
 
 
 @partial(jax.jit, static_argnames=("shape", "n_atoms"))

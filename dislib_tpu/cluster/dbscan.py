@@ -49,8 +49,8 @@ from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
 from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
 from dislib_tpu.parallel import mesh as _mesh
-from dislib_tpu.runtime import fetch as _fetch, \
-    raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.runtime import fitloop as _fitloop
 from dislib_tpu.runtime import health as _health
 
 # padded row counts above this stream the adjacency in tiles instead of
@@ -108,26 +108,30 @@ class DBSCAN(BaseEstimator):
         # ring-tier shard_map splits rows over the mesh — an input built
         # under another mesh re-lays out on device (never a host hop)
         x = _ensure_canonical(x)
-        guard = _health.guard("dbscan", health, checkpoint)
         if checkpoint is not None:
-            raw, core = self._fit_checkpointed(x, checkpoint, mesh, guard)
+            raw, core = self._fit_checkpointed(x, checkpoint, mesh, health)
         else:
-            guard.admit()
-            if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
-                raw, core, hvec = _dbscan_fit_ring(
-                    x._data, x.shape, float(self.eps),
-                    int(self.min_samples), mesh)
-            elif x._data.shape[0] <= _DENSE_MAX:
-                raw, core, hvec = _dbscan_fit(x._data, x.shape,
-                                              float(self.eps),
-                                              int(self.min_samples))
-            else:
-                raw, core, hvec = _dbscan_fit_tiled(
-                    x._data, x.shape, float(self.eps),
-                    int(self.min_samples), _tiled.TILE)
-            verdict = guard.check(hvec, it=0)
-            if not verdict.ok:
-                guard.remediate(verdict, it=0)  # input faults: typed raise
+            def step(st, chunk):
+                if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
+                    raw, core, hvec = _dbscan_fit_ring(
+                        x._data, x.shape, float(self.eps),
+                        int(self.min_samples), mesh)
+                elif x._data.shape[0] <= _DENSE_MAX:
+                    raw, core, hvec = _dbscan_fit(x._data, x.shape,
+                                                  float(self.eps),
+                                                  int(self.min_samples))
+                else:
+                    raw, core, hvec = _dbscan_fit_tiled(
+                        x._data, x.shape, float(self.eps),
+                        int(self.min_samples), _tiled.TILE)
+                return _fitloop.ChunkOutcome(
+                    _fitloop.LoopState((), 0, True, extra=(raw, core)),
+                    hvec=hvec)      # input faults: typed raise via the loop
+
+            loop = _fitloop.ChunkedFitLoop("dbscan", health=health)
+            st = loop.run(init=lambda rem: _fitloop.LoopState(()), step=step)
+            self.fit_info_ = loop.info
+            raw, core = st.extra
         raw = np.asarray(jax.device_get(raw))[: x.shape[0]]
         core = np.asarray(jax.device_get(core))[: x.shape[0]]
         # renumber root labels compactly in order of first appearance
@@ -150,7 +154,7 @@ class DBSCAN(BaseEstimator):
         return Array._from_logical_padded(_repad(lab, (x.shape[0], 1)),
                                           (x.shape[0], 1))
 
-    def _fit_checkpointed(self, x: Array, checkpoint, mesh, guard=None):
+    def _fit_checkpointed(self, x: Array, checkpoint, mesh, health=None):
         """Chunked fit: `every` propagation rounds per dispatch, the
         (label, core) state snapshotted between chunks.  The ring tier is
         picked by the same policy as the plain fit (scale-out and fault
@@ -192,38 +196,38 @@ class DBSCAN(BaseEstimator):
                                               core, _tiled.TILE)
         fp = np.asarray([x.shape[0], x.shape[1], eps, ms, mp], np.float64)
         digest = data_digest(x._data)
-        if guard is None:
-            guard = _health.guard("dbscan", None, checkpoint)
-        snap = checkpoint.load()
-        if snap is not None:
-            validate_snapshot(snap, fp, digest)
-            label = jnp.asarray(snap["label"])
-            core = jnp.asarray(snap["core"])
-        else:
+        loop = _fitloop.ChunkedFitLoop("dbscan", checkpoint=checkpoint,
+                                       health=health)
+
+        def init(rem):
             core, label = setup()
-        while True:
-            (label,) = guard.admit(label)
-            label, changed, hvec = propagate(label, core)
-            verdict = guard.check(hvec)     # watchdogged chunk force point
-            if not verdict.ok:
-                guard.remediate(verdict)    # input faults: typed raise
-                snap = checkpoint.load()    # recoverable trip: last good
-                if snap is not None:
-                    label = jnp.asarray(snap["label"])
-                    core = jnp.asarray(snap["core"])
-                else:
-                    core, label = setup()
-                continue
-            # blocking fetches, async file write (overlaps next propagate);
-            # the write is GATED on this chunk's health verdict
-            guard.save_async(checkpoint, {"label": _fetch(label),
-                                          "core": _fetch(core),
-                                          "fp": fp, "digest": digest})
-            if not bool(_fetch(changed)):
-                break
-            _raise_if_preempted(checkpoint)
-        checkpoint.flush()
-        return finalize(label, core), core
+            return _fitloop.LoopState((label,), extra=core)
+
+        def restore(snap, rem):
+            validate_snapshot(snap, fp, digest)
+            return _fitloop.LoopState((jnp.asarray(snap["label"]),),
+                                      extra=jnp.asarray(snap["core"]))
+
+        def step(st, chunk):
+            (label,) = st.carries
+            label, changed, hvec = propagate(label, st.extra)
+            # state deferred: the watchdogged hvec read (the chunk force
+            # point) precedes the `changed` convergence fetch
+            return _fitloop.ChunkOutcome(
+                lambda: _fitloop.LoopState((label,), st.it + 1,
+                                           not bool(_fetch(changed)),
+                                           extra=st.extra),
+                hvec=hvec)
+
+        def snapshot(st):
+            # blocking fetches, async file write (overlaps next propagate)
+            return {"label": _fetch(st.carries[0]), "core": _fetch(st.extra),
+                    "fp": fp, "digest": digest}
+
+        st = loop.run(init=init, step=step, restore=restore,
+                      snapshot=snapshot)
+        self.fit_info_ = loop.info
+        return finalize(st.carries[0], st.extra), st.extra
 
 
 @partial(jax.jit, static_argnames=("shape", "min_samples"))

@@ -29,8 +29,8 @@ from dislib_tpu.data.array import Array, fused_kernel
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
-from dislib_tpu.runtime import fetch as _fetch, \
-    raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.runtime import fitloop as _fitloop
 from dislib_tpu.runtime import health as _health
 from dislib_tpu.utils.dlog import verbose_logger
 
@@ -122,99 +122,85 @@ class GaussianMixture(BaseEstimator):
         if self.max_iter < 1:
             raise ValueError("max_iter must be >= 1")
         m, n = x.shape
-        guard = _health.guard("gm", health, checkpoint)
-        reg_covar = float(self.reg_covar)
-        it, lb, converged = 0, None, False
-        state = checkpoint.load() if checkpoint is not None else None
-        if state is not None:
+        box = {"x": x, "reg_covar": float(self.reg_covar), "resp0": None,
+               "lb": None}
+        log = verbose_logger("gm", self.verbose)
+        loop = _fitloop.ChunkedFitLoop(
+            "gm", checkpoint=checkpoint, health=health,
+            max_iter=self.max_iter,
+            increasing=True,            # EM lower bound must not fall
+            carry_names=("weights", "means", "covariances"),
+            carry_shapes=((self.n_components,), (self.n_components, n)),
+            elastic=_fitloop.data_rebind(box))
+
+        def init(rem):
+            # EM damping: the 'halve' escalation tier raises the
+            # covariance ridge per tier attempt, the standard fix for a
+            # component collapsing onto a point (singular covariance→NaN)
+            box["reg_covar"] = float(self.reg_covar) * rem.damping
+            box["resp0"] = self._init_resp(box["x"])
+            box["lb"] = None
+            return _fitloop.LoopState(self._explicit_inits(n))
+
+        def restore(snap, rem):
             # resume: all three parameters come from the snapshot, so skip
             # the (KMeans-based) responsibility init entirely
-            resp0 = jnp.zeros((x._data.shape[0], self.n_components),
-                              jnp.float32)
-            overrides = tuple(jnp.asarray(state[k]) for k in
-                              ("weights", "means", "covariances"))
+            box["reg_covar"] = float(self.reg_covar) * rem.damping
+            box["resp0"] = jnp.zeros((box["x"]._data.shape[0],
+                                      self.n_components), jnp.float32)
+            ov = tuple(jnp.asarray(rem.perturb(snap[k])) for k in
+                       ("weights", "means", "covariances"))
             want = (self.n_components, n)
-            if overrides[1].shape != want:
+            if ov[1].shape != want:
                 raise ValueError(
-                    f"checkpoint means shape {overrides[1].shape} does not "
+                    f"checkpoint means shape {ov[1].shape} does not "
                     f"match this estimator/data {want} — stale or foreign "
                     "snapshot")
-            lb = float(state["lower_bound"])
-            it = int(state["n_iter"])
-            converged = bool(state.get("converged", False))
-        else:
-            resp0 = self._init_resp(x)
-            overrides = self._explicit_inits(n)
-        it0 = it                       # this-run history starts here
-        history = []
-        log = verbose_logger("gm", self.verbose)
-        while not converged:
-            chunk = self.max_iter - it if checkpoint is None else \
-                min(checkpoint.every, self.max_iter - it)
-            if chunk <= 0:
-                break
-            overrides = guard.admit(*overrides)
+            box["lb"] = float(snap["lower_bound"])
+            return _fitloop.LoopState(ov, it=int(snap["n_iter"]),
+                                      done=bool(snap.get("converged", False)))
+
+        def step(st, chunk):
+            xd = box["x"]
             weights, means, covs, lb_dev, n_done, conv, hist, hvec = _gm_fit(
-                x._data, x.shape, resp0, self.covariance_type,
-                reg_covar, float(self.tol), chunk, overrides,
-                prev_lb0=lb)
-            verdict = guard.check(
-                hvec, carry_names=("weights", "means", "covariances"),
-                carry_shapes=((self.n_components,), (self.n_components, n)),
-                it=it, increasing=True)     # EM lower bound must not fall
-            if not verdict.ok:
-                rem = guard.remediate(verdict, it=it)
-                # EM damping: the 'halve' action raises the covariance
-                # ridge per restart, the standard fix for a component
-                # collapsing onto a point (singular covariance → NaN)
-                reg_covar = float(self.reg_covar) * rem.damping
-                snap = checkpoint.load()
-                resp0 = jnp.zeros((x._data.shape[0], self.n_components),
-                                  jnp.float32)
-                if snap is not None:
-                    overrides = tuple(
-                        jnp.asarray(rem.perturb(snap[k])) for k in
-                        ("weights", "means", "covariances"))
-                    lb = float(snap["lower_bound"])
-                    it = int(snap["n_iter"])
-                    converged = bool(snap.get("converged", False))
-                else:                   # nothing written yet: from scratch
-                    resp0 = self._init_resp(x)
-                    overrides = self._explicit_inits(n)
-                    it, lb, converged = 0, None, False
-                del history[max(0, it - it0):]
-                continue
-            it += int(n_done)
-            lb = float(lb_dev)
-            converged = bool(conv)
-            history.extend(_fetch(hist)[: int(n_done)])
-            log.info("iter %d: lower_bound=%.6g", it, lb)
-            overrides = (weights, means, covs)
-            if checkpoint is not None:
-                # the EM parameters are DONATED to the next chunk's kernel
-                # (HBM reused in place), so their device->host copies are
-                # blocking; the checksum+file write still overlaps the next
-                # chunk on the snapshot worker.  The write is GATED on this
-                # chunk's health verdict.
-                guard.save_async(checkpoint, {
-                    "weights": _fetch(weights),
-                    "means": _fetch(means),
-                    "covariances": _fetch(covs),
-                    "lower_bound": lb, "n_iter": it, "converged": converged})
-                if not converged and it < self.max_iter:  # work left only
-                    _raise_if_preempted(checkpoint)
-            if checkpoint is None:
-                break
-        if checkpoint is not None:
-            checkpoint.flush()
-        weights, means, covs = overrides
+                xd._data, xd.shape, box["resp0"], self.covariance_type,
+                box["reg_covar"], float(self.tol), chunk, st.carries,
+                prev_lb0=box["lb"])
+
+            def commit():
+                # deferred scalar syncs: the watchdogged hvec read stays
+                # the chunk's first force point
+                box["lb"] = float(lb_dev)
+                it = st.it + int(n_done)
+                log.info("iter %d: lower_bound=%.6g", it, box["lb"])
+                return _fitloop.LoopState((weights, means, covs), it,
+                                          bool(conv))
+
+            return _fitloop.ChunkOutcome(
+                commit, hvec=hvec,
+                history=lambda: _fetch(hist)[: int(n_done)])
+
+        def snapshot(st):
+            # the EM parameters are DONATED to the next chunk's kernel
+            # (HBM reused in place), so their device->host copies are
+            # blocking; the checksum+file write still overlaps the next
+            # chunk on the snapshot worker
+            weights, means, covs = st.carries
+            return {"weights": _fetch(weights), "means": _fetch(means),
+                    "covariances": _fetch(covs), "lower_bound": box["lb"],
+                    "n_iter": st.it, "converged": st.done}
+
+        st = loop.run(init=init, step=step, restore=restore,
+                      snapshot=snapshot)
+        weights, means, covs = st.carries
         self.weights_ = np.asarray(jax.device_get(weights))
         self.means_ = np.asarray(jax.device_get(means))
         self.covariances_ = np.asarray(jax.device_get(covs))
-        self.lower_bound_ = lb if lb is not None else -np.inf
-        self.n_iter_ = it
-        self.converged_ = converged
-        self.history_ = np.asarray(history, dtype=np.float64)
+        self.lower_bound_ = box["lb"] if box["lb"] is not None else -np.inf
+        self.n_iter_ = st.it
+        self.converged_ = st.done
+        self.history_ = np.asarray(loop.history, dtype=np.float64)
+        self.fit_info_ = loop.info
         return self
 
     def score(self, x: Array, y=None) -> float:

@@ -41,8 +41,8 @@ from dislib_tpu.data.sparse import SparseArray, _spmm
 from dislib_tpu.ops import distances_sq as _distances_sq
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
-from dislib_tpu.runtime import fetch as _fetch, \
-    raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.runtime import fitloop as _fitloop
 from dislib_tpu.runtime import health as _health
 from dislib_tpu.utils.dlog import verbose_logger
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
@@ -130,103 +130,88 @@ class KMeans(BaseEstimator):
         """Fit on `x`.  With ``checkpoint=FitCheckpoint(path, every=k)`` the
         device loop runs in k-iteration chunks, snapshotting (centers,
         n_iter) after each; a re-run resumes from the snapshot (SURVEY §6
-        checkpoint/resume — TPU preemption recovery).  Between chunks the
-        loop honours the preemption flag (`dislib_tpu.runtime`): snapshot
-        first, then a clean ``Preempted`` instead of dying mid-collective.
-        Centers are host-side logical state, so a snapshot restores onto a
-        different mesh/device count unchanged (elastic resume).
+        checkpoint/resume — TPU preemption recovery).  The whole per-chunk
+        resilience protocol — fused health vector at zero extra
+        dispatches, watchdog, verdict-gated snapshot writes,
+        rollback-to-last-good with the ``health`` policy's escalation
+        ladder (dense fits offer the elastic mesh-shrink tier), preemption
+        polling — is owned by :class:`~dislib_tpu.runtime.ChunkedFitLoop`;
+        centers are host-side logical state, so snapshots restore onto a
+        different mesh/device count unchanged (elastic resume)."""
+        sparse_in = isinstance(x, SparseArray)
+        box = {"x": x, "inertia": None}
+        log = verbose_logger("kmeans", self.verbose)
+        loop = _fitloop.ChunkedFitLoop(
+            "kmeans", checkpoint=checkpoint, health=health,
+            max_iter=self.max_iter, carry_names=("centers",),
+            carry_shapes=((self.n_clusters, x.shape[1]),),
+            elastic=None if sparse_in else _fitloop.data_rebind(box))
 
-        ``health`` — optional :class:`~dislib_tpu.runtime.HealthPolicy`.
-        Every chunk's kernel emits a fused health vector (non-finite
-        centers, inertia monotonicity, center norm) at zero extra
-        dispatches; a tripped guard rolls the fit back to the last GOOD
-        snapshot (writes are gated on healthy chunks) and applies the
-        policy, or raises a typed ``NumericalDivergence``."""
-        it = 0
-        done = False
-        guard = _health.guard("kmeans", health, checkpoint)
-        state = checkpoint.load() if checkpoint is not None else None
-        if state is not None:
-            centers = jnp.asarray(state["centers"])
+        def init(rem):
+            box["inertia"] = None
+            return _fitloop.LoopState(
+                (jnp.asarray(rem.perturb(self._init_centers(box["x"]))),))
+
+        def restore(snap, rem):
+            centers = np.asarray(snap["centers"])
             want = (self.n_clusters, x.shape[1])
             if centers.shape != want:
                 raise ValueError(
                     f"checkpoint centers shape {centers.shape} does not match "
                     f"this estimator/data {want} — stale or foreign snapshot")
-            it = int(state["n_iter"])
-            done = bool(state.get("converged", False))
-        else:
-            centers = self._init_centers(x)
-        it0 = it                       # this-run history starts here
-        inertia = None
-        history = []
-        log = verbose_logger("kmeans", self.verbose)
-        while not done:
-            chunk = self.max_iter - it if checkpoint is None else \
-                min(checkpoint.every, self.max_iter - it)
-            if chunk <= 0:
-                break
-            (centers,) = guard.admit(centers)
-            if isinstance(x, SparseArray):
+            # a faulted chunk's inertia must not leak into the fitted
+            # attrs if the restored state exits the loop (converged
+            # snapshot): None falls back to -score(x)
+            box["inertia"] = None
+            return _fitloop.LoopState((jnp.asarray(rem.perturb(centers)),),
+                                      it=int(snap["n_iter"]),
+                                      done=bool(snap.get("converged", False)))
+
+        def step(st, chunk):
+            (centers,) = st.carries
+            if sparse_in:
                 data, lrows, cols, rowsq = x.sharded_rows()
-                new_centers, n_done, inertia, shift, hist, hvec = \
+                centers, n_done, inertia, shift, hist, hvec = \
                     _kmeans_fit_sparse_sharded(
                         data, lrows, cols, rowsq, centers, x.shape[0], chunk,
                         float(self.tol), _mesh.get_mesh())
             else:
-                new_centers, n_done, inertia, shift, hist, hvec = _kmeans_fit(
-                    x._data, x.shape, centers, chunk, float(self.tol),
+                xd = box["x"]
+                centers, n_done, inertia, shift, hist, hvec = _kmeans_fit(
+                    xd._data, xd.shape, centers, chunk, float(self.tol),
                     fast=self._fast())
-            verdict = guard.check(
-                hvec, carry_names=("centers",),
-                carry_shapes=((self.n_clusters, x.shape[1]),), it=it)
-            if not verdict.ok:
-                # roll back to the last-good generation (gated writes keep
-                # it good) and apply the remediation policy; raises the
-                # typed diagnostic when the policy says so
-                rem = guard.remediate(verdict, it=it)
-                snap = checkpoint.load()
-                # the faulted chunk's inertia must not leak into the
-                # fitted attrs if the restored state exits the loop
-                # (converged snapshot): None falls back to -score(x)
-                inertia = None
-                if snap is not None:
-                    centers = jnp.asarray(rem.perturb(snap["centers"]))
-                    it = int(snap["n_iter"])
-                    done = bool(snap.get("converged", False))
-                else:                   # nothing written yet: from scratch
-                    centers = jnp.asarray(
-                        rem.perturb(_fetch(self._init_centers(x))))
-                    it, done = 0, False
-                del history[max(0, it - it0):]
-                continue
-            centers = new_centers
-            it += int(n_done)
-            history.extend(_fetch(hist)[: int(n_done)])
-            done = float(shift) < self.tol
-            log.info("iter %d: inertia=%.6g shift=%.3g", it,
-                     float(inertia), float(shift))
-            if checkpoint is not None:
-                # async offload: the device->host copy starts now and the
-                # file write runs on the snapshot worker, both overlapping
-                # the next chunk's compute (centers are never donated, so
-                # the non-blocking fetch is safe); the write is GATED on
-                # this chunk's health verdict
-                guard.save_async(checkpoint, {
-                    "centers": _fetch(centers, blocking=False),
-                    "n_iter": it, "converged": done})
-                if not done and it < self.max_iter:  # work left: allow a
-                    _raise_if_preempted(checkpoint)  # clean preempt here
-            if checkpoint is None:
-                break
-        if checkpoint is not None:
-            checkpoint.flush()          # last snapshot lands before return
-        self.centers_ = np.asarray(jax.device_get(centers))
-        self.n_iter_ = it
-        self.history_ = np.asarray(history, dtype=np.float64)
+
+            def commit():
+                # deferred: these scalar syncs run only AFTER the verdict,
+                # so the watchdogged hvec read is the chunk's first force
+                # point (and a faulted chunk never touches the box)
+                box["inertia"] = inertia
+                it = st.it + int(n_done)
+                done = float(shift) < self.tol
+                log.info("iter %d: inertia=%.6g shift=%.3g", it,
+                         float(inertia), float(shift))
+                return _fitloop.LoopState((centers,), it, done)
+
+            return _fitloop.ChunkOutcome(
+                commit, hvec=hvec,
+                history=lambda: _fetch(hist)[: int(n_done)])
+
+        def snapshot(st):
+            # async offload: the device->host copy starts now and the file
+            # write runs on the snapshot worker, both overlapping the next
+            # chunk's compute (centers are never donated)
+            return {"centers": _fetch(st.carries[0], blocking=False),
+                    "n_iter": st.it, "converged": st.done}
+
+        st = loop.run(init=init, step=step, restore=restore,
+                      snapshot=snapshot)
+        self.centers_ = np.asarray(jax.device_get(st.carries[0]))
+        self.n_iter_ = st.it
+        self.history_ = np.asarray(loop.history, dtype=np.float64)
+        self.fit_info_ = loop.info
         # inertia is None only when resuming an already-finished fit
-        self.inertia_ = float(inertia) if inertia is not None else \
-            -self.score(x)
+        self.inertia_ = float(box["inertia"]) \
+            if box["inertia"] is not None else -self.score(box["x"])
         return self
 
     # async trial protocol (SURVEY §4.5): fit/score entirely on device, no

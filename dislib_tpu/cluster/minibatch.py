@@ -1,0 +1,226 @@
+"""Mini-batch K-means with a streaming ``partial_fit`` (round-12; the
+first streaming estimator of ROADMAP item 3).
+
+This module is the living proof of the :mod:`dislib_tpu.runtime.fitloop`
+recipe: it contains ZERO bespoke resilience code (lint-enforced by
+``tests/test_health_guard_lint.py``) yet passes the same rollback /
+watchdog / preemption / quarantine fault grid as the seven ported
+chunked estimators — every resilience behavior is the driver's.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from dislib_tpu.base import BaseEstimator
+from dislib_tpu.cluster.kmeans import KMeans
+from dislib_tpu.data.array import Array, array as _ds_array, \
+    ensure_canonical as _ensure_canonical
+from dislib_tpu.ops import distances_sq as _distances_sq
+from dislib_tpu.ops.base import precise
+from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.runtime import fitloop as _fitloop
+from dislib_tpu.runtime import health as _health
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
+
+
+class MiniBatchKMeans(KMeans):
+    """Mini-batch K-means with a streaming ``partial_fit`` — the first
+    streaming estimator of ROADMAP item 3, and the acceptance test for
+    the :class:`~dislib_tpu.runtime.ChunkedFitLoop` recipe: this class
+    contains ZERO bespoke resilience code (lint-enforced).  Rollback to
+    last-good, the chunk watchdog, the escalation ladder, verdict-gated
+    snapshots, and preemption polling all come from the driver — a batch
+    that trips a guard is rolled back and re-run, a hung batch becomes a
+    typed ``WatchdogTimeout``, and a preemption notice lands as a clean
+    ``Preempted`` between batches with the stream resumable from the
+    snapshot.
+
+    Each ``partial_fit(batch)`` is ONE fused dispatch (assign +
+    per-center batch mass/means + online center update + health vector).
+    ``counts_`` carries the accumulated per-center sample mass, so the
+    update is the standard  c_j ← c_j + (m_j/counts_j)·(mean_j − c_j)
+    with the learning rate decaying as mass accumulates (Sculley 2010).
+    ``fit`` is a convenience wrapper streaming row slices of a ds-array
+    through ``partial_fit`` for ``epochs`` passes.
+
+    Parameters
+    ----------
+    n_clusters : int, default 8
+    init : 'random' or ndarray (n_clusters, n_features) — fresh centers
+        come from the FIRST batch's rows under 'random'.
+    batch_size : int, default 256 — row slice width used by ``fit``.
+    epochs : int, default 1 — passes over the data in ``fit``.
+    random_state : int or None
+
+    Attributes
+    ----------
+    centers_ : ndarray (n_clusters, n_features)
+    counts_ : ndarray (n_clusters,) — per-center accumulated sample mass.
+    n_batches_ : int — batches consumed by the stream so far.
+    inertia_ : float — the last batch's within-cluster sum of squares.
+    """
+
+    def __init__(self, n_clusters=8, init="random", batch_size=256,
+                 epochs=1, random_state=None, verbose=False):
+        self.n_clusters = n_clusters
+        self.init = init
+        self.batch_size = int(batch_size)
+        self.epochs = int(epochs)
+        self.random_state = random_state
+        self.verbose = verbose
+        self._loop = None
+
+    # searches run the plain synchronous fallback — the KMeans async-trial
+    # kernels would silently swap full-batch Lloyd's in for the mini-batch
+    # update
+    _fit_async = BaseEstimator._fit_async
+    _fit_finalize = BaseEstimator._fit_finalize
+    _score_async = BaseEstimator._score_async
+
+    def partial_fit(self, x, y=None, checkpoint=None, health=None):
+        """Consume one batch (ds-array or host ndarray).  The first call
+        configures the stream's resilience (``checkpoint``/``health``
+        are stream-wide; later calls reuse them) and restores from the
+        checkpoint if one exists — a preempted stream resumes where it
+        snapshot."""
+        xb = x if isinstance(x, Array) else \
+            _ds_array(np.asarray(x, np.float32))
+        if self._loop is None:
+            # the batch holder persists WITH the loop: the elastic tier's
+            # rebind hook must re-lay out whichever batch is current when
+            # a mid-stream mesh shrink lands, not the first call's
+            self._batch = {}
+            self._loop = _fitloop.ChunkedFitLoop(
+                "minibatch_kmeans", checkpoint=checkpoint, health=health,
+                carry_names=("centers", "counts"),
+                carry_shapes=((self.n_clusters, xb.shape[1]),
+                              (self.n_clusters,)),
+                save_every=checkpoint.every if checkpoint is not None else 1,
+                elastic=_fitloop.data_rebind(self._batch))
+        batch, loop = self._batch, self._loop
+        # after a mid-stream elastic shrink, batches the producer built
+        # under the pre-shrink mesh re-lay out on device at ingest
+        batch["x"] = xb if loop.info["mesh_shrinks"] == 0 \
+            else _ensure_canonical(xb)
+
+        def init(rem):
+            centers = jnp.asarray(
+                rem.perturb(self._init_centers(batch["x"])))
+            return _fitloop.LoopState(
+                (centers, jnp.zeros((self.n_clusters,), jnp.float32)))
+
+        def restore(snap, rem):
+            centers = np.asarray(snap["centers"])
+            want = (self.n_clusters, xb.shape[1])
+            if centers.shape != want:
+                raise ValueError(
+                    f"checkpoint centers shape {centers.shape} does not "
+                    f"match this estimator/stream {want} — stale or "
+                    "foreign snapshot")
+            return _fitloop.LoopState(
+                (jnp.asarray(rem.perturb(centers)),
+                 jnp.asarray(rem.perturb(snap["counts"]))),
+                it=int(snap["n_batches"]))
+
+        def step(st, chunk):
+            xd = batch["x"]
+            centers, counts, inertia, hvec = _mbk_step(
+                xd._data, xd.shape, *st.carries)
+            # state/history deferred: the watchdogged hvec read stays the
+            # batch's first force point
+            return _fitloop.ChunkOutcome(
+                lambda: _fitloop.LoopState((centers, counts), st.it + 1,
+                                           extra=inertia),
+                hvec=hvec, history=lambda: (float(inertia),))
+
+        def snapshot(st):
+            return {"centers": _fetch(st.carries[0], blocking=False),
+                    "counts": _fetch(st.carries[1], blocking=False),
+                    "n_batches": st.it, "inertia": float(st.extra)}
+
+        st = loop.run_one(init=init, step=step, restore=restore,
+                          snapshot=snapshot)
+        self.centers_ = np.asarray(jax.device_get(st.carries[0]))
+        self.counts_ = np.asarray(jax.device_get(st.carries[1]))
+        self.n_batches_ = self.n_iter_ = st.it
+        self.inertia_ = float(st.extra)
+        self.history_ = np.asarray(loop.history, dtype=np.float64)
+        self.fit_info_ = loop.info
+        return self
+
+    def fit(self, x: Array, y=None, checkpoint=None, health=None):
+        """Stream ``x`` through ``partial_fit`` in ``batch_size`` row
+        slices, ``epochs`` passes.  Restarts the stream state (a fresh
+        ``fit`` is a fresh model; ``partial_fit`` is the continuation
+        API) — EXCEPT when ``checkpoint`` already holds a snapshot: the
+        fit then resumes the stream at the recorded batch position (the
+        preemption-recovery re-run), never re-consuming batches the
+        snapshot already contains, and lands on the uninterrupted run's
+        model."""
+        self._loop = None
+        start, snap = _fitloop.stream_state(checkpoint)
+        m = x.shape[0]
+        mesh = _mesh.get_mesh()
+        g = 0                           # global batch index across epochs
+        for _ in range(max(1, self.epochs)):
+            for s in range(0, m, self.batch_size):
+                g += 1
+                if g <= start:
+                    continue            # already consumed by the snapshot
+                if _mesh.get_mesh() is not mesh:
+                    # an elastic mesh-shrink landed mid-stream: re-lay the
+                    # source out for the surviving devices before slicing
+                    # the next batch from it
+                    x, mesh = _ensure_canonical(x), _mesh.get_mesh()
+                self.partial_fit(x[s: min(s + self.batch_size, m), :],
+                                 checkpoint=checkpoint, health=health)
+        if start and g <= start:
+            # the snapshot already covers the whole stream (a completed
+            # fit re-run): adopt the fitted state without re-dispatching
+            self.centers_ = np.asarray(snap["centers"])
+            self.counts_ = np.asarray(snap["counts"])
+            self.n_batches_ = self.n_iter_ = int(snap["n_batches"])
+            self.inertia_ = float(snap.get("inertia", np.nan))
+            self.history_ = np.asarray([], dtype=np.float64)
+            self.fit_info_ = {"chunks": 0, "rollbacks": 0,
+                              "mesh_shrinks": 0, "escalations": {}}
+        return self
+
+
+@partial(_pjit, static_argnames=("shape",), name="mbkmeans_step")
+@precise
+def _mbk_step(xp, shape, centers, counts):
+    """One mini-batch update — assign, per-center batch mass/means, online
+    center update, fused health vector: the whole ``partial_fit`` chunk is
+    this ONE dispatch (counter-asserted in ``tests/test_minibatch``)."""
+    m, n = shape
+    xv = xp[:, :n]
+    w = (lax.broadcasted_iota(jnp.int32, (xv.shape[0],), 0) < m) \
+        .astype(xv.dtype)
+    d = _distances_sq(xv, centers)
+    labels = jnp.argmin(d, axis=1)
+    onehot = jax.nn.one_hot(labels, centers.shape[0], dtype=xv.dtype) \
+        * w[:, None]
+    bc = jnp.sum(onehot, axis=0)                      # (k,) batch mass
+    bmean = (onehot.T @ xv) / jnp.maximum(bc, 1.0)[:, None]
+    new_counts = counts + bc
+    eta = (bc / jnp.maximum(new_counts, 1.0))[:, None]
+    new_centers = jnp.where(bc[:, None] > 0,
+                            centers + eta * (bmean - centers), centers)
+    inertia = jnp.sum(jnp.min(d, axis=1) * w)
+    # NO loss history in the health vector: consecutive chunks see
+    # DIFFERENT batches, so batch-to-batch inertia is not a monotone
+    # trajectory — feeding it to the cross-chunk monotone guard would
+    # false-trip an armed `monotone_rtol` on healthy streams
+    # (review-found).  Non-finite batches/centers stay covered by the
+    # inputs/carries slots.
+    hvec = _health.health_vec(carries=(new_centers, new_counts),
+                              inputs=(xv,))
+    return new_centers, new_counts, inertia, hvec

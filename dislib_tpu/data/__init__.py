@@ -4,7 +4,8 @@ from dislib_tpu.data.array import (
 )
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
-    QuarantineReport, last_quarantine_report,
+    QuarantineLedger, QuarantineReport, last_quarantine_report,
+    quarantine_ledger,
 )
 from dislib_tpu.data.sparse import SparseArray
 
@@ -13,5 +14,6 @@ __all__ = [
     "eye", "apply_along_axis", "concat_rows", "concat_cols", "rechunk",
     "ensure_canonical",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
-    "save_txt", "QuarantineReport", "last_quarantine_report", "SparseArray",
+    "save_txt", "QuarantineReport", "QuarantineLedger",
+    "last_quarantine_report", "quarantine_ledger", "SparseArray",
 ]

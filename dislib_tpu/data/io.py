@@ -89,13 +89,90 @@ class QuarantineReport:
                 f"n_loaded={self.n_loaded}, rows={self.rows.tolist()})")
 
 
+class QuarantineLedger:
+    """Stream-wide accumulation of ingest quarantines (round-12 fix: the
+    module-level report used to be OVERWRITTEN per load, so a streaming
+    job — repeated ``load → partial_fit`` batches — could only ever see
+    its LAST batch's quarantine).  Every load that quarantines rows
+    appends its :class:`QuarantineReport` here, in arrival order, so the
+    steady-state stream can audit total losses and re-align the affected
+    row-paired batches.  :meth:`reset` is the escape hatch between
+    logically separate streams.
+
+    Two bounds keep the infinite-stream case honest: the COUNT totals
+    (``n_quarantined``/``n_loaded``) are exact accumulators for the whole
+    stream, while ``reports`` (which pin each load's offending-row value
+    arrays) retain only the newest ``max_reports``
+    (``DSLIB_QUARANTINE_LEDGER_CAP``, default 256) — a service ingesting
+    occasionally-dirty batches for days must not leak every bad row it
+    ever saw."""
+
+    def __init__(self, max_reports=None):
+        self.reports: list[QuarantineReport] = []
+        self.max_reports = int(os.environ.get(
+            "DSLIB_QUARANTINE_LEDGER_CAP", 256)) \
+            if max_reports is None else int(max_reports)
+        self._totals = [0, 0]
+
+    def append(self, report: QuarantineReport) -> None:
+        self.reports.append(report)
+        self._totals[0] += report.n_quarantined
+        self._totals[1] += report.n_loaded
+        del self.reports[: max(0, len(self.reports) - self.max_reports)]
+
+    @property
+    def n_quarantined(self) -> int:
+        """Total rows quarantined across every load since the last reset
+        (exact even past the retained-report cap)."""
+        return self._totals[0]
+
+    @property
+    def n_loaded(self) -> int:
+        """Total clean rows loaded by the quarantining loads."""
+        return self._totals[1]
+
+    @property
+    def keep_masks(self) -> list:
+        """Per-report keep-masks of the RETAINED reports, in load order —
+        apply each to its batch's row-paired partner
+        (``QuarantineReport.keep_mask`` semantics, preserved per batch
+        instead of overwritten)."""
+        return [r.keep_mask for r in self.reports]
+
+    def keep_mask_all(self):
+        """The retained reports' masks concatenated in load order.  NOTE:
+        loads that quarantined NOTHING never enter the ledger, so this
+        spans only the affected batches — re-align a mixed stream batch
+        by batch (match each report's ``source`` to its partner batch),
+        not by slicing one global mask over every batch ever loaded."""
+        masks = self.keep_masks
+        return np.concatenate(masks) if masks else np.zeros(0, bool)
+
+    def reset(self) -> None:
+        self.reports.clear()
+        self._totals = [0, 0]
+
+    def __repr__(self):
+        return (f"QuarantineLedger(loads={len(self.reports)}, "
+                f"n_quarantined={self.n_quarantined}, "
+                f"n_loaded={self.n_loaded})")
+
+
 _LAST_QUARANTINE: QuarantineReport | None = None
+_LEDGER = QuarantineLedger()
 
 
 def last_quarantine_report() -> QuarantineReport | None:
     """The :class:`QuarantineReport` of the most recent load that
     quarantined rows in this process, or None."""
     return _LAST_QUARANTINE
+
+
+def quarantine_ledger() -> QuarantineLedger:
+    """The process-wide :class:`QuarantineLedger` — quarantine outcomes
+    ACCUMULATED across repeated ingest/``partial_fit`` calls (the
+    streaming steady state), with ``reset()`` as the escape hatch."""
+    return _LEDGER
 
 
 def _quarantine_enabled(opt) -> bool:
@@ -112,6 +189,9 @@ def _emit_quarantine(source, rows, bad_values, n_clean, bad_labels=None):
     report = QuarantineReport(source, rows, bad_values, n_clean,
                               labels=bad_labels)
     _LAST_QUARANTINE = report
+    _LEDGER.append(report)
+    from dislib_tpu.utils.profiling import count_resilience
+    count_resilience("quarantined_rows", report.n_quarantined)
     warnings.warn(
         f"{source}: quarantined {report.n_quarantined} non-finite row(s) "
         f"(indices {rows[:8].tolist()}{'...' if len(rows) > 8 else ''}) — "

@@ -39,11 +39,12 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array
+from dislib_tpu.data.array import Array, \
+    ensure_canonical as _ensure_canonical
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
-from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows, \
-    raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows
+from dislib_tpu.runtime import fitloop as _fitloop
 from dislib_tpu.runtime import health as _health
 from dislib_tpu.utils.dlog import verbose_logger
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
@@ -119,29 +120,53 @@ class ALS(BaseEstimator):
             rows_d, cols_d, vals = _triplets(x)
             t_trip = (rows_d, cols_d, vals) if test is None \
                 else _test_triplets(test, x.shape)
-        elif test is None:
-            test_p = x._data
-        else:
+        t_host = None
+        if not sparse_in and test is not None:
             import scipy.sparse as sp
             if isinstance(test, SparseArray):
-                t = np.asarray(test.collect().toarray())
+                t_host = np.asarray(test.collect().toarray())
             else:
                 t = test.collect() if isinstance(test, Array) else test
-                t = np.asarray(t.toarray() if sp.issparse(t) else t)
-            if t.shape != x.shape:
-                raise ValueError(
-                    f"test ratings shape {t.shape} != ratings shape {x.shape}")
-            test_p = _pad_like(t, x)
+                t_host = np.asarray(t.toarray() if sp.issparse(t) else t)
+            if t_host.shape != x.shape:
+                raise ValueError(f"test ratings shape {t_host.shape} != "
+                                 f"ratings shape {x.shape}")
         seed = self.random_state if self.random_state is not None else 0
-        guard = _health.guard("als", health, checkpoint)
-        lam = float(self.lambda_)
-        tu = x.shape[0] if sparse_in else x._data.shape[0]
-        tv = x.shape[1] if sparse_in else x._data.shape[1]
+        box = {"x": x, "lam": float(self.lambda_), "rmse": np.inf}
 
-        def _restore(snap, perturb=lambda a: a):
+        def _bind_test():
+            if not sparse_in:
+                box["test_p"] = box["x"]._data if t_host is None \
+                    else _pad_like(t_host, box["x"])
+        _bind_test()
+
+        def rebind(mesh):
+            if mesh is None:            # pre-switch: force pending chains
+                box["x"].force()
+                return
+            box["x"] = _ensure_canonical(box["x"])
+            _bind_test()
+
+        log = verbose_logger("als", self.verbose)
+        loop = _fitloop.ChunkedFitLoop(
+            "als", checkpoint=checkpoint, health=health,
+            max_iter=self.max_iter, carry_names=("users", "items"),
+            carry_shapes=((x.shape[0], int(self.n_f)),
+                          (x.shape[1], int(self.n_f))),
+            elastic=None if sparse_in else rebind)
+
+        def init(rem):
+            # ALS damping: the 'halve' tier raises the per-row ridge λ·n_u
+            # per attempt (ill-conditioned normal equations are the
+            # numeric failure mode of the batched Cholesky solves)
+            box["lam"] = float(self.lambda_) * rem.damping
+            box["rmse"] = np.inf
+            return _fitloop.LoopState(())   # fresh: the kernel seeds itself
+
+        def restore(snap, rem):
             # snapshots carry the LOGICAL factor dims (m, n); the stored
             # factor arrays may be padded for a different mesh — elastic
-            # resume re-pads them for this mesh (runtime.repad_rows)
+            # resume re-pads them for THIS mesh (runtime.repad_rows)
             if "m" not in snap or "users" not in snap:
                 raise ValueError(
                     "checkpoint is missing the ALS factor state — stale "
@@ -154,84 +179,64 @@ class ALS(BaseEstimator):
                     f"over ratings {(sm, sn)}) do not match this "
                     f"estimator/data (ratings {tuple(x.shape)}, "
                     f"n_f={self.n_f}) — stale or foreign snapshot")
-            st = (jnp.asarray(perturb(_repad_rows(snap["users"], sm, tu))),
-                  jnp.asarray(perturb(_repad_rows(snap["items"], sn, tv))),
-                  float(snap["rmse"]))
-            return (st, float(snap["rmse"]), int(snap["n_iter"]),
-                    bool(snap.get("converged", False)))
+            box["lam"] = float(self.lambda_) * rem.damping
+            box["rmse"] = float(snap["rmse"])
+            tu = x.shape[0] if sparse_in else box["x"]._data.shape[0]
+            tv = x.shape[1] if sparse_in else box["x"]._data.shape[1]
+            return _fitloop.LoopState(
+                (jnp.asarray(rem.perturb(_repad_rows(snap["users"], sm, tu))),
+                 jnp.asarray(rem.perturb(_repad_rows(snap["items"], sn, tv)))),
+                it=int(snap["n_iter"]),
+                done=bool(snap.get("converged", False)),
+                extra=float(snap["rmse"]))
 
-        it, rmse, conv, state = 0, np.inf, False, None
-        if checkpoint is not None:
-            snap = checkpoint.load()
-            if snap is not None:
-                state, rmse, it, conv = _restore(snap)
-        it0 = it                       # this-run history starts here
-        history = []
-        log = verbose_logger("als", self.verbose)
-        while not conv:
-            chunk = self.max_iter - it if checkpoint is None else \
-                min(checkpoint.every, self.max_iter - it)
-            if chunk <= 0:
-                break
-            state = guard.admit(*state) if state is not None else \
-                guard.admit() or None
+        def step(st, chunk):
+            state = (*st.carries, st.extra) if st.carries else None
             if sparse_in:
-                u, v, rmse_dev, n_done, conv_dev, hist, hvec = _als_fit_sparse(
+                u, v, rmse_dev, n_done, conv, hist, hvec = _als_fit_sparse(
                     rows_d, cols_d, vals, *t_trip, x.shape[0], x.shape[1],
-                    int(self.n_f), lam, float(self.tol),
+                    int(self.n_f), box["lam"], float(self.tol),
                     chunk, int(seed), init_state=state)
             else:
-                u, v, rmse_dev, n_done, conv_dev, hist, hvec = _als_fit(
-                    x._data, test_p, x.shape, int(self.n_f),
-                    lam, float(self.tol), chunk, int(seed),
+                u, v, rmse_dev, n_done, conv, hist, hvec = _als_fit(
+                    box["x"]._data, box["test_p"], x.shape, int(self.n_f),
+                    box["lam"], float(self.tol), chunk, int(seed),
                     init_state=state)
-            verdict = guard.check(hvec, carry_names=("users", "items"),
-                                  carry_shapes=((tu, int(self.n_f)),
-                                                (tv, int(self.n_f))), it=it)
-            if not verdict.ok:
-                rem = guard.remediate(verdict, it=it)
-                # ALS damping: the 'halve' action raises the per-row ridge
-                # λ·n_u per restart (ill-conditioned normal equations are
-                # the numeric failure mode of the batched Cholesky solves)
-                lam = float(self.lambda_) * rem.damping
-                snap = checkpoint.load()
-                if snap is not None:
-                    state, rmse, it, conv = _restore(snap, rem.perturb)
-                else:                   # nothing written yet: from scratch
-                    it, rmse, conv, state = 0, np.inf, False, None
-                del history[max(0, it - it0):]
-                continue
-            it += int(n_done)
-            rmse = float(rmse_dev)
-            conv = bool(conv_dev)
-            history.extend(_fetch(hist)[: int(n_done)])
-            log.info("iter %d: rmse=%.6g", it, rmse)
-            state = (u, v, rmse)
-            if checkpoint is not None:
-                # the factors are DONATED to the next chunk's kernel call
-                # (their HBM is reused in place), so their device->host
-                # copies must land before that dispatch: fetch blocking,
-                # and offload only the checksum+write to the snapshot
-                # worker (it still overlaps the next chunk's compute).
-                # The write is GATED on this chunk's health verdict.
-                guard.save_async(checkpoint, {
-                    "users": _fetch(u), "items": _fetch(v),
+
+            def commit():
+                # deferred scalar syncs: the watchdogged hvec read stays
+                # the chunk's first force point
+                box["rmse"] = float(rmse_dev)
+                it = st.it + int(n_done)
+                log.info("iter %d: rmse=%.6g", it, box["rmse"])
+                return _fitloop.LoopState((u, v), it, bool(conv),
+                                          extra=box["rmse"])
+
+            return _fitloop.ChunkOutcome(
+                commit, hvec=hvec,
+                history=lambda: _fetch(hist)[: int(n_done)])
+
+        def snapshot(st):
+            # the factors are DONATED to the next chunk's kernel call
+            # (their HBM is reused in place), so their device->host copies
+            # must land before that dispatch: fetch blocking, and offload
+            # only the checksum+write to the snapshot worker
+            return {"users": _fetch(st.carries[0]),
+                    "items": _fetch(st.carries[1]),
                     "m": x.shape[0], "n": x.shape[1],
-                    "rmse": rmse, "n_iter": it, "converged": conv})
-                if not conv and it < self.max_iter:  # work left only
-                    _raise_if_preempted(checkpoint)
-            if checkpoint is None:
-                break
-        if checkpoint is not None:
-            checkpoint.flush()
-        u, v, _ = state
+                    "rmse": st.extra, "n_iter": st.it, "converged": st.done}
+
+        st = loop.run(init=init, step=step, restore=restore,
+                      snapshot=snapshot)
+        u, v = st.carries
         m, n = x.shape
         self.users_ = np.asarray(jax.device_get(u))[:m]
         self.items_ = np.asarray(jax.device_get(v))[:n]
-        self.rmse_ = float(rmse)
-        self.n_iter_ = it
-        self.converged_ = conv
-        self.history_ = np.asarray(history, dtype=np.float64)
+        self.rmse_ = float(box["rmse"])
+        self.n_iter_ = st.it
+        self.converged_ = st.done
+        self.history_ = np.asarray(loop.history, dtype=np.float64)
+        self.fit_info_ = loop.info
         return self
 
     # async trial protocol (SURVEY §4.5): the no-test, no-checkpoint fit is

@@ -40,6 +40,9 @@ from dislib_tpu.runtime.preemption import (
     preemption_requested, raise_if_preempted, request_preemption,
 )
 from dislib_tpu.runtime.retry import Retry, is_transient_error, retry_call
+from dislib_tpu.runtime.fitloop import (ChunkedFitLoop, ChunkOutcome,
+                                        Escalation, EscalationLadder,
+                                        LoopState)
 
 __all__ = [
     "Preempted", "PreemptionWatcher", "preemption_requested",
@@ -49,5 +52,7 @@ __all__ = [
     "repad_rows", "fetch", "AsyncFetch",
     "HealthPolicy", "ChunkGuard", "NumericalDivergence", "WatchdogTimeout",
     "Adoption", "AdoptionRejected", "adopt_latest", "generation_token",
+    "ChunkedFitLoop", "ChunkOutcome", "LoopState", "Escalation",
+    "EscalationLadder",
     "health", "xla_flags",
 ]

@@ -136,11 +136,17 @@ class HealthPolicy:
     enabled : bool (``DSLIB_HEALTH``, default on) — master switch; a
         disabled policy's guard admits everything and never trips.
     seed : int — base seed of the 'reseed' perturbation stream.
+    elastic_attempts : int (``DSLIB_HEALTH_ELASTIC_ATTEMPTS``, default 0)
+        — rollback attempts the fit-loop escalation ladder may spend at
+        the elastic mesh-shrink tier (the LAST rungs of the shared
+        ``max_restarts`` budget; see ``runtime.fitloop``).  Only fits
+        whose estimator supports the on-device data rebind offer the
+        tier.
     """
 
     def __init__(self, action=None, max_restarts=None, deadline_s=None,
                  monotone_rtol=None, grow_limit=None, enabled=None, seed=0,
-                 first_deadline_s=None):
+                 first_deadline_s=None, elastic_attempts=None):
         env = os.environ
         if action is None:
             action = env.get("DSLIB_HEALTH_ACTION", "retry")
@@ -166,6 +172,9 @@ class HealthPolicy:
         self.enabled = (env.get("DSLIB_HEALTH", "1") != "0") \
             if enabled is None else bool(enabled)
         self.seed = int(seed)
+        self.elastic_attempts = \
+            int(env.get("DSLIB_HEALTH_ELASTIC_ATTEMPTS", 0)) \
+            if elastic_attempts is None else int(elastic_attempts)
 
     def make_guard(self, name, checkpoint=None):
         """Build the per-fit guard.  Fault-injection policies
@@ -286,6 +295,8 @@ class ChunkGuard:
         t.start()
         t.join(deadline)
         if t.is_alive():
+            from dislib_tpu.utils.profiling import count_resilience
+            count_resilience("watchdog_trips")
             raise WatchdogTimeout(
                 f"{self.name}: chunk {self.chunk_index} force point "
                 f"exceeded its {deadline}s deadline — hung collective or "
@@ -400,6 +411,13 @@ class ChunkGuard:
             detail["max_abs"] = float(h[_SLOT_MAX_ABS])
             return Verdict(False, guard="norm-growth", detail=detail)
         return Verdict(True)
+
+    def on_escalation(self, escalation) -> None:
+        """Notification hook the fit-loop driver fires after every
+        ladder escalation (``runtime.fitloop.Escalation``).  Production
+        guards ignore it; tier-targeted fault injectors
+        (``utils.faults.FaultAtTier``) use it to stop firing once the
+        right remediation tier is reached."""
 
     # -- gated snapshot writes ------------------------------------------
 

@@ -40,9 +40,8 @@ from jax import lax
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
-from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows, \
-    preemption_requested as _preemption_requested, \
-    raise_if_preempted as _raise_if_preempted
+from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows
+from dislib_tpu.runtime import fitloop as _fitloop
 from dislib_tpu.runtime import health as _health
 
 # Discretisation contract (documented divergence from the reference, which
@@ -281,7 +280,7 @@ class _BaseTreeEnsemble(BaseEstimator):
         growth reads state to host between chunks (only then)."""
         m, n = x.shape
         depth = self._effective_depth(m)
-        snap = fp = digest = None
+        fp = digest = None
         if checkpoint is not None:
             from dislib_tpu.utils.checkpoint import (data_digest,
                                                      validate_snapshot)
@@ -296,135 +295,113 @@ class _BaseTreeEnsemble(BaseEstimator):
                              -1.0 if rs is None else float(rs),
                              float(self._n_bins())], np.float64)
             digest = data_digest(x._data, stats=stats_host)
-            snap = checkpoint.load()
-            if snap is not None:
-                if "fp" in snap and np.size(snap["fp"]) == len(fp) - 1:
-                    # pre-n_bins forest snapshot (8-knob fp): the grown
-                    # state depends on a knob the old fp never recorded
-                    raise ValueError(
-                        "checkpoint was written by a different library "
-                        "version (forest fingerprint predates n_bins) — "
-                        "delete the snapshot file to restart the fit")
-                validate_snapshot(snap, fp, digest)
-        if snap is not None:
-            seed = int(snap["seed"])
-        else:
-            seed = self.random_state if self.random_state is not None else \
-                np.random.randint(0, 2**31 - 1)
-        key = jax.random.PRNGKey(int(seed))
 
         n_bins = self._n_bins()
         edges = _quantile_bins(x._data, x.shape, n_bins)
         bx = _bin_data(x._data, x.shape, edges)
         mp = x._data.shape[0]
         valid = (np.arange(mp) < m).astype(np.float32)
+        stats = jnp.asarray(stats_host)               # (mp, S)
+        try_features = self._try_features_count(n)
+        box = {"feats": [], "tbins": []}
+        loop = _fitloop.ChunkedFitLoop(
+            "forest", checkpoint=checkpoint, health=health,
+            max_iter=depth, chunk_iters=1,
+            save_every=checkpoint.every if checkpoint is not None else 1,
+            # the fused per-level health vector is read at snapshot
+            # boundaries only (one sync per chunk, same cadence as the
+            # snapshot's own blocking fetches); unchecked growth defers to
+            # the adoption-time check
+            check_on="save",
+            # growth snapshots only resumable mid-points, never the final
+            # level (leaves are derived after the loop)
+            save_final=False,
+            carry_names=("node_totals", "w"))
 
-        k_boot, key = jax.random.split(key)
-        if snap is not None:
-            start_lvl = int(snap["lvl"])
-            # node assignment and bootstrap weights are per-(padded-)sample:
-            # re-pad them for THIS mesh's quantum so an 8-device snapshot
-            # resumes on a 4-device (or 2-D) mesh — pad columns carry w=0,
-            # so zero-filling them is exact (elastic resume)
-            node = jnp.asarray(_repad_rows(snap["node"], m, mp, axis=1))
-            w = jnp.asarray(_repad_rows(snap["w"], m, mp, axis=1))
-            feats = [jnp.asarray(snap[f"feats_{i}"]) for i in range(start_lvl)]
-            tbins = [jnp.asarray(snap[f"tbins_{i}"]) for i in range(start_lvl)]
-            for _ in range(start_lvl):       # replay the key chain
+        def _keys_for(seed, lvl):
+            # replay the PRNG key chain to `lvl` — a resumed or
+            # rolled-back growth stays bit-identical
+            key = jax.random.PRNGKey(int(seed))
+            k_boot, key = jax.random.split(key)
+            for _ in range(lvl):
                 key, _ = jax.random.split(key)
-        else:
-            start_lvl = 0
+            return k_boot, key
+
+        def init(rem):
+            if "seed" not in box:       # chosen once; rollbacks replay it
+                box["seed"] = self.random_state \
+                    if self.random_state is not None \
+                    else np.random.randint(0, 2**31 - 1)
+            k_boot, box["key"] = _keys_for(box["seed"], 0)
+            box["feats"], box["tbins"] = [], []
             if bootstrap:
                 w = jax.random.poisson(k_boot, 1.0,
                                        (n_trees, mp)).astype(jnp.float32)
             else:
                 w = jnp.ones((n_trees, mp), jnp.float32)
             w = w * jnp.asarray(valid)[None, :]
-            node = jnp.zeros((n_trees, mp), jnp.int32)
-            feats, tbins = [], []
+            if rem.attempt:             # from-scratch rollback perturbs w
+                w = jnp.asarray(rem.perturb(_fetch(w)))
+            return _fitloop.LoopState(
+                (w,), extra=jnp.zeros((n_trees, mp), jnp.int32))
 
-        stats = jnp.asarray(stats_host)               # (mp, S)
-        try_features = self._try_features_count(n)
-        guard = _health.guard("forest", health, checkpoint)
+        def restore(snap, rem):
+            if "fp" in snap and np.size(snap["fp"]) == len(fp) - 1:
+                # pre-n_bins forest snapshot (8-knob fp): the grown state
+                # depends on a knob the old fp never recorded
+                raise ValueError(
+                    "checkpoint was written by a different library "
+                    "version (forest fingerprint predates n_bins) — "
+                    "delete the snapshot file to restart the fit")
+            validate_snapshot(snap, fp, digest)
+            box["seed"] = int(snap["seed"])
+            lvl = int(snap["lvl"])
+            _, box["key"] = _keys_for(box["seed"], lvl)
+            # node assignment and bootstrap weights are per-(padded-)sample:
+            # re-pad them for THIS mesh's quantum so an 8-device snapshot
+            # resumes on a 4-device (or 2-D) mesh — pad columns carry w=0,
+            # so zero-filling them is exact (elastic resume)
+            node = jnp.asarray(_repad_rows(snap["node"], m, mp, axis=1))
+            w = jnp.asarray(rem.perturb(_repad_rows(snap["w"], m, mp,
+                                                    axis=1)))
+            box["feats"] = [jnp.asarray(snap[f"feats_{i}"])
+                            for i in range(lvl)]
+            box["tbins"] = [jnp.asarray(snap[f"tbins_{i}"])
+                            for i in range(lvl)]
+            return _fitloop.LoopState((w,), it=lvl, extra=node)
 
-        def _snap(lvl_next):
+        def step(st, chunk):
+            box["key"], k_lvl = jax.random.split(box["key"])
+            keys = jax.random.split(k_lvl, n_trees)
+            (w,) = st.carries
+            feat, tbin, is_split, node, _, hvec = _forest_level(
+                st.extra, bx, w, stats, keys, 2 ** st.it, try_features,
+                0.0, self._criterion, n_bins)
+            box["feats"].append(feat)
+            box["tbins"].append(tbin)
+            nxt = st.it + 1
+            return _fitloop.ChunkOutcome(
+                _fitloop.LoopState((w,), nxt, nxt == depth, extra=node),
+                hvec=hvec)
+
+        def snapshot(st):
             # node is donated to the next level's kernel — its copy must
             # land on host before that dispatch (blocking fetch); only the
-            # checksum+file write moves to the snapshot worker.  The write
-            # is GATED on the chunk's health verdict (guard.save_async).
-            state = {"lvl": lvl_next, "seed": seed, "fp": fp,
-                     "digest": digest, "node": _fetch(node), "w": _fetch(w)}
-            for i, (f_, t_) in enumerate(zip(feats, tbins)):
+            # checksum+file write moves to the snapshot worker
+            state = {"lvl": st.it, "seed": box["seed"], "fp": fp,
+                     "digest": digest, "node": _fetch(st.extra),
+                     "w": _fetch(st.carries[0])}
+            for i, (f_, t_) in enumerate(zip(box["feats"], box["tbins"])):
                 state[f"feats_{i}"] = _fetch(f_)
                 state[f"tbins_{i}"] = _fetch(t_)
-            guard.save_async(checkpoint, state)
+            return state
 
-        base_lvl = start_lvl            # snapshot cadence anchor
-        lvl = start_lvl
-        while lvl < depth:
-            key, k_lvl = jax.random.split(key)
-            keys = jax.random.split(k_lvl, n_trees)
-            (w,) = guard.admit(w)
-            feat, tbin, is_split, node, _, hvec = _forest_level(
-                node, bx, w, stats, keys, 2 ** lvl, try_features,
-                0.0, self._criterion, n_bins)
-            feats.append(feat)
-            tbins.append(tbin)
-            nxt = lvl + 1
-            if checkpoint is not None:
-                at_every = (nxt - base_lvl) % checkpoint.every == 0
-                preempt = _preemption_requested()
-                if nxt == depth or at_every or preempt:
-                    # chunk boundary: the fused per-level health vector is
-                    # read here (one sync per chunk, same cadence as the
-                    # snapshot's own blocking fetches)
-                    verdict = guard.check(
-                        hvec, carry_names=("node_totals", "w"), it=nxt)
-                    if not verdict.ok:
-                        rem = guard.remediate(verdict, it=nxt)
-                        snap2 = checkpoint.load()
-                        if snap2 is not None:   # last-good level boundary
-                            base_lvl = int(snap2["lvl"])
-                            node = jnp.asarray(
-                                _repad_rows(snap2["node"], m, mp, axis=1))
-                            w = jnp.asarray(rem.perturb(
-                                _repad_rows(snap2["w"], m, mp, axis=1)))
-                            feats = [jnp.asarray(snap2[f"feats_{i}"])
-                                     for i in range(base_lvl)]
-                            tbins = [jnp.asarray(snap2[f"tbins_{i}"])
-                                     for i in range(base_lvl)]
-                        else:           # nothing written yet: from scratch
-                            base_lvl = 0
-                            if bootstrap:
-                                w = jax.random.poisson(
-                                    k_boot, 1.0,
-                                    (n_trees, mp)).astype(jnp.float32)
-                            else:
-                                w = jnp.ones((n_trees, mp), jnp.float32)
-                            w = rem.perturb(_fetch(w * jnp.asarray(
-                                valid)[None, :]))
-                            w = jnp.asarray(w)
-                            node = jnp.zeros((n_trees, mp), jnp.int32)
-                            feats, tbins = [], []
-                        # replay the PRNG key chain to the rollback level —
-                        # a resumed growth stays bit-identical
-                        key = jax.random.PRNGKey(int(seed))
-                        k_boot, key = jax.random.split(key)
-                        for _ in range(base_lvl):
-                            key, _ = jax.random.split(key)
-                        lvl = base_lvl
-                        continue
-                if nxt < depth and (at_every or preempt):
-                    _snap(nxt)
-                    # preemption notice between levels: snapshot NOW (the
-                    # off-`every` case included) and raise cleanly — a
-                    # level boundary is always a resumable point
-                    _raise_if_preempted(checkpoint)
-            lvl = nxt
-
-        if checkpoint is not None:
-            checkpoint.flush()          # last level snapshot lands
-        leaves, leaf_hvec = _leaf_stats(node, w, stats, 2 ** depth)
+        st = loop.run(init=init, step=step, restore=restore,
+                      snapshot=snapshot)
+        self.fit_info_ = loop.info
+        feats, tbins = box["feats"], box["tbins"]
+        leaves, leaf_hvec = _leaf_stats(st.extra, st.carries[0], stats,
+                                        2 ** depth)
         # feats/tbins stay as the ragged per-level device arrays: packing
         # here would dispatch eager multi-device pad/stack programs while
         # the level producers are still in flight — on a thread-starved
@@ -436,7 +413,7 @@ class _BaseTreeEnsemble(BaseEstimator):
         # dispatch-only contract of this function is preserved.
         return {"edges": edges, "feats": tuple(feats), "tbins": tuple(tbins),
                 "depth": depth, "leaves": leaves, "n_features": n,
-                "hvec": leaf_hvec, "guard": guard}
+                "hvec": leaf_hvec, "guard": loop.guard}
 
     def _adopt_forest(self, grown):
         """Materialise fitted attributes from a `_grow_forest` handle.

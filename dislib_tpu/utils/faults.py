@@ -39,7 +39,8 @@ from dislib_tpu.utils.checkpoint import FitCheckpoint
 
 __all__ = ["CallbackCheckpoint", "SigtermAtNthSave", "sigterm_self",
            "corrupt_snapshot", "FlakyCall", "FlakyOpen",
-           "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk"]
+           "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk",
+           "FaultAtTier"]
 
 
 class CallbackCheckpoint(FitCheckpoint):
@@ -311,9 +312,64 @@ class _TripAtChunkGuard(ChunkGuard):
             return v
         return None
 
-    def check(self, hvec, carry_names=(), carry_shapes=(), it=None):
+    def check(self, hvec, carry_names=(), carry_shapes=(), it=None,
+              increasing=False):
         return self._maybe_trip(it) or super().check(
-            hvec, carry_names, carry_shapes, it)
+            hvec, carry_names, carry_shapes, it, increasing)
+
+    def check_host(self, values, it=None):
+        return self._maybe_trip(it) or super().check_host(values, it)
+
+
+class FaultAtTier(HealthPolicy):
+    """Health policy whose guard trips EVERY check (from ``at_chunk`` on)
+    until the fit-loop escalation ladder reaches remediation tier
+    ``tiers`` — i.e. the fault "defeats" exactly the first ``tiers``
+    ladder tiers (0 = healed by the first plain chunk retry, 1 = defeats
+    retry, healed by policy remediation, 2 = defeats retry AND
+    remediation, healed only by the elastic mesh-shrink, 3 = defeats the
+    whole ladder and forces the typed raise).  The healing signal is the
+    driver's :meth:`~dislib_tpu.runtime.health.ChunkGuard.on_escalation`
+    notification, so the injector tracks the LADDER's actual tier — not a
+    guessed attempt count — and a schedule change cannot silently turn a
+    tier-2 drill into a tier-1 one.  Give the policy a budget that makes
+    the target tier reachable (e.g. ``max_restarts=3,
+    elastic_attempts=1`` for tier 2)."""
+
+    def __init__(self, tiers=1, at_chunk=1, guard_name="fault-at-tier",
+                 **kw):
+        super().__init__(**kw)
+        self.tiers = int(tiers)
+        self.at_chunk = int(at_chunk)
+        self.guard_name = guard_name
+        self.fired = 0
+        self.healed = False
+
+    def make_guard(self, name, checkpoint=None):
+        return _FaultAtTierGuard(name, self, checkpoint)
+
+
+class _FaultAtTierGuard(ChunkGuard):
+    def _maybe_trip(self, it):
+        pol = self.policy
+        if self.chunk_index >= pol.at_chunk and not pol.healed:
+            pol.fired += 1
+            v = Verdict(False, guard=pol.guard_name,
+                        detail={"iteration": it, "injected": True,
+                                "defeats_tiers": pol.tiers})
+            self.last_verdict = v
+            return v
+        return None
+
+    def on_escalation(self, escalation):
+        # the re-run AFTER an escalation that reached tier `tiers` passes
+        if escalation.tier_index >= self.policy.tiers:
+            self.policy.healed = True
+
+    def check(self, hvec, carry_names=(), carry_shapes=(), it=None,
+              increasing=False):
+        return self._maybe_trip(it) or super().check(
+            hvec, carry_names, carry_shapes, it, increasing)
 
     def check_host(self, values, it=None):
         return self._maybe_trip(it) or super().check_host(values, it)

@@ -71,10 +71,13 @@ def op_graph(fn, *args, **kwargs) -> str:
 class _Counters:
     """Process-wide dispatch/trace/transfer tallies, total and per kernel
     name (transfers are total-only: one per host↔device boundary crossing
-    at the blessed sync points)."""
+    at the blessed sync points), plus the round-12 resilience tallies —
+    host-side integers bumped by the fit-loop driver, the watchdog, and
+    the ingest quarantine, so surfacing them costs ZERO extra dispatches
+    (asserted against the dispatch counters in ``tests/test_fitloop``)."""
 
     __slots__ = ("dispatches", "traces", "transfers", "dispatch_by",
-                 "trace_by")
+                 "trace_by", "resilience")
 
     def __init__(self):
         self.dispatches = 0
@@ -82,6 +85,7 @@ class _Counters:
         self.transfers = 0
         self.dispatch_by: dict[str, int] = {}
         self.trace_by: dict[str, int] = {}
+        self.resilience: dict[str, int] = {}
 
 
 _COUNTERS = _Counters()
@@ -143,6 +147,23 @@ def transfer_count() -> int:
     return _COUNTERS.transfers
 
 
+def count_resilience(key: str, n: int = 1) -> None:
+    """Record ``n`` resilience events under ``key`` — the blessed keys are
+    ``rollbacks``, ``chunk_retries``, ``escalations_<tier>``,
+    ``mesh_shrinks`` (the fit-loop driver), ``watchdog_trips`` (the chunk
+    guard), and ``quarantined_rows`` (ingest)."""
+    with _COUNTERS_LOCK:
+        _COUNTERS.resilience[key] = _COUNTERS.resilience.get(key, 0) + n
+
+
+def resilience_counters() -> dict:
+    """Resilience tallies since the last ``reset_counters()`` — rollbacks,
+    chunk retries, watchdog trips, escalations per ladder tier, mesh
+    shrinks, quarantined rows (keys absent until their first event)."""
+    with _COUNTERS_LOCK:
+        return dict(_COUNTERS.resilience)
+
+
 def dispatch_count() -> int:
     """Total library-kernel dispatches since the last `reset_counters()`."""
     return _COUNTERS.dispatches
@@ -161,7 +182,8 @@ def counters() -> dict:
                 "traces": _COUNTERS.traces,
                 "transfers": _COUNTERS.transfers,
                 "dispatch_by": dict(_COUNTERS.dispatch_by),
-                "trace_by": dict(_COUNTERS.trace_by)}
+                "trace_by": dict(_COUNTERS.trace_by),
+                "resilience": dict(_COUNTERS.resilience)}
 
 
 def reset_counters() -> None:
@@ -172,6 +194,7 @@ def reset_counters() -> None:
         _COUNTERS.transfers = 0
         _COUNTERS.dispatch_by.clear()
         _COUNTERS.trace_by.clear()
+        _COUNTERS.resilience.clear()
 
 
 def memory_stats():

@@ -131,7 +131,9 @@ def test_chaos_soak(tmp_path, monkeypatch):
         kind, outcome = _one_run(i, base + i, tmp_path, monkeypatch)
         tally[f"{kind}:{outcome}"] += 1
         clear_preemption()
+    from dislib_tpu.utils import profiling as prof
     summary = {"metric": "chaos_soak", "runs": runs, "seed": base,
-               "outcomes": dict(sorted(tally.items()))}
+               "outcomes": dict(sorted(tally.items())),
+               "resilience": prof.resilience_counters()}
     print("CHAOS_SOAK_SUMMARY " + json.dumps(summary))
     assert sum(tally.values()) == runs

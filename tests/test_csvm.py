@@ -250,3 +250,32 @@ class TestSparseNative:
         diff = set(m1._sv_idx.tolist()) ^ set(m2._sv_idx.tolist())
         assert len(diff) <= max(3, len(m1._sv_idx) // 50), \
             f"SV sets diverge by {len(diff)} vectors"
+
+
+def test_raised_refit_does_not_poison_the_previous_model(rng, tmp_path):
+    """A refit that ends in a typed raise (budget spent, no rollback
+    target) must leave the previously fitted attributes untouched — the
+    per-iteration SV updates are deferred behind the health verdict
+    (review-found, pinned)."""
+    import numpy as np
+    import pytest
+    import dislib_tpu as ds
+    from dislib_tpu.classification import CascadeSVM
+    from dislib_tpu.runtime import NumericalDivergence
+    from dislib_tpu.utils import faults
+
+    n = 120
+    xh = np.vstack([rng.randn(n // 2, 4) - 2,
+                    rng.randn(n // 2, 4) + 2]).astype(np.float32)
+    yh = np.r_[np.zeros(n // 2), np.ones(n // 2)].astype(np.float32)
+    sh = rng.permutation(n)
+    x, y = ds.array(xh[sh]), ds.array(yh[sh].reshape(-1, 1))
+    kw = dict(cascade_arity=2, c=1.0, kernel="rbf", gamma=0.3,
+              check_convergence=False, max_iter=4)
+    est = CascadeSVM(**kw).fit(x, y)
+    alpha0, idx0 = est._sv_alpha.copy(), est._sv_idx.copy()
+    with pytest.raises(NumericalDivergence):
+        est.fit(x, y, health=faults.TripAtChunk(at_chunk=1, times=10))
+    np.testing.assert_array_equal(est._sv_alpha, alpha0)
+    np.testing.assert_array_equal(est._sv_idx, idx0)
+    assert np.isfinite(est.decision_function(x).collect()).all()

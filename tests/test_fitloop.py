@@ -1,0 +1,266 @@
+"""The unified chunk-fit driver (round-12 tentpole): escalation-ladder
+schedule semantics, the tier-targeted ``FaultAtTier`` injector, the
+resilience counters (host-side — zero extra dispatches, asserted against
+the dispatch counters), the fit ``info`` surface, and the PINNED
+elastic-tier scenario: a fault that defeats the retry AND remediation
+tiers escalates to the mesh-shrink tier, the fit resumes on half the
+devices, and the healed model equals the unfaulted oracle.
+
+Shapes mirror ``tests/test_health.py`` so the fit kernels compile once
+per suite, not once per file.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import KMeans
+from dislib_tpu.runtime import NumericalDivergence
+from dislib_tpu.runtime.fitloop import (ChunkedFitLoop, ChunkOutcome,
+                                        EscalationLadder, LoopState, TIERS)
+from dislib_tpu.runtime.health import HealthPolicy, Verdict
+from dislib_tpu.utils import FitCheckpoint, faults
+from dislib_tpu.utils import profiling as prof
+
+
+def _blobs(rng, n=198, d=4, k=3):
+    centers = rng.rand(k, d) * 10
+    x = np.vstack([centers[i] + 0.3 * rng.randn(n // k, d) for i in range(k)])
+    return x.astype(np.float32)
+
+
+def _kmeans_setup(rng):
+    x_np = _blobs(rng)
+    init = np.ascontiguousarray(x_np[[0, 70, 140]])
+    kw = dict(n_clusters=3, init=init, max_iter=12, tol=0.0)
+    return ds.array(x_np), kw
+
+
+# ---------------------------------------------------------------------------
+# ladder schedule semantics
+# ---------------------------------------------------------------------------
+
+class TestLadderSchedule:
+    def _ladder(self, elastic_ok=True, **pol):
+        g = HealthPolicy(**pol).make_guard("t", checkpoint=object())
+        return EscalationLadder(g, elastic_ok=elastic_ok)
+
+    def test_default_budget_schedule_is_retry_then_remediate(self):
+        # max_restarts=2 default: exactly the pre-extraction budget —
+        # two rollbacks then the typed raise, tiers deciding WHAT each does
+        assert self._ladder().schedule == ["retry", "remediate"]
+
+    def test_elastic_rungs_are_last_and_opt_in(self):
+        assert self._ladder(max_restarts=3, elastic_attempts=1).schedule \
+            == ["retry", "remediate", "elastic"]
+        assert self._ladder(max_restarts=3).schedule \
+            == ["retry", "remediate", "remediate"]
+        # no elastic hook (elastic_ok=False): the rung is never offered
+        assert self._ladder(elastic_ok=False, max_restarts=3,
+                            elastic_attempts=1).schedule \
+            == ["retry", "remediate", "remediate"]
+
+    def test_escalation_walks_the_schedule_and_raises_at_budget(self):
+        lad = self._ladder(max_restarts=3, elastic_attempts=1,
+                           action="halve")
+        bad = Verdict(False, guard="nonfinite")
+        e1, e2, e3 = (lad.escalate(bad) for _ in range(3))
+        assert [e.tier for e in (e1, e2, e3)] == list(TIERS)
+        assert (e1.attempt, e2.attempt, e3.attempt) == (1, 2, 3)
+        # tier-adjusted remediation: plain retry tiers never damp/perturb,
+        # the remediate tier applies the policy action from ITS first rung
+        assert e1.remediation.damping == 1.0
+        assert e2.remediation.damping == 2.0
+        assert e3.remediation.damping == 1.0
+        with pytest.raises(NumericalDivergence, match="max_restarts"):
+            lad.escalate(bad)
+
+    def test_escalations_feed_the_resilience_counters(self):
+        prof.reset_counters()
+        lad = self._ladder(max_restarts=3, elastic_attempts=1)
+        bad = Verdict(False, guard="nonfinite")
+        for _ in range(3):
+            lad.escalate(bad)
+        r = prof.resilience_counters()
+        assert r["rollbacks"] == 3 and r["chunk_retries"] == 1
+        assert r["escalations_retry"] == 1
+        assert r["escalations_remediate"] == 1
+        assert r["escalations_elastic"] == 1
+
+
+# ---------------------------------------------------------------------------
+# deferred commit: estimator-side syncs stay BEHIND the watchdogged check
+# ---------------------------------------------------------------------------
+
+class TestDeferredCommit:
+    def test_commit_thunk_runs_only_after_a_passing_verdict(self, tmp_path):
+        """A step whose successor state is a CALLABLE must see it invoked
+        only for chunks whose verdict passed: the convergence-scalar
+        syncs inside it therefore sit behind the watchdogged hvec read (a
+        hung kernel trips `WatchdogTimeout` at the check, never blocks in
+        estimator code), and a faulted chunk's side effects never run —
+        the review-found watchdog-coverage regression, pinned."""
+        calls = {"steps": 0, "commits": 0}
+        ck = FitCheckpoint(str(tmp_path / "d.npz"), every=1)
+        loop = ChunkedFitLoop("t", checkpoint=ck, max_iter=3, chunk_iters=1,
+                              health=faults.TripAtChunk(at_chunk=2, times=1))
+
+        def init(rem):
+            return LoopState(())
+
+        def restore(snap, rem):
+            return LoopState((), it=int(snap["it"]))
+
+        def step(st, chunk):
+            calls["steps"] += 1
+
+            def commit():
+                calls["commits"] += 1
+                return LoopState((), st.it + 1, False)
+
+            return ChunkOutcome(commit,
+                                host_values={"v": np.asarray([1.0])})
+
+        st = loop.run(init=init, step=step, restore=restore,
+                      snapshot=lambda st: {"it": st.it})
+        assert st.it == 3
+        assert calls["steps"] == 4, "one chunk re-ran after the rollback"
+        assert calls["commits"] == 3, \
+            "a faulted chunk's deferred commit must never run"
+
+
+# ---------------------------------------------------------------------------
+# FaultAtTier: defeats exactly N tiers
+# ---------------------------------------------------------------------------
+
+class TestFaultAtTier:
+    def test_tier0_heals_on_first_plain_retry(self, rng, tmp_path):
+        x, kw = _kmeans_setup(rng)
+        full = KMeans(**kw).fit(x)
+        pol = faults.FaultAtTier(tiers=0, at_chunk=2)
+        res = KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+            health=pol)
+        assert pol.fired == 1 and pol.healed
+        assert res.fit_info_["escalations"] == \
+            {"retry": 1, "remediate": 0, "elastic": 0}
+        np.testing.assert_allclose(res.centers_, full.centers_, rtol=1e-5)
+
+    def test_tier1_defeats_retry_heals_on_remediation(self, rng, tmp_path):
+        x, kw = _kmeans_setup(rng)
+        full = KMeans(**kw).fit(x)
+        pol = faults.FaultAtTier(tiers=1, at_chunk=2)
+        res = KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+            health=pol)
+        assert pol.fired == 2 and pol.healed
+        assert res.fit_info_["escalations"] == \
+            {"retry": 1, "remediate": 1, "elastic": 0}
+        np.testing.assert_allclose(res.centers_, full.centers_, rtol=1e-5)
+
+    def test_whole_ladder_defeated_raises_typed(self, rng, tmp_path):
+        x, kw = _kmeans_setup(rng)
+        pol = faults.FaultAtTier(tiers=3, at_chunk=2, max_restarts=2)
+        with pytest.raises(NumericalDivergence, match="max_restarts"):
+            KMeans(**kw).fit(
+                x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"),
+                                            every=2),
+                health=pol)
+        assert pol.fired == 3 and not pol.healed
+
+
+# ---------------------------------------------------------------------------
+# the PINNED elastic-tier scenario (acceptance): a fault that defeats
+# retry AND remediation escalates to the mesh-shrink tier; the fit
+# resumes on half the devices and equals the unfaulted oracle
+# ---------------------------------------------------------------------------
+
+class TestElasticTier:
+    def test_mesh_shrink_resume_equals_unfaulted_oracle(self, rng,
+                                                        tmp_path):
+        from conftest import skip_unless_devices
+        skip_unless_devices(8)
+        ds.init((8, 1), devices=jax.devices()[:8])
+        x, kw = _kmeans_setup(rng)
+        full = KMeans(**kw).fit(x)
+
+        ds.init((8, 1), devices=jax.devices()[:8])
+        pol = faults.FaultAtTier(tiers=2, at_chunk=2, max_restarts=3,
+                                 elastic_attempts=1)
+        prof.reset_counters()
+        res = KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+            health=pol)
+        # the ladder actually reached the elastic tier and shrank the mesh
+        assert pol.healed and pol.fired == 3
+        assert res.fit_info_["mesh_shrinks"] == 1
+        assert res.fit_info_["escalations"]["elastic"] == 1
+        assert ds.get_mesh().shape["rows"] == 4, \
+            "elastic tier must halve the mesh's row axis"
+        assert prof.resilience_counters()["mesh_shrinks"] == 1
+        # the resumed model equals the unfaulted oracle
+        assert res.n_iter_ == full.n_iter_
+        np.testing.assert_allclose(res.centers_, full.centers_,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_unshrinkable_mesh_degrades_to_plain_retry(self, rng, tmp_path):
+        ds.init((1, 1), devices=jax.devices()[:1])
+        x, kw = _kmeans_setup(rng)
+        full = KMeans(**kw).fit(x)
+        pol = faults.FaultAtTier(tiers=2, at_chunk=2, max_restarts=3,
+                                 elastic_attempts=1)
+        res = KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+            health=pol)
+        # the elastic rung still runs (heals the tier-targeted fault) but
+        # cannot shrink a single-row mesh — deterministic degradation
+        assert pol.healed and res.fit_info_["mesh_shrinks"] == 0
+        assert ds.get_mesh().shape["rows"] == 1
+        np.testing.assert_allclose(res.centers_, full.centers_, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# counters: populated by a healed fit, at zero extra dispatches
+# ---------------------------------------------------------------------------
+
+class TestResilienceCounters:
+    def test_healed_fit_counts_and_costs_only_the_retried_chunk(
+            self, rng, tmp_path):
+        x, kw = _kmeans_setup(rng)
+        ck = FitCheckpoint(str(tmp_path / "warm.npz"), every=2)
+        KMeans(**kw).fit(x, checkpoint=ck)          # warm the compile caches
+        ck.delete()
+
+        prof.reset_counters()
+        KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "ref.npz"), every=2))
+        clean = prof.counters()
+        assert prof.resilience_counters() == {}, \
+            "an unfaulted fit must not count resilience events"
+
+        prof.reset_counters()
+        res = KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "f.npz"), every=2),
+            health=faults.NaNAtChunk(at_chunk=3))
+        faulted = prof.counters()
+        r = faulted["resilience"]
+        assert r["rollbacks"] == 1 and r["chunk_retries"] == 1
+        assert r["escalations_retry"] == 1
+        assert res.fit_info_["rollbacks"] == 1
+        # the counters are host-side integers: the ONLY extra device work
+        # of the healed fit is the one re-run chunk
+        assert faulted["dispatch_by"]["kmeans_fit"] == \
+            clean["dispatch_by"]["kmeans_fit"] + 1
+
+    def test_watchdog_trips_are_counted(self, rng, tmp_path, monkeypatch):
+        monkeypatch.setenv("DSLIB_RETRY_BACKOFF", "0")
+        x, kw = _kmeans_setup(rng)
+        prof.reset_counters()
+        pol = faults.HangAtChunk(at_chunk=2, hang_s=0.4, deadline_s=0.05,
+                                 times=1)
+        KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+            health=pol)
+        assert prof.resilience_counters()["watchdog_trips"] == 1

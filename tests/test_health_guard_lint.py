@@ -1,13 +1,23 @@
-"""Health-guard lint (round-8 robustness PR, the `test_host_sync_lint`
-pattern): every chunked fit loop must (1) register a runtime health guard,
-(2) actually judge each chunk with it, and (3) route every snapshot write
-through the guard's gate — a direct ``checkpoint.save_async`` would let an
-unhealthy chunk rotate the last GOOD generation out of the checkpoint,
-which is exactly the corruption mode the health layer exists to prevent.
+"""Fit-loop driver lint (round-12 robustness PR, retargeted from the
+round-8 guard lint): the per-chunk resilience protocol — guard
+registration, admit, health checks, verdict-gated snapshot writes,
+rollback, preemption polls — lives in ONE place,
+``dislib_tpu.runtime.fitloop.ChunkedFitLoop``.  Estimator code that
+hand-rolls any piece of it is a lint failure:
 
-Enforced by AST scan so a new estimator (or a refactor of an existing
-one) cannot silently ship an unguarded loop: add the loop to the registry
-and wire the guard, or consciously change this lint with a reason.
+1. every chunked fit loop in the registry must actually drive its chunks
+   through ``ChunkedFitLoop`` (``run``/``run_one``);
+2. estimator code may not call the protocol primitives directly —
+   ``save_async``/``checkpoint.save`` (an ungated write could rotate the
+   last GOOD generation away), ``remediate``/``admit``/``check``/
+   ``check_host`` (a private rollback block bypasses the escalation
+   ladder and its shared budget), ``checkpoint.load`` (rollback targets
+   belong to the driver), or the preemption polls (a hand-rolled chunk
+   boundary).  Exceptions live in the allowlist WITH a reason, and a
+   dead allowlist entry is itself a failure;
+3. the streaming recipe stays honest: ``MiniBatchKMeans.partial_fit``
+   (the zero-bespoke-resilience acceptance estimator) is registry-bound
+   like the seven ported loops.
 """
 
 import ast
@@ -16,11 +26,10 @@ import os
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # every chunked fit loop in the library: (file, function) — the function
-# must build a guard (`_health.guard(...)`), judge chunks
-# (`guard.check(...)` / `guard.check_host(...)`), and gate writes
-# (`guard.save_async(...)`)
+# must instantiate ChunkedFitLoop and call .run(...) / .run_one(...)
 CHUNKED_FIT_LOOPS = {
     ("dislib_tpu/cluster/kmeans.py", "fit"),
+    ("dislib_tpu/cluster/minibatch.py", "partial_fit"),
     ("dislib_tpu/cluster/gm.py", "fit"),
     ("dislib_tpu/recommendation/als.py", "fit"),
     ("dislib_tpu/classification/csvm.py", "fit"),
@@ -41,18 +50,41 @@ ESTIMATOR_DIRS = (
     "dislib_tpu/model_selection",
 )
 
+# (file, attr) -> reason.  Every entry must still occur in the file
+# (dead entries would quietly bless future hand-rolled loops).
+ALLOWLIST = {
+    ("dislib_tpu/trees/decision_tree.py", "check"):
+        "adoption-time health gate: _adopt_forest judges the grown "
+        "forest's fused leaf hvec at its first host materialisation — "
+        "there is no loop left to roll back, so the driver cannot own "
+        "this check",
+}
+
+# protocol primitives the driver owns.  attr -> receiver restriction
+# (None = any receiver; a tuple restricts to those receiver names so
+# generic verbs like `load` don't false-positive on np.load)
+FORBIDDEN_CALLS = {
+    "save_async": None,
+    "remediate": None,
+    "admit": None,
+    "check_host": None,
+    "check": ("guard", "g"),
+    "save": ("checkpoint", "ck"),
+    "load": ("checkpoint", "ck"),
+    "raise_if_preempted": None,
+    "preemption_requested": None,
+}
+
 
 def _functions(path):
     tree = ast.parse(open(path, encoding="utf-8").read())
     out = {}
 
-    def walk(node, prefix=""):
+    def walk(node):
         for child in ast.iter_child_nodes(node):
             if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 out.setdefault(child.name, child)
-                walk(child, child.name)
-            else:
-                walk(child, prefix)
+            walk(child)
 
     walk(tree)
     return out
@@ -64,19 +96,18 @@ def _calls(node):
             yield sub
 
 
-def _attr_call(call, attr):
+def _call_name(call):
+    """(attr_or_func_name, receiver_name_or_None)."""
     f = call.func
-    return isinstance(f, ast.Attribute) and f.attr == attr
+    if isinstance(f, ast.Attribute):
+        recv = f.value.id if isinstance(f.value, ast.Name) else None
+        return f.attr, recv
+    if isinstance(f, ast.Name):
+        return f.id, None
+    return None, None
 
 
-def _receiver_name(call):
-    f = call.func
-    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
-        return f.value.id
-    return None
-
-
-def test_every_chunked_fit_loop_registers_a_guard_and_checks_chunks():
+def test_every_chunked_fit_loop_runs_on_the_driver():
     missing = []
     for rel, fname in sorted(CHUNKED_FIT_LOOPS):
         fns = _functions(os.path.join(REPO, rel))
@@ -85,54 +116,55 @@ def test_every_chunked_fit_loop_registers_a_guard_and_checks_chunks():
             missing.append(f"{rel}: function {fname}() no longer exists — "
                            "update the lint registry")
             continue
-        calls = list(_calls(fn))
-        registers = any(
-            (_attr_call(c, "guard") and _receiver_name(c) == "_health")
-            or _attr_call(c, "make_guard")
-            for c in calls)
-        # dbscan/daura build the guard in fit() and pass it down — accept
-        # a `guard` parameter as registration for those
-        takes_param = any(a.arg == "guard" for a in fn.args.args)
-        if not (registers or takes_param):
-            missing.append(f"{rel}:{fname}() never registers a health "
-                           "guard (_health.guard(...))")
-        checks = any(_attr_call(c, "check") or _attr_call(c, "check_host")
-                     for c in calls
-                     if _receiver_name(c) in ("guard", "self"))
-        if not checks:
-            missing.append(f"{rel}:{fname}() never judges a chunk "
-                           "(guard.check / guard.check_host)")
+        calls = [_call_name(c) for c in _calls(fn)]
+        builds = any(n == "ChunkedFitLoop" for n, _ in calls)
+        runs = any(n in ("run", "run_one") for n, _ in calls)
+        if not builds:
+            missing.append(f"{rel}:{fname}() never instantiates "
+                           "ChunkedFitLoop — chunked fits must run on the "
+                           "driver, not a hand-rolled loop")
+        if not runs:
+            missing.append(f"{rel}:{fname}() never calls the driver's "
+                           "run()/run_one()")
     assert not missing, (
-        "chunked fit loops without a wired health guard:\n  "
-        + "\n  ".join(missing))
+        "chunked fit loops not driven by runtime.fitloop.ChunkedFitLoop:"
+        "\n  " + "\n  ".join(missing))
 
 
-def test_snapshot_writes_are_gated_on_the_guard():
-    """No estimator file may write a snapshot around the guard: every
-    ``save_async`` call must be the guard's own gate, and blocking
-    ``checkpoint.save`` must not appear at all."""
+def test_no_hand_rolled_resilience_protocol_in_estimator_code():
+    """The five copy-pasted rollback blocks this lint replaced must never
+    grow back: any protocol-primitive call in estimator code fails."""
     offenders = []
+    seen_allowed = set()
     for d in ESTIMATOR_DIRS:
         full_dir = os.path.join(REPO, d)
         for fn in sorted(os.listdir(full_dir)):
             if not fn.endswith(".py"):
                 continue
-            path = os.path.join(full_dir, fn)
-            tree = ast.parse(open(path, encoding="utf-8").read())
+            rel = f"{d}/{fn}"
+            tree = ast.parse(
+                open(os.path.join(full_dir, fn), encoding="utf-8").read())
             for call in _calls(tree):
-                if _attr_call(call, "save_async") and \
-                        _receiver_name(call) != "guard":
-                    offenders.append(
-                        f"{d}/{fn}:{call.lineno}: ungated "
-                        f"{_receiver_name(call)}.save_async(...)")
-                if _attr_call(call, "save") and \
-                        _receiver_name(call) in ("checkpoint", "ck"):
-                    offenders.append(
-                        f"{d}/{fn}:{call.lineno}: ungated checkpoint.save")
+                name, recv = _call_name(call)
+                if name not in FORBIDDEN_CALLS:
+                    continue
+                recv_limit = FORBIDDEN_CALLS[name]
+                if recv_limit is not None and recv not in recv_limit:
+                    continue
+                if (rel, name) in ALLOWLIST:
+                    seen_allowed.add((rel, name))
+                    continue
+                offenders.append(
+                    f"{rel}:{call.lineno}: {recv or ''}"
+                    f"{'.' if recv else ''}{name}(...) — the fit-loop "
+                    "driver owns this protocol step")
     assert not offenders, (
-        "snapshot writes that bypass the health gate (route them through "
-        "guard.save_async so a bad chunk can never rotate out the last "
-        "good generation):\n  " + "\n  ".join(offenders))
+        "hand-rolled resilience protocol in estimator code (route it "
+        "through ChunkedFitLoop):\n  " + "\n  ".join(offenders))
+    dead = set(ALLOWLIST) - seen_allowed
+    assert not dead, (
+        f"allowlist entries no longer match any call: {sorted(dead)} — "
+        "remove them so they can't bless future hand-rolled loops")
 
 
 def test_registry_entries_still_exist():

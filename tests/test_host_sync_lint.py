@@ -44,17 +44,16 @@ ESTIMATOR_DIRS = (
 # (file, enclosing function) pairs allowed to host-sync inside a loop,
 # each with the reason it is a boundary and not a per-iteration sync.
 ALLOWLIST = {
-    # chunked fit loops: one sync per k-iteration device chunk, at the
-    # snapshot/convergence boundary (float of the chunk's scalars)
-    ("dislib_tpu/cluster/kmeans.py", "fit"),
-    ("dislib_tpu/cluster/gm.py", "fit"),
-    ("dislib_tpu/recommendation/als.py", "fit"),
-    # (dbscan/daura's checkpointed rounds sync ONLY through runtime.fetch
-    # now, so they need no entry — the lint's desired end state)
+    # (round-12: the chunked fit loops moved onto runtime.fitloop's
+    # ChunkedFitLoop — their boundary syncs are the driver's now, and the
+    # kmeans/gm/als fit() entries are gone: the lint's desired end state.
+    # The estimator `step` closures sync only their chunk's convergence
+    # scalars, OUTSIDE any estimator-file loop, except the cascade below.)
     # cascade SVM: the irregular tier — level merges are host-planned by
-    # design (SURVEY §3.3), one sync per cascade level, never per solver
-    # iteration (those run in lax.while_loop on device)
-    ("dislib_tpu/classification/csvm.py", "fit"),
+    # design (SURVEY §3.3), one sync per cascade level inside step()'s
+    # level loop, never per solver iteration (those run in
+    # lax.while_loop on device)
+    ("dislib_tpu/classification/csvm.py", "step"),
     ("dislib_tpu/classification/csvm.py", "_merge_level"),
     ("dislib_tpu/classification/csvm.py", "k_of"),
     ("dislib_tpu/classification/csvm.py", "_solve_level_batched"),

@@ -1,0 +1,287 @@
+"""MiniBatchKMeans — the ChunkedFitLoop recipe's acceptance estimator
+(round-12): a streaming ``partial_fit`` with ZERO bespoke resilience code
+(the driver lint enforces that structurally) that still passes the same
+rollback / watchdog / preemption / quarantine fault grid as the seven
+ported estimators.  One fused dispatch per batch, counter-asserted.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.cluster import KMeans, MiniBatchKMeans
+from dislib_tpu.data.io import QuarantineLedger, QuarantineReport
+from dislib_tpu.runtime import (HealthPolicy, NumericalDivergence,
+                                Preempted, WatchdogTimeout,
+                                clear_preemption, request_preemption)
+from dislib_tpu.utils import FitCheckpoint, faults
+from dislib_tpu.utils import profiling as prof
+
+
+def _blobs(rng, n=192, d=4, k=3):
+    centers = rng.rand(k, d) * 10
+    x = np.vstack([centers[i] + 0.3 * rng.randn(n // k, d) for i in range(k)])
+    return x.astype(np.float32), centers
+
+
+def _stream(x_np, bs=64):
+    return [ds.array(x_np[s: s + bs]) for s in range(0, len(x_np), bs)]
+
+
+def _mbk(**kw):
+    kw.setdefault("n_clusters", 3)
+    kw.setdefault("random_state", 0)
+    return MiniBatchKMeans(**kw)
+
+
+@pytest.fixture
+def fast_retry(monkeypatch):
+    monkeypatch.setenv("DSLIB_RETRY_BACKOFF", "0")
+
+
+class TestStreaming:
+    def test_partial_fit_stream_clusters_the_blobs(self, rng):
+        x_np, _ = _blobs(rng)
+        est = _mbk()
+        for b in _stream(x_np):
+            est.partial_fit(b)
+        assert est.n_batches_ == 3
+        assert np.isfinite(est.centers_).all()
+        assert est.counts_.sum() == pytest.approx(len(x_np))
+        x = ds.array(x_np)
+        # the streamed model is a usable clustering: within 2x of a
+        # full-batch Lloyd's inertia on the same data
+        full = KMeans(n_clusters=3, random_state=0, max_iter=10).fit(x)
+        assert -est.score(x) < 2.0 * -full.score(x)
+        labels = np.asarray(est.predict(x).collect()).ravel()
+        assert len(np.unique(labels)) == 3
+
+    def test_fit_resumes_a_checkpointed_stream_without_reconsuming(
+            self, rng, tmp_path):
+        """A preempted `fit(x, checkpoint=...)` re-run must resume at the
+        snapshot's batch position — re-streaming from 0 would apply the
+        already-snapshotted batches twice and diverge from the unfaulted
+        model (review-found, pinned)."""
+        x_np, _ = _blobs(rng)
+        x = ds.array(x_np)
+        ref = _mbk(batch_size=64).fit(x)
+        path = str(tmp_path / "r.npz")
+        # simulate the preempted first run: 2 of 3 batches snapshotted
+        part = _mbk(batch_size=64)
+        for b in _stream(x_np)[:2]:
+            part.partial_fit(b, checkpoint=FitCheckpoint(path, every=1))
+        res = _mbk(batch_size=64).fit(x, checkpoint=FitCheckpoint(path,
+                                                                  every=1))
+        assert res.n_batches_ == 3
+        assert res.counts_.sum() == pytest.approx(len(x_np)), \
+            "resumed fit re-consumed snapshotted batches"
+        np.testing.assert_array_equal(res.centers_, ref.centers_)
+        # a re-run over a COMPLETED snapshot adopts it, zero re-dispatch
+        again = _mbk(batch_size=64).fit(x, checkpoint=FitCheckpoint(path,
+                                                                    every=1))
+        assert again.n_batches_ == 3
+        np.testing.assert_array_equal(again.centers_, ref.centers_)
+
+    def test_fit_streams_row_slices_and_restarts_state(self, rng):
+        x_np, _ = _blobs(rng)
+        est = _mbk(batch_size=64, epochs=2).fit(ds.array(x_np))
+        assert est.n_batches_ == 6
+        assert est.counts_.sum() == pytest.approx(2 * len(x_np))
+        est.fit(ds.array(x_np))            # fresh fit restarts the stream
+        assert est.n_batches_ == 6
+
+    def test_ndarray_batches_are_accepted(self, rng):
+        x_np, _ = _blobs(rng)
+        est = _mbk().partial_fit(x_np[:64])
+        assert est.n_batches_ == 1
+
+    def test_one_dispatch_per_batch(self, rng):
+        x_np, _ = _blobs(rng)
+        batches = _stream(x_np)
+        _mbk().partial_fit(batches[0])     # warm the compile cache
+        prof.reset_counters()
+        est = _mbk()
+        for b in batches:
+            est.partial_fit(b)
+        assert prof.counters()["dispatch_by"].get("mbkmeans_step") == 3
+
+
+class TestFaultGrid:
+    """The same grid the ported estimators pass — with zero resilience
+    code in the estimator, every behavior below is the DRIVER's."""
+
+    def _healed_stream(self, rng, tmp_path, pol, tag):
+        x_np, _ = _blobs(rng)
+        batches = _stream(x_np)
+        ref = _mbk()
+        for b in batches:
+            ref.partial_fit(b)
+        est = _mbk()
+        ck = FitCheckpoint(str(tmp_path / f"{tag}.npz"), every=1)
+        for b in batches:
+            est.partial_fit(b, checkpoint=ck, health=pol)
+        return ref, est
+
+    def test_nan_poisoned_batch_rolls_back_and_heals(self, rng, tmp_path):
+        pol = faults.NaNAtChunk(at_chunk=2)
+        ref, est = self._healed_stream(rng, tmp_path, pol, "nan")
+        assert pol.fired == 1, "fault was never injected"
+        assert est.fit_info_["rollbacks"] == 1
+        # rollback re-runs the SAME batch: the healed stream is bit-equal
+        np.testing.assert_array_equal(est.centers_, ref.centers_)
+        np.testing.assert_array_equal(est.counts_, ref.counts_)
+
+    def test_escalation_ladder_runs_for_streams(self, rng, tmp_path):
+        pol = faults.FaultAtTier(tiers=1, at_chunk=2)
+        ref, est = self._healed_stream(rng, tmp_path, pol, "tier")
+        assert pol.healed and pol.fired == 2
+        assert est.fit_info_["escalations"]["remediate"] == 1
+        np.testing.assert_array_equal(est.centers_, ref.centers_)
+
+    def test_hung_batch_trips_watchdog_then_heals(self, rng, tmp_path,
+                                                  fast_retry):
+        pol = faults.HangAtChunk(at_chunk=2, hang_s=0.4, deadline_s=0.05,
+                                 times=1)
+        ref, est = self._healed_stream(rng, tmp_path, pol, "hang")
+        assert pol.stalls == 1
+        np.testing.assert_array_equal(est.centers_, ref.centers_)
+
+    def test_hang_exhaustion_is_typed(self, rng, tmp_path, fast_retry,
+                                      monkeypatch):
+        monkeypatch.setenv("DSLIB_RETRY_ATTEMPTS", "2")
+        x_np, _ = _blobs(rng)
+        est = _mbk()
+        with pytest.raises(WatchdogTimeout):
+            est.partial_fit(
+                _stream(x_np)[0],
+                checkpoint=FitCheckpoint(str(tmp_path / "h.npz"), every=1),
+                health=faults.HangAtChunk(at_chunk=1, hang_s=0.4,
+                                          deadline_s=0.05, times=10))
+
+    def test_no_checkpoint_nan_raises_typed(self, rng):
+        x_np, _ = _blobs(rng)
+        with pytest.raises(NumericalDivergence) as exc:
+            _mbk().partial_fit(_stream(x_np)[0],
+                               health=faults.NaNAtChunk(at_chunk=1))
+        assert exc.value.estimator == "minibatch_kmeans"
+
+    def test_preemption_lands_between_batches_and_stream_resumes(
+            self, rng, tmp_path):
+        x_np, _ = _blobs(rng)
+        batches = _stream(x_np)
+        ref = _mbk()
+        for b in batches:
+            ref.partial_fit(b)
+
+        path = str(tmp_path / "p.npz")
+        est = _mbk()
+        try:
+            est.partial_fit(batches[0],
+                            checkpoint=FitCheckpoint(path, every=1))
+            request_preemption()           # eviction notice mid-stream
+            with pytest.raises(Preempted):
+                # the batch COMMITS and SNAPSHOTS first, then the clean
+                # raise lands at the chunk boundary — never mid-dispatch
+                est.partial_fit(batches[1],
+                                checkpoint=FitCheckpoint(path, every=1))
+            clear_preemption()             # the replacement job's clean env
+            # the snapshot on disk is the resume point: a FRESH estimator
+            # (new process in production) reads the stream position from
+            # it and continues exactly (the raise-after-snapshot contract)
+            start = int(FitCheckpoint(path, every=1).load()["n_batches"])
+            assert start == 2, "the preempted batch must have snapshot"
+            res = _mbk()
+            for b in batches[start:]:
+                res.partial_fit(b, checkpoint=FitCheckpoint(path, every=1))
+        finally:
+            clear_preemption()
+        assert res.n_batches_ == ref.n_batches_
+        np.testing.assert_array_equal(res.centers_, ref.centers_)
+        np.testing.assert_array_equal(res.counts_, ref.counts_)
+
+    def test_armed_monotone_guard_does_not_false_trip_across_batches(
+            self, rng, tmp_path):
+        """Consecutive chunks of a STREAM see different data, so
+        batch-to-batch inertia is not a monotone trajectory — the batch
+        kernel keeps the loss history OUT of its health vector, and an
+        armed `monotone_rtol` must not burn the fault budget on healthy
+        batch-to-batch variation (review-found false-trip, pinned)."""
+        x_np, _ = _blobs(rng)
+        # batches sorted by distance from the mean: inertia RISES across
+        # batches by construction
+        order = np.argsort(np.linalg.norm(x_np - x_np.mean(0), axis=1))
+        est = _mbk()
+        ck = FitCheckpoint(str(tmp_path / "m.npz"), every=1)
+        for b in _stream(x_np[order]):
+            est.partial_fit(b, checkpoint=ck,
+                            health=HealthPolicy(monotone_rtol=0.05))
+        assert est.fit_info_["rollbacks"] == 0, \
+            "healthy stream burned the fault budget on rising inertia"
+        assert est.n_batches_ == 3
+
+    def test_ledger_caps_retained_reports_but_keeps_exact_totals(self):
+        led = QuarantineLedger(max_reports=2)
+        for i in range(5):
+            led.append(QuarantineReport(f"s{i}", [0], np.zeros((1, 2)), 9))
+        assert len(led.reports) == 2, "retained reports must be capped"
+        assert [r.source for r in led.reports] == ["s3", "s4"]
+        assert led.n_quarantined == 5 and led.n_loaded == 45, \
+            "count totals must stay exact past the cap"
+        led.reset()
+        assert led.n_quarantined == 0 and not led.reports
+
+    def test_nonfinite_batch_is_typed_not_silent(self, rng, tmp_path):
+        x_np, _ = _blobs(rng)
+        bad = x_np[:64].copy()
+        bad[5, 1] = np.nan
+        est = _mbk()
+        with pytest.raises(NumericalDivergence) as exc:
+            est.partial_fit(bad,
+                            checkpoint=FitCheckpoint(str(tmp_path / "b.npz"),
+                                                     every=1))
+        assert exc.value.guard == "input-nonfinite"
+
+    def test_quarantined_ingest_accumulates_across_the_stream(self, rng,
+                                                              tmp_path):
+        """The streaming steady state the round-12 QuarantineLedger fix
+        exists for: repeated load→partial_fit batches ACCUMULATE their
+        quarantine reports instead of overwriting them."""
+        ds.quarantine_ledger().reset()
+        est = _mbk()
+        kept = []
+        for i in range(3):
+            xb, _ = _blobs(rng, n=48)
+            xb[4 + i, 0] = np.nan          # one poisoned row per batch
+            p = str(tmp_path / f"b{i}.csv")
+            np.savetxt(p, xb, delimiter=",")
+            with pytest.warns(RuntimeWarning, match="quarantined 1"):
+                got = ds.load_txt_file(p)
+            kept.append(got.shape[0])
+            est.partial_fit(got)
+        ledger = ds.quarantine_ledger()
+        assert len(ledger.reports) == 3, \
+            "ledger must accumulate across repeated ingest calls"
+        assert ledger.n_quarantined == 3 and ledger.n_loaded == sum(kept)
+        assert [m.shape for m in ledger.keep_masks] == [(48,)] * 3
+        assert ledger.keep_mask_all().shape == (144,)
+        assert ledger.keep_mask_all().sum() == sum(kept)
+        # last_quarantine_report keeps its most-recent-load contract
+        assert ds.last_quarantine_report() is ledger.reports[-1]
+        assert np.isfinite(est.centers_).all()
+        ledger.reset()
+        assert ledger.n_quarantined == 0 and not ledger.reports
+
+
+class TestZeroBespokeResilience:
+    def test_partial_fit_source_has_no_protocol_calls(self):
+        """Belt over the lint's braces: the estimator's own methods never
+        touch guard/checkpoint primitives — the driver is the only
+        resilience surface."""
+        import inspect
+        src = inspect.getsource(MiniBatchKMeans)
+        for needle in ("save_async", "remediate", ".admit(", ".check(",
+                       "check_host", "raise_if_preempted",
+                       "preemption_requested", "checkpoint.load"):
+            assert needle not in src, f"bespoke resilience code: {needle}"

@@ -5,12 +5,37 @@
 # typed diagnostic, then a clean resume equals the unfaulted model).
 #
 # Usage:  tools/chaos_soak.sh [RUNS] [SEED]
+#         tools/chaos_soak.sh --matrix [SEED] [OUT_JSONL]
 #
-# Runs the `slow`-marked tests/test_chaos_soak.py (excluded from tier-1)
-# and echoes the machine-readable summary line; append it to the current
-# BENCH_local_*.jsonl when recording a capture.
+# Default mode runs the `slow`-marked tests/test_chaos_soak.py (excluded
+# from tier-1) and echoes the machine-readable summary line; append it to
+# the current BENCH_local_*.jsonl when recording a capture.
+#
+# --matrix (round-12) runs the seeded chaos MATRIX instead — every
+# chunked estimator × every fault injector incl. the tier-targeted
+# FaultAtTier (tests/test_chaos_matrix.py) — and APPENDS its
+# machine-readable summary (per-cell verdicts + resilience counters) to
+# OUT_JSONL (default BENCH_local_matrix.jsonl) as one JSON line.
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
+if [ "$1" = "--matrix" ]; then
+    SEED="${2:-0}"
+    OUT="${3:-BENCH_local_matrix.jsonl}"
+    LOG="$(mktemp)"
+    env JAX_PLATFORMS=cpu DSLIB_MATRIX_SEED="$SEED" \
+        python -m pytest tests/test_chaos_matrix.py::test_chaos_matrix_full \
+        -q -m slow -s -p no:cacheprovider 2>&1 | tee "$LOG"
+    rc=${PIPESTATUS[0]}
+    echo "-- matrix summary --"
+    grep -a "^CHAOS_MATRIX_SUMMARY" "$LOG" | sed 's/^CHAOS_MATRIX_SUMMARY //'
+    if [ "$rc" -eq 0 ]; then
+        grep -a "^CHAOS_MATRIX_SUMMARY" "$LOG" \
+            | sed 's/^CHAOS_MATRIX_SUMMARY //' >> "$OUT"
+        echo "appended to $OUT"
+    fi
+    rm -f "$LOG"
+    exit $rc
+fi
 RUNS="${1:-10}"
 SEED="${2:-0}"
 LOG="$(mktemp)"

@@ -37,7 +37,7 @@ SECTIONS = [
       "precise"]),
     ("Decomposition", "dislib_tpu", ["PCA"]),
     ("Clustering", "dislib_tpu.cluster",
-     ["KMeans", "GaussianMixture", "DBSCAN", "Daura"]),
+     ["KMeans", "MiniBatchKMeans", "GaussianMixture", "DBSCAN", "Daura"]),
     ("Classification", "dislib_tpu.classification",
      ["CascadeSVM", "KNeighborsClassifier"]),
     ("Trees", "dislib_tpu.trees",
@@ -62,20 +62,27 @@ SECTIONS = [
     ("Health runtime (self-healing fits)", "dislib_tpu.runtime.health",
      ["HealthPolicy", "ChunkGuard", "Verdict", "Remediation",
       "NumericalDivergence", "WatchdogTimeout", "guard", "health_vec"]),
+    ("Chunked fit-loop driver (resilient-by-construction estimators)",
+     "dislib_tpu.runtime",
+     ["ChunkedFitLoop", "LoopState", "ChunkOutcome", "EscalationLadder",
+      "Escalation"]),
     ("Checkpoint adoption (hot-swap read gate)", "dislib_tpu.runtime",
      ["Adoption", "AdoptionRejected", "adopt_latest", "generation_token"]),
     ("Serving", "dislib_tpu.serving",
      ["ServePipeline", "PredictServer", "ServeResponse", "ModelPool",
       "ProgramCache", "bucket_ladder", "bucket_for", "split_rows"]),
     ("Ingest quarantine", "dislib_tpu",
-     ["QuarantineReport", "last_quarantine_report"]),
+     ["QuarantineReport", "QuarantineLedger", "last_quarantine_report",
+      "quarantine_ledger"]),
     ("Fault injection", "dislib_tpu.utils.faults",
      ["CallbackCheckpoint", "SigtermAtNthSave", "corrupt_snapshot",
       "FlakyCall", "FlakyOpen",
-      "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk"]),
+      "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk",
+      "FaultAtTier"]),
     ("Profiling", "dislib_tpu.utils.profiling",
      ["trace", "annotate", "op_graph", "profiled_jit", "dispatch_count",
-      "trace_count", "transfer_count", "counters", "reset_counters"]),
+      "trace_count", "transfer_count", "counters", "reset_counters",
+      "count_resilience", "resilience_counters"]),
     ("Distributed (multi-host)", "dislib_tpu.parallel.distributed",
      ["initialize", "is_initialized", "process_info", "shutdown"]),
 ]
