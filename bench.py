@@ -686,6 +686,27 @@ def bench_polar(m, n, tag, max_iter=30, peak_floor=0.1):
     return res
 
 
+def _mesh_2d_shapes(what):
+    """Near-square 2-D factorisation of the device count — (src, dst)
+    mesh shapes for the tiers that need a genuine 2-D mesh (summa,
+    rechunk, overlap).  Rejects < 4 devices and prime counts (whose only
+    factorisation is 1-D) loudly; ONE copy of the sqrt-descend loop so a
+    policy fix propagates to every tier."""
+    import jax
+    devs = len(jax.devices())
+    if devs < 4:
+        raise RuntimeError(
+            f"{what} bench needs >= 4 devices for a 2-D mesh, have {devs}")
+    r = int(np.sqrt(devs))
+    while devs % r:
+        r -= 1
+    if r == 1:
+        raise RuntimeError(
+            f"{what} bench needs a composite device count for a 2-D mesh, "
+            f"have {devs} (prime)")
+    return (devs // r, r), (r, devs // r)
+
+
 def bench_summa(dim, tag, peak_floor=0.05):
     """SUMMA matmul on a genuinely 2-D mesh — the explicit panel-broadcast
     schedule (`ops/summa`) vs the XLA-partitioned dot on the SAME mesh.
@@ -696,15 +717,8 @@ def bench_summa(dim, tag, peak_floor=0.05):
     import jax
     import dislib_tpu as ds
 
-    devs = len(jax.devices())
-    if devs < 4:
-        raise RuntimeError(
-            f"summa bench needs >= 4 devices for a 2-D mesh, have {devs}")
-    # near-square 2-D factorisation of the device count
-    r = int(np.sqrt(devs))
-    while devs % r:
-        r -= 1
-    ds.init((devs // r, r))
+    src, _ = _mesh_2d_shapes("summa")
+    ds.init(src)
     from dislib_tpu.utils import profiling as _prof
 
     rng = np.random.RandomState(0)
@@ -726,10 +740,20 @@ def bench_summa(dim, tag, peak_floor=0.05):
         out = ds.matmul(a, a, algorithm=algo)
         _sync(out)
 
+    # steady-state A/B (round-13 satellite): BOTH schedules are warmed
+    # before EITHER timed region, and the regions are trace-asserted
+    # compile-free — a first-call recompile inside _median_time would
+    # poison the vs_xla ratio with one-off compile wall (the peak
+    # probe's file-cached-setup precedent).  The hoist makes the
+    # guarantee structural; the assert makes a regression loud.
     run("summa")
-    t = _median_time(lambda: run("summa"))
     run("xla")
+    traces_before = _prof.trace_count()
+    t = _median_time(lambda: run("summa"))
     t_xla = _median_time(lambda: run("xla"))
+    assert _prof.trace_count() == traces_before, \
+        "summa/xla timed region recompiled — the A/B ratio is not " \
+        "steady-state"
     gflops = 2.0 * dim ** 3 / t / 1e9
     res = {"metric": f"summa_{tag}_gflops_per_chip (baseline: XLA-"
                      "partitioned dot, same 2-D mesh)",
@@ -769,14 +793,7 @@ def bench_rechunk(m, n, tag, panels=4, min_gbps=0.02, peak_ratio_max=1.5):
     from dislib_tpu.parallel import mesh as _mesh
     from dislib_tpu.utils import profiling as _prof
 
-    devs = len(jax.devices())
-    if devs < 4:
-        raise RuntimeError(
-            f"rechunk bench needs >= 4 devices for a 2-D mesh, have {devs}")
-    r = int(np.sqrt(devs))
-    while devs % r:
-        r -= 1
-    src, dst = (devs // r, r), (r, devs // r)
+    src, dst = _mesh_2d_shapes("rechunk")
     rng = np.random.RandomState(0)
     x_host = rng.rand(m, n).astype(np.float32)
     ds.init(src)
@@ -859,6 +876,252 @@ def bench_rechunk(m, n, tag, panels=4, min_gbps=0.02, peak_ratio_max=1.5):
     if gbps < floor:
         msg = (f"RECHUNK THROUGHPUT GATE FAILED: {gbps:.3f} GB/s below "
                f"the {floor:.3f} GB/s floor")
+        print(msg, file=sys.stderr, flush=True)
+        raise AssertionError(msg)
+    return res
+
+
+def bench_overlap(kind, m, n, tag, hidden_floor=0.0, panels=4, repeats=9):
+    """Comm–compute overlap tier (round-13 PR): how much of the panel
+    collective does the double-buffered schedule actually hide under
+    compute, per schedule family (``kind`` = summa | rechunk | ring).
+
+    ``comm_hidden_frac`` = (t_seq − t_db) / t_comm_alone, where
+    t_comm_alone comes from a BROADCAST-ONLY variant of the same program
+    (identical collectives, the compute replaced by a (1, 1) touch per
+    panel — ``comm_only=True`` on the kernel), so the fraction is
+    normalized by the comm the pipeline could possibly hide: 1.0 = the
+    whole collective disappeared under compute, 0 = no overlap, < 0 =
+    the pipelined program is slower (a scheduling regression).
+
+    Gates, all failing the config loudly:
+    - db and seq results BIT-EQUAL (same panel order, identical ops);
+    - ONE dispatch under the db schedule (dispatch counters), and the
+      router observably ran it (schedule counters);
+    - ``comm_hidden_frac`` >= ``hidden_floor``
+      (``DSLIB_OVERLAP_HIDDEN_MIN`` overrides — the vs_peak noisy-rig
+      escape.  On host-core rigs the collectives are memcpys through
+      shared caches, so the honest floor is "no pathological slowdown";
+      real ICI is where the hidden fraction is the roofline claim);
+    - double-buffer memory bound via ``compiled.memory_analysis()``:
+      the db program's peak-live stays within the documented
+      one-extra-panel budget — rechunk (out + temp)/in <= min(1 + 2/k,
+      the tier's 1.5x ceiling) (``DSLIB_OVERLAP_PEAK_RATIO_MAX``
+      overrides); summa/ring: temp(db) − temp(seq) <= one in-flight
+      panel set (+1/2 panel slack for scheduler variance) — the double
+      buffer must cost ONE panel of live memory, never an operand copy.
+    Rows carry ``fresh: true`` — the stale-fallback machinery flips it
+    (and stamps ``stale_origin``) on any replay."""
+    import jax
+    import dislib_tpu as ds
+    from dislib_tpu.utils import profiling as _prof
+
+    src, dst = _mesh_2d_shapes("overlap")
+    rng = np.random.RandomState(0)
+    x_host = rng.rand(m, n).astype(np.float32)
+
+    extra = {}
+    if kind == "summa":
+        from dislib_tpu.ops import precision as px
+        from dislib_tpu.ops.summa import summa_matmul
+        ds.init(src)
+        mesh = ds.get_mesh()
+        a = ds.array(x_host).force()
+        b = ds.array(rng.rand(n, m).astype(np.float32)).force()
+        ad, bd = a._data, b._data
+        policy = px.FLOAT32
+
+        def run(sched, comm_only=False):
+            _sync(summa_matmul(ad, bd, mesh, policy, overlap=sched,
+                               comm_only=comm_only))
+
+        def lower(sched):
+            return summa_matmul.lower(ad, bd, mesh, policy, overlap=sched)
+
+        out_db = np.asarray(summa_matmul(ad, bd, mesh, policy,
+                                         overlap="db"))
+        out_seq = np.asarray(summa_matmul(ad, bd, mesh, policy,
+                                          overlap="seq"))
+        # the kernel's own step-count formula — keeps the one-extra-panel
+        # memory gate anchored to ops/summa's schedule.  PER-DEVICE
+        # bytes (memory_analysis accounts one device): the broadcast A
+        # panel lives (M/rows, kb) on each device, the B panel (kb,
+        # N/cols) (review-found: global bytes made the bound ~mesh-
+        # factor too loose)
+        from dislib_tpu.ops.summa import summa_steps
+        steps = summa_steps(mesh)
+        panel_set = (ad.size // src[0]
+                     + bd.size // src[1]) * ad.dtype.itemsize // steps
+        counter_key, expect = "summa_matmul", 1
+        # the routed entry (math.matmul) must counter-visibly run the
+        # schedule the env selects
+        ds.matmul(a, b, algorithm="summa").force()
+        sched_counts = _prof.schedule_counters()
+        assert any(k.startswith("summa_matmul:") for k in sched_counts), \
+            f"summa route left no schedule counter: {sched_counts}"
+    elif kind == "rechunk":
+        from dislib_tpu.ops import rechunk as _rc
+        from dislib_tpu.parallel import mesh as _mesh_mod
+        ds.init(src)
+        a = ds.array(x_host).force()
+        ds.init(dst)
+        dst_mesh = _mesh_mod.get_mesh()
+
+        def run(sched, comm_only=False):
+            if comm_only:
+                _sync(_rc.panel_comm_probe(a._data, a.shape, dst_mesh,
+                                           panels, overlap=sched))
+            else:
+                _sync(_rc.panel_rechunk(a._data, a.shape, dst_mesh, panels,
+                                        overlap=sched))
+
+        out_db = np.asarray(_rc.panel_rechunk(a._data, a.shape, dst_mesh,
+                                              panels, overlap="db"))
+        out_seq = np.asarray(_rc.panel_rechunk(a._data, a.shape, dst_mesh,
+                                               panels, overlap="seq"))
+        ma_db = _rc.panel_memory_analysis(a._data, a.shape, dst_mesh,
+                                          panels, overlap="db")
+        ratio = ma_db["peak_live_ratio"] if ma_db["peak_live_ratio"] \
+            is not None else ma_db["analytic_ratio"]
+        ratio_max = float(os.environ.get(
+            "DSLIB_OVERLAP_PEAK_RATIO_MAX", min(1.0 + 2.0 / panels, 1.5)))
+        if ratio > ratio_max:
+            msg = (f"OVERLAP MEMORY GATE FAILED: double-buffered rechunk "
+                   f"peak-live {ratio:.3f}x exceeds the {ratio_max:.3f}x "
+                   "bound (1 + 2/k against the tier's 1.5x ceiling) — the "
+                   "extra in-flight panel must cost one panel, not a copy")
+            print(msg, file=sys.stderr, flush=True)
+            raise AssertionError(msg)
+        extra.update({"peak_live_ratio_db": ratio,
+                      "peak_live_ratio_max": ratio_max,
+                      "panels": ma_db["panels"]})
+        steps = ma_db["panels"]
+        panel_set = ma_db["analytic_temp_bytes"]
+        counter_key, expect = "rechunk_panels", 1
+        lower = None
+    elif kind == "ring":
+        from dislib_tpu.ops.ring import ring_kneighbors
+        from dislib_tpu.parallel import mesh as _mesh_mod
+        ds.init(src)
+        mesh = _mesh_mod.get_mesh()
+        k_nn = 5
+        # asymmetric shapes: FEW query rows against the full fitted set,
+        # so the rotated shard (the hideable comm) is a meaningful share
+        # of each step — the fold at square shapes dwarfs the rotation
+        # and the hidden fraction would measure pure scheduler noise
+        mq = max(64, m // 16)
+        q = ds.array(x_host[:mq]).force()
+        f = ds.array(x_host).force()
+        qd, fd = q._data, f._data
+
+        def run(sched, comm_only=False):
+            out = ring_kneighbors(qd, fd, mesh, k_nn, m, overlap=sched,
+                                  comm_only=comm_only)
+            _sync(*(out if isinstance(out, tuple) else (out,)))
+
+        def lower(sched):
+            return ring_kneighbors.lower(qd, fd, mesh, k_nn, m,
+                                         overlap=sched)
+
+        d_db, i_db = ring_kneighbors(qd, fd, mesh, k_nn, m, overlap="db")
+        d_seq, i_seq = ring_kneighbors(qd, fd, mesh, k_nn, m, overlap="seq")
+        out_db = np.concatenate([np.asarray(d_db),
+                                 np.asarray(i_db, np.float32)], axis=1)
+        out_seq = np.concatenate([np.asarray(d_seq),
+                                  np.asarray(i_seq, np.float32)], axis=1)
+        steps = src[0]
+        # rotated set per hop, PER-DEVICE (memory_analysis accounts one
+        # device): the (rows_loc, n/cols) fitted block + its norms + ids
+        # (review-found: the global feature dim made the bound too loose)
+        rows_loc = fd.shape[0] // src[0]
+        panel_set = rows_loc * (fd.shape[1] // src[1] + 2) \
+            * fd.dtype.itemsize
+        # counter-assert the PUBLIC path: one profiled ring dispatch per
+        # kneighbors call (the estimator boundary)
+        nn = ds.NearestNeighbors(n_neighbors=k_nn, ring=True).fit(f)
+        nn.kneighbors(q)                    # warm
+        _prof.reset_counters()
+        nn.kneighbors(q)
+        got = _prof.counters()["dispatch_by"].get("ring_kneighbors")
+        assert got == 1, \
+            f"ring kneighbors path cost {got} ring dispatches, expected 1"
+    else:
+        raise ValueError(f"unknown overlap bench kind {kind!r}")
+
+    # bit-equality gate: the two schedules consume panels in identical
+    # order with identical ops
+    np.testing.assert_array_equal(out_db, out_seq)
+
+    # dispatch gate under the db schedule (the ring KERNEL is counted at
+    # its estimator boundary — asserted in the ring branch above)
+    run("db")                               # warm
+    if kind != "ring":
+        _prof.reset_counters()
+        run("db")
+        d = _prof.counters()["dispatch_by"].get(counter_key, 0)
+        assert d == expect, \
+            f"{kind} db schedule cost {d} dispatches, expected {expect}"
+
+    # summa/ring memory bound: the db program's temp may exceed seq's by
+    # at most one in-flight panel set (+50% scheduler slack) — XLA's own
+    # accounting of "the double buffer costs one panel, not a copy"
+    if kind in ("summa", "ring") and lower is not None:
+        try:
+            t_db = int(lower("db").compile().memory_analysis()
+                       .temp_size_in_bytes)
+            t_seq = int(lower("seq").compile().memory_analysis()
+                        .temp_size_in_bytes)
+        except Exception:   # noqa: BLE001 — backend without the analysis
+            t_db = t_seq = None
+        if t_db is not None:
+            slack = max(panel_set // 2, 65536)
+            assert t_db <= t_seq + panel_set + slack, (
+                f"OVERLAP MEMORY GATE FAILED: {kind} db temp {t_db} vs seq "
+                f"{t_seq} — the double buffer costs more than one "
+                f"in-flight panel set ({panel_set} B)")
+            extra.update({"temp_bytes_db": t_db, "temp_bytes_seq": t_seq,
+                          "panel_set_bytes": panel_set})
+
+    # timing: both schedules + the broadcast-only probe, all steady-state.
+    # INTERLEAVED rounds + BEST-of wall (the _peak_gflops precedent):
+    # the hidden fraction is a DIFFERENCE of two walls divided by a
+    # small third — on a cpu-shares-throttled container, (a) measuring
+    # the schedules in separate blocks lets throttle drift bias the
+    # difference, so each round times db, seq and the probe back to
+    # back, and (b) median contention noise swamps the delta, while the
+    # min wall estimates each schedule's uncontended cost
+    run("seq")
+    run("seq", comm_only=True)
+    walls = {"db": [], "seq": [], "comm": []}
+    for _ in range(repeats):
+        for key, fn in (("db", lambda: run("db")),
+                        ("seq", lambda: run("seq")),
+                        ("comm", lambda: run("seq", comm_only=True))):
+            t0 = time.perf_counter()
+            fn()
+            walls[key].append(time.perf_counter() - t0)
+    t_db = float(min(walls["db"]))
+    t_seq = float(min(walls["seq"]))
+    t_comm = float(min(walls["comm"]))
+    hidden = (t_seq - t_db) / t_comm if t_comm > 0 else 0.0
+    floor = float(os.environ.get("DSLIB_OVERLAP_HIDDEN_MIN", hidden_floor))
+    res = {"metric": f"overlap_{kind}_{tag}_comm_hidden_frac (baseline: "
+                     "sequential-phase schedule, same program)",
+           "value": round(hidden, 3), "unit": "frac",
+           "vs_baseline": round(t_seq / t_db, 3) if t_db > 0 else None,
+           "db_wall_s": round(t_db, 5), "seq_wall_s": round(t_seq, 5),
+           "comm_alone_wall_s": round(t_comm, 5),
+           "comm_hidden_floor": floor, "steps": steps,
+           "dispatches_per_op": 1, "fresh": True,
+           "note": "comm_hidden = (t_seq - t_db) / t_comm_alone; "
+                   "t_comm_alone = broadcast-only variant of the same "
+                   "program; gates: db==seq bit-equal, 1 dispatch, "
+                   "peak-live within one extra in-flight panel",
+           **extra}
+    if hidden < floor:
+        msg = (f"OVERLAP GATE FAILED: {kind} comm-hidden fraction "
+               f"{hidden:.3f} below the {floor:.3f} floor — the "
+               "double-buffered schedule is not hiding comm on this rig")
         print(msg, file=sys.stderr, flush=True)
         raise AssertionError(msg)
     return res
@@ -1884,6 +2147,28 @@ def _configs():
             # round-11 rechunk tier: collective reshard, memory-bounded
             ("rechunk_smoke", lambda: bench_rechunk(2048, 256, "smoke",
                                                     min_gbps=0.02)),
+            # round-13 overlap tier: comm-hidden fraction per panel
+            # schedule, db==seq bit-equal + 1-dispatch + memory-bounded
+            # gated in-config.  Floors are rig-calibrated (the bf16
+            # roofline-normalization precedent): rechunk/ring measure
+            # +0.2-0.4 / +0.1-0.4 hidden on these host cores (thunk
+            # concurrency), while summa's double buffer is CACHE-BOUND
+            # here (two live panel pairs vs one: measured -0.3±0.1, no
+            # ICI to win back) — its smoke floor is the documented
+            # bounded-regression -1.0 and the full/chip config arms 0.0
+            ("overlap_smoke_summa",
+             lambda: bench_overlap("summa", 512, 512, "smoke",
+                                   hidden_floor=-1.0)),
+            ("overlap_smoke_rechunk",
+             lambda: bench_overlap("rechunk", 2048, 256, "smoke",
+                                   hidden_floor=0.02)),
+            # ring floor −0.05, not 0: measured 0.38–0.67 hidden here,
+            # but one run in ~5 TIES (−0.01) when the container is
+            # throttled mid-region — the floor tolerates the tie, the
+            # chip config arms 0.0
+            ("overlap_smoke_ring",
+             lambda: bench_overlap("ring", 8192, 128, "smoke",
+                                   hidden_floor=-0.05, repeats=15)),
             ("kmeans_smoke_fastdist",
              lambda: bench_kmeans(1000, 20, 4, 5, "smoke_fastdist")),
             # round-12 fit-loop driver: heal == unfaulted, +1 dispatch only
@@ -1942,6 +2227,19 @@ def _configs():
         # operand between 2-D layouts, peak-live proxy <= 1.5x gated
         ("rechunk_16384x2048_gb_per_sec",
          lambda: bench_rechunk(16384, 2048, "16384x2048", min_gbps=0.2)),
+        # round-13 overlap tier at paper scale: on real ICI the
+        # double-buffered schedule must hide a strictly positive
+        # fraction of the panel collective (floor 0.0, armed) —
+        # DSLIB_OVERLAP_HIDDEN_MIN is the noisy-rig escape
+        ("overlap_summa_4096_comm_hidden_frac",
+         lambda: bench_overlap("summa", 4096, 4096, "4096",
+                               hidden_floor=0.0)),
+        ("overlap_rechunk_16384x2048_comm_hidden_frac",
+         lambda: bench_overlap("rechunk", 16384, 2048, "16384x2048",
+                               hidden_floor=0.0)),
+        ("overlap_ring_65536x128_comm_hidden_frac",
+         lambda: bench_overlap("ring", 65536, 128, "65536x128",
+                               hidden_floor=0.0)),
         # round-7 fusion PR: one forced op chain vs per-op eager dispatch —
         # at 512² the per-dispatch RTT dominates both modes' compute, so
         # the ratio reads the dispatch savings directly
@@ -2017,7 +2315,8 @@ def _run_one(name):
     # the parent's skip-and-continue and two-timeouts-abort paths)
     if name in os.environ.get("DSLIB_BENCH_FAKE_HANG", "").split(","):
         time.sleep(10_000)
-    if name.startswith(("summa", "rechunk")) and os.environ.get("BENCH_SMOKE") \
+    if name.startswith(("summa", "rechunk", "overlap")) \
+            and os.environ.get("BENCH_SMOKE") \
             and (_smoke_wants_cpu()
                  or "cpu" in os.environ.get("JAX_PLATFORMS", "")):
         # the SUMMA/rechunk tiers need a 2-D mesh; smoke mode fakes one with

@@ -61,6 +61,7 @@ from dislib_tpu.data.io import (
 )
 from dislib_tpu.data.sparse import SparseArray
 from dislib_tpu.math import matmul, kron, svd, qr, polar
+from dislib_tpu.ops.overlap import resolve as overlap_schedule
 from dislib_tpu.decomposition import tsqr, random_svd, lanczos_svd, PCA
 from dislib_tpu.utils.base import shuffle, train_test_split
 from dislib_tpu.utils.saving import save_model, load_model
@@ -100,7 +101,7 @@ __all__ = [
     "ensure_canonical", "SparseArray",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
     "save_txt",
-    "matmul", "kron", "svd", "qr", "polar",
+    "matmul", "kron", "svd", "qr", "polar", "overlap_schedule",
     "tsqr", "random_svd", "lanczos_svd", "PCA",
     "shuffle", "train_test_split", "save_model", "load_model",
     "KMeans", "MiniBatchKMeans", "GaussianMixture", "DBSCAN", "Daura",

@@ -31,7 +31,9 @@ from dislib_tpu.data.array import Array, _repad, \
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
+from dislib_tpu.ops import overlap as _ov
 from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
+from dislib_tpu.utils import profiling as _prof
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.runtime import fetch as _fetch
 from dislib_tpu.runtime import fitloop as _fitloop
@@ -91,15 +93,24 @@ class Daura(BaseEstimator):
         else:
             def step(st, chunk):
                 if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
+                    # rotate/compute schedule: resolved at this host
+                    # boundary (DSLIB_OVERLAP flips retrace via the static)
+                    sched = _ov.resolve()
+                    _prof.count_schedule("ring_neigh", sched)
                     labels, medoids, hvec = _daura_fit_ring(
-                        x._data, x.shape, float(self.cutoff), n_atoms, mesh)
+                        x._data, x.shape, float(self.cutoff), n_atoms, mesh,
+                        overlap=sched)
                 elif x._data.shape[0] <= _DENSE_MAX:
                     labels, medoids, hvec = _daura_fit(
                         x._data, x.shape, float(self.cutoff), n_atoms)
                 else:
+                    # single-device tiled tier: no collective to overlap,
+                    # but the pallas route still picks the inner kernel
+                    sched = _ov.resolve()
+                    _prof.count_schedule("tiled_neigh", sched)
                     labels, medoids, hvec = _daura_fit_tiled(
                         x._data, x.shape, float(self.cutoff), n_atoms,
-                        _tiled.TILE)
+                        _tiled.TILE, use_pallas=(sched == "pallas"))
                 return _fitloop.ChunkOutcome(
                     _fitloop.LoopState((), 0, True, extra=(labels, medoids)),
                     hvec=hvec)      # input faults: typed raise via the loop
@@ -137,20 +148,27 @@ class Daura(BaseEstimator):
         ring = ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX)
         if ring:
             mp = x._data.shape[0]
+            sched = _ov.resolve()
+            _prof.count_schedule("ring_neigh", sched)
 
             def extract(active, labels, medoids, cid):
                 return _daura_extract_ring(
                     x._data, cutoff, n_atoms, mesh, active, labels,
-                    medoids, cid, max_new=checkpoint.every)
+                    medoids, cid, max_new=checkpoint.every, overlap=sched)
         else:
             # tiles-padded row count, computed arithmetically (pad_to_tiles'
             # own formula) — no eager padded copy of the dataset
             mp = -(-x._data.shape[0] // _tiled.TILE) * _tiled.TILE
+            # single-device tiled tier: the pallas route picks the inner
+            # kernel (no collective to overlap)
+            sched = _ov.resolve()
+            _prof.count_schedule("tiled_neigh", sched)
 
             def extract(active, labels, medoids, cid):
                 return _daura_extract_tiled(
                     x._data, x.shape, cutoff, n_atoms, _tiled.TILE, active,
-                    labels, medoids, cid, max_new=checkpoint.every)
+                    labels, medoids, cid, max_new=checkpoint.every,
+                    use_pallas=(sched == "pallas"))
         fp = np.asarray([x.shape[0], x.shape[1], cutoff, mp], np.float64)
         digest = data_digest(x._data)
         loop = _fitloop.ChunkedFitLoop("daura", checkpoint=checkpoint,
@@ -237,10 +255,11 @@ def _daura_fit(xp, shape, cutoff, n_atoms):
     return labels, medoids, hvec
 
 
-@partial(jax.jit, static_argnames=("shape", "n_atoms", "tile", "max_new"))
+@partial(jax.jit, static_argnames=("shape", "n_atoms", "tile", "max_new",
+                                   "use_pallas"))
 @precise
 def _daura_extract_tiled(xp, shape, cutoff, n_atoms, tile, active, labels,
-                         medoids, cid, max_new):
+                         medoids, cid, max_new, use_pallas=False):
     """Extract ≤ max_new clusters from the current greedy state (tiled
     passes).  Each extraction is one cluster = one pass; bounding the count
     is the mid-fit checkpoint boundary (SURVEY §6): the carried
@@ -255,7 +274,8 @@ def _daura_extract_tiled(xp, shape, cutoff, n_atoms, tile, active, labels,
     def body(carry):
         active_, labels_, medoids_, cid_, k = carry
         counts, _ = _tiled.neigh_count_min(xv, cut2, ids, active_,
-                                           jnp.int32(mp), tile)
+                                           jnp.int32(mp), tile,
+                                           use_pallas=use_pallas)
         counts = jnp.where(active_, counts, -1)
         medoid = jnp.argmax(counts).astype(jnp.int32)
         mrow = distances_sq(xv[medoid][None, :], xv)[0]
@@ -274,7 +294,7 @@ def _daura_extract_tiled(xp, shape, cutoff, n_atoms, tile, active, labels,
     return active, labels, medoids, cid, hvec
 
 
-def _daura_fit_tiled(xp, shape, cutoff, n_atoms, tile):
+def _daura_fit_tiled(xp, shape, cutoff, n_atoms, tile, use_pallas=False):
     """Greedy GROMOS loop without the resident m×m adjacency: each round's
     active-neighbor counts are a streamed tile pass (`ops/tiled.py`), and
     the extracted medoid's neighborhood is one (1, m) distance row.  Trades
@@ -290,14 +310,14 @@ def _daura_fit_tiled(xp, shape, cutoff, n_atoms, tile):
     medoids0 = jnp.full((mp,), -1, jnp.int32)
     _, labels, medoids, _, hvec = _daura_extract_tiled(
         xp, shape, cutoff, n_atoms, tile, valid, labels0, medoids0,
-        jnp.int32(0), max_new=1 << 30)
+        jnp.int32(0), max_new=1 << 30, use_pallas=use_pallas)
     return labels, medoids, hvec
 
 
-@partial(jax.jit, static_argnames=("n_atoms", "mesh", "max_new"))
+@partial(jax.jit, static_argnames=("n_atoms", "mesh", "max_new", "overlap"))
 @precise
 def _daura_extract_ring(xp, cutoff, n_atoms, mesh, active, labels,
-                        medoids, cid, max_new):
+                        medoids, cid, max_new, overlap="db"):
     """Ring-tier bounded extraction: ≤ max_new clusters from the current
     greedy state, active-neighbor counts ring-distributed over the mesh
     'rows' axis (ops/ring.py) — frames stay row-sharded, only the
@@ -310,7 +330,8 @@ def _daura_extract_ring(xp, cutoff, n_atoms, mesh, active, labels,
     def body(carry):
         active_, labels_, medoids_, cid_, k = carry
         counts, _ = ring_neigh_count_min(xp, cut2, ids, active_,
-                                         jnp.int32(mp), mesh)
+                                         jnp.int32(mp), mesh,
+                                         overlap=overlap)
         counts = jnp.where(active_, counts, -1)
         medoid = jnp.argmax(counts).astype(jnp.int32)
         mrow = distances_sq(xp[medoid][None, :], xp)[0]
@@ -328,7 +349,7 @@ def _daura_extract_ring(xp, cutoff, n_atoms, mesh, active, labels,
     return active, labels, medoids, cid, hvec
 
 
-def _daura_fit_ring(xp, shape, cutoff, n_atoms, mesh):
+def _daura_fit_ring(xp, shape, cutoff, n_atoms, mesh, overlap="db"):
     """One unbounded call of the ring extraction kernel."""
     m, _ = shape
     mp = xp.shape[0]
@@ -337,5 +358,5 @@ def _daura_fit_ring(xp, shape, cutoff, n_atoms, mesh):
     medoids0 = jnp.full((mp,), -1, jnp.int32)
     _, labels, medoids, _, hvec = _daura_extract_ring(
         xp, cutoff, n_atoms, mesh, valid, labels0, medoids0,
-        jnp.int32(0), max_new=1 << 30)
+        jnp.int32(0), max_new=1 << 30, overlap=overlap)
     return labels, medoids, hvec

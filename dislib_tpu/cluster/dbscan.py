@@ -45,9 +45,11 @@ from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad, \
     ensure_canonical as _ensure_canonical
 from dislib_tpu.ops import distances_sq
+from dislib_tpu.ops import overlap as _ov
 from dislib_tpu.ops.base import precise
 from dislib_tpu.ops import tiled as _tiled
 from dislib_tpu.ops.ring import ring_auto, ring_neigh_count_min
+from dislib_tpu.utils import profiling as _prof
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.runtime import fetch as _fetch
 from dislib_tpu.runtime import fitloop as _fitloop
@@ -113,17 +115,26 @@ class DBSCAN(BaseEstimator):
         else:
             def step(st, chunk):
                 if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
+                    # rotate/compute schedule: resolved at this host
+                    # boundary (DSLIB_OVERLAP flips retrace via the static)
+                    sched = _ov.resolve()
+                    _prof.count_schedule("ring_neigh", sched)
                     raw, core, hvec = _dbscan_fit_ring(
                         x._data, x.shape, float(self.eps),
-                        int(self.min_samples), mesh)
+                        int(self.min_samples), mesh, overlap=sched)
                 elif x._data.shape[0] <= _DENSE_MAX:
                     raw, core, hvec = _dbscan_fit(x._data, x.shape,
                                                   float(self.eps),
                                                   int(self.min_samples))
                 else:
+                    # single-device tiled tier: no collective to overlap,
+                    # but the pallas route still picks the inner kernel
+                    sched = _ov.resolve()
+                    _prof.count_schedule("tiled_neigh", sched)
                     raw, core, hvec = _dbscan_fit_tiled(
                         x._data, x.shape, float(self.eps),
-                        int(self.min_samples), _tiled.TILE)
+                        int(self.min_samples), _tiled.TILE,
+                        use_pallas=(sched == "pallas"))
                 return _fitloop.ChunkOutcome(
                     _fitloop.LoopState((), 0, True, extra=(raw, core)),
                     hvec=hvec)      # input faults: typed raise via the loop
@@ -167,33 +178,42 @@ class DBSCAN(BaseEstimator):
         eps, ms = float(self.eps), int(self.min_samples)
         if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
             mp = x._data.shape[0]
+            sched = _ov.resolve()
+            _prof.count_schedule("ring_neigh", sched)
 
             def setup():
-                return _dbscan_setup_ring(x._data, x.shape, eps, ms, mesh)
+                return _dbscan_setup_ring(x._data, x.shape, eps, ms, mesh,
+                                          overlap=sched)
 
             def propagate(lab, core):
                 return _dbscan_propagate_ring(
                     x._data, eps, lab, core, mesh,
-                    max_rounds=checkpoint.every)
+                    max_rounds=checkpoint.every, overlap=sched)
 
             def finalize(lab, core):
                 return _dbscan_finalize_ring(x._data, x.shape, eps, lab,
-                                             core, mesh)
+                                             core, mesh, overlap=sched)
         else:
             mp = -(-x._data.shape[0] // _tiled.TILE) * _tiled.TILE
+            # single-device tiled tier: the pallas route picks the inner
+            # kernel (no collective to overlap)
+            sched = _ov.resolve()
+            _prof.count_schedule("tiled_neigh", sched)
+            pall = sched == "pallas"
 
             def setup():
                 return _dbscan_setup_tiled(x._data, x.shape, eps, ms,
-                                           _tiled.TILE)
+                                           _tiled.TILE, use_pallas=pall)
 
             def propagate(lab, core):
                 return _dbscan_propagate_tiled(
                     x._data, x.shape, eps, lab, core, _tiled.TILE,
-                    max_rounds=checkpoint.every)
+                    max_rounds=checkpoint.every, use_pallas=pall)
 
             def finalize(lab, core):
                 return _dbscan_finalize_tiled(x._data, x.shape, eps, lab,
-                                              core, _tiled.TILE)
+                                              core, _tiled.TILE,
+                                              use_pallas=pall)
         fp = np.asarray([x.shape[0], x.shape[1], eps, ms, mp], np.float64)
         digest = data_digest(x._data)
         loop = _fitloop.ChunkedFitLoop("dbscan", checkpoint=checkpoint,
@@ -278,9 +298,10 @@ def _dbscan_fit(xp, shape, eps, min_samples):
     return final, core, hvec
 
 
-@partial(jax.jit, static_argnames=("shape", "min_samples", "tile"))
+@partial(jax.jit, static_argnames=("shape", "min_samples", "tile",
+                                   "use_pallas"))
 @precise
-def _dbscan_setup_tiled(xp, shape, eps, min_samples, tile):
+def _dbscan_setup_tiled(xp, shape, eps, min_samples, tile, use_pallas=False):
     """Tiled tier, phase 1: core mask + initial labels (one ε-pass)."""
     m, n = shape
     xv, _ = _tiled.pad_to_tiles(xp[:, :n], tile)
@@ -289,14 +310,16 @@ def _dbscan_setup_tiled(xp, shape, eps, min_samples, tile):
     valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
     ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
     counts, _ = _tiled.neigh_count_min(xv, eps * eps, ids, valid, sentinel,
-                                       tile)
+                                       tile, use_pallas=use_pallas)
     core = (counts >= min_samples) & valid
     return core, jnp.where(core, ids, sentinel)
 
 
-@partial(jax.jit, static_argnames=("shape", "tile", "max_rounds"))
+@partial(jax.jit, static_argnames=("shape", "tile", "max_rounds",
+                                   "use_pallas"))
 @precise
-def _dbscan_propagate_tiled(xp, shape, eps, label, core, tile, max_rounds):
+def _dbscan_propagate_tiled(xp, shape, eps, label, core, tile, max_rounds,
+                            use_pallas=False):
     """Tiled tier, phase 2: ≤ max_rounds min-label propagation rounds with
     pointer jumping.  Returns (label, changed) — ``changed`` True means the
     bound was hit mid-propagation and the caller must run another chunk
@@ -309,7 +332,8 @@ def _dbscan_propagate_tiled(xp, shape, eps, label, core, tile, max_rounds):
     def body(carry):
         lab, _, it = carry
         _, neigh_min = _tiled.neigh_count_min(xv, eps * eps, lab, core,
-                                              sentinel, tile)
+                                              sentinel, tile,
+                                              use_pallas=use_pallas)
         new = jnp.where(core, jnp.minimum(lab, neigh_min), sentinel)
         jumped = jnp.where(new < sentinel, new[jnp.minimum(new, mp - 1)],
                            sentinel)
@@ -326,9 +350,10 @@ def _dbscan_propagate_tiled(xp, shape, eps, label, core, tile, max_rounds):
     return label, changed, hvec
 
 
-@partial(jax.jit, static_argnames=("shape", "tile"))
+@partial(jax.jit, static_argnames=("shape", "tile", "use_pallas"))
 @precise
-def _dbscan_finalize_tiled(xp, shape, eps, label, core, tile):
+def _dbscan_finalize_tiled(xp, shape, eps, label, core, tile,
+                           use_pallas=False):
     """Tiled tier, phase 3: border labels + compact -1 noise encoding."""
     m, n = shape
     xv, _ = _tiled.pad_to_tiles(xp[:, :n], tile)
@@ -336,27 +361,31 @@ def _dbscan_finalize_tiled(xp, shape, eps, label, core, tile):
     sentinel = jnp.int32(mp)
     valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
     _, border_label = _tiled.neigh_count_min(xv, eps * eps, label, core,
-                                             sentinel, tile)
+                                             sentinel, tile,
+                                             use_pallas=use_pallas)
     final = jnp.where(core, label, jnp.where(valid, border_label, sentinel))
     return jnp.where(final < sentinel, final, -1)
 
 
-def _dbscan_fit_tiled(xp, shape, eps, min_samples, tile):
+def _dbscan_fit_tiled(xp, shape, eps, min_samples, tile, use_pallas=False):
     """Same algorithm as `_dbscan_fit`, adjacency streamed in tiles — the
     distance GEMM is recomputed per propagation round (O(log n) rounds via
     pointer jumping) instead of held resident.  Expressed as
     setup → propagate(unbounded) → finalize, the same three programs the
     checkpointed fit runs in bounded chunks."""
-    core, label0 = _dbscan_setup_tiled(xp, shape, eps, min_samples, tile)
+    core, label0 = _dbscan_setup_tiled(xp, shape, eps, min_samples, tile,
+                                       use_pallas=use_pallas)
     label, _, hvec = _dbscan_propagate_tiled(xp, shape, eps, label0, core,
-                                             tile, max_rounds=1 << 30)
-    return (_dbscan_finalize_tiled(xp, shape, eps, label, core, tile), core,
-            hvec)
+                                             tile, max_rounds=1 << 30,
+                                             use_pallas=use_pallas)
+    return (_dbscan_finalize_tiled(xp, shape, eps, label, core, tile,
+                                   use_pallas=use_pallas), core, hvec)
 
 
-@partial(jax.jit, static_argnames=("shape", "min_samples", "mesh"))
+@partial(jax.jit, static_argnames=("shape", "min_samples", "mesh",
+                                   "overlap"))
 @precise
-def _dbscan_setup_ring(xp, shape, eps, min_samples, mesh):
+def _dbscan_setup_ring(xp, shape, eps, min_samples, mesh, overlap="db"):
     """Ring tier, phase 1: core mask + initial labels (one ring ε-pass)."""
     m, _ = shape
     mp = xp.shape[0]
@@ -364,14 +393,16 @@ def _dbscan_setup_ring(xp, shape, eps, min_samples, mesh):
     eps2 = jnp.asarray(eps * eps, xp.dtype)
     valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
     ids = lax.broadcasted_iota(jnp.int32, (mp,), 0)
-    counts, _ = ring_neigh_count_min(xp, eps2, ids, valid, sentinel, mesh)
+    counts, _ = ring_neigh_count_min(xp, eps2, ids, valid, sentinel, mesh,
+                                     overlap=overlap)
     core = (counts >= min_samples) & valid
     return core, jnp.where(core, ids, sentinel)
 
 
-@partial(jax.jit, static_argnames=("mesh", "max_rounds"))
+@partial(jax.jit, static_argnames=("mesh", "max_rounds", "overlap"))
 @precise
-def _dbscan_propagate_ring(xp, eps, label, core, mesh, max_rounds):
+def _dbscan_propagate_ring(xp, eps, label, core, mesh, max_rounds,
+                           overlap="db"):
     """Ring tier, phase 2: ≤ max_rounds propagation rounds (checkpoint
     chunk boundary, same contract as the tiled variant).  Needs no
     logical shape: validity is already encoded in `core`, and the ring
@@ -383,7 +414,7 @@ def _dbscan_propagate_ring(xp, eps, label, core, mesh, max_rounds):
     def body(carry):
         lab, _, it = carry
         _, neigh_min = ring_neigh_count_min(xp, eps2, lab, core, sentinel,
-                                            mesh)
+                                            mesh, overlap=overlap)
         new = jnp.where(core, jnp.minimum(lab, neigh_min), sentinel)
         jumped = jnp.where(new < sentinel, new[jnp.minimum(new, mp - 1)],
                            sentinel)
@@ -399,9 +430,9 @@ def _dbscan_propagate_ring(xp, eps, label, core, mesh, max_rounds):
     return label, changed, hvec
 
 
-@partial(jax.jit, static_argnames=("shape", "mesh"))
+@partial(jax.jit, static_argnames=("shape", "mesh", "overlap"))
 @precise
-def _dbscan_finalize_ring(xp, shape, eps, label, core, mesh):
+def _dbscan_finalize_ring(xp, shape, eps, label, core, mesh, overlap="db"):
     """Ring tier, phase 3: border labels + compact -1 noise encoding."""
     m, _ = shape
     mp = xp.shape[0]
@@ -409,20 +440,22 @@ def _dbscan_finalize_ring(xp, shape, eps, label, core, mesh):
     eps2 = jnp.asarray(eps * eps, xp.dtype)
     valid = lax.broadcasted_iota(jnp.int32, (mp,), 0) < m
     _, border_label = ring_neigh_count_min(xp, eps2, label, core, sentinel,
-                                           mesh)
+                                           mesh, overlap=overlap)
     final = jnp.where(core, label, jnp.where(valid, border_label, sentinel))
     return jnp.where(final < sentinel, final, -1)
 
 
-def _dbscan_fit_ring(xp, shape, eps, min_samples, mesh):
+def _dbscan_fit_ring(xp, shape, eps, min_samples, mesh, overlap="db"):
     """Same algorithm as `_dbscan_fit_tiled`, ε-passes ring-distributed over
     the mesh 'rows' axis (`ops/ring.ring_neigh_count_min`): each device
     keeps only its row shard resident, label vectors stay row-sharded, and
     the pointer-jump gather is a sharded global gather handled by SPMD.
     Expressed as setup → propagate(unbounded) → finalize, the same three
     programs the checkpointed ring fit runs in bounded chunks."""
-    core, label0 = _dbscan_setup_ring(xp, shape, eps, min_samples, mesh)
+    core, label0 = _dbscan_setup_ring(xp, shape, eps, min_samples, mesh,
+                                      overlap=overlap)
     label, _, hvec = _dbscan_propagate_ring(xp, eps, label0, core, mesh,
-                                            max_rounds=1 << 30)
-    return (_dbscan_finalize_ring(xp, shape, eps, label, core, mesh), core,
-            hvec)
+                                            max_rounds=1 << 30,
+                                            overlap=overlap)
+    return (_dbscan_finalize_ring(xp, shape, eps, label, core, mesh,
+                                  overlap=overlap), core, hvec)

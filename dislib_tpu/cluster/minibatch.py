@@ -191,6 +191,11 @@ class MiniBatchKMeans(KMeans):
             self.history_ = np.asarray([], dtype=np.float64)
             self.fit_info_ = {"chunks": 0, "rollbacks": 0,
                               "mesh_shrinks": 0, "escalations": {}}
+        elif checkpoint is not None:
+            # run()'s contract for the streaming path: the final snapshot
+            # is on disk before fit returns (run_one never flushes — the
+            # stream owner does)
+            checkpoint.flush()
         return self
 
 

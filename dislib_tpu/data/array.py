@@ -1118,7 +1118,7 @@ def _eye_op(pshape, shape, dtype):
 
 
 def rechunk(x: Array, new_blocks=None, mesh=None, *, schedule="auto",
-            panels=None) -> Array:
+            panels=None, overlap=None) -> Array:
     """Reshard a ds-array to a new block-size hint and/or mesh layout —
     ON DEVICE, via a collective schedule, never a host materialization
     (round-11 rechunk PR; arXiv:2112.01075 discipline).
@@ -1143,6 +1143,13 @@ def rechunk(x: Array, new_blocks=None, mesh=None, *, schedule="auto",
       a device-set change uses the runtime's device-to-device copy.
     - ``panels``: in-flight panel count for the collective schedule
       (default ``DSLIB_RECHUNK_PANELS`` = 4).
+    - ``overlap``: the panel exchange's loop schedule — ``"db"``
+      (double-buffered, the default: the next panel's broadcast is
+      issued under the current panel's assemble) or ``"seq"``
+      (sequential-phase); ``None`` reads ``DSLIB_OVERLAP``.  Bit-equal
+      either way; the double buffer costs one extra in-flight panel
+      (round-13 overlap PR — see the user guide's "Overlap &
+      scheduling").
 
     The result re-satisfies the pad-and-mask invariant by construction:
     pad slices are exactly zero after the reshard, whatever the input
@@ -1179,7 +1186,8 @@ def rechunk(x: Array, new_blocks=None, mesh=None, *, schedule="auto",
                              (x._shape, tuple(out_pshape), _mesh_token()),
                              (x._node(),), out_pshape, x.dtype)
             return _lazy_array(expr, x._shape, reg, x._sparse)
-    data, _sched = _rc.reshard(x._data, x._shape, target, schedule, panels)
+    data, _sched = _rc.reshard(x._data, x._shape, target, schedule, panels,
+                               overlap)
     return Array(data, x._shape, reg, x._sparse)
 
 

@@ -34,9 +34,11 @@ from dislib_tpu.data.array import (
     ensure_canonical as _ensure_canonical,
 )
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.ops import overlap as _ov
 from dislib_tpu.ops import precision as px
 from dislib_tpu.ops.base import precise
 from dislib_tpu.ops.summa import summa_matmul, summa_supported
+from dislib_tpu.utils import profiling as _prof
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
 
@@ -56,8 +58,19 @@ def _matmul_kernel(a, b, ta, tb, a_shape, b_shape, policy):
 # auto-SUMMA size gate: below this min logical dimension an explicit
 # panel schedule buys nothing over the partitioner's fused dot, and a
 # small product is usually mid-chain where leaving the fusion graph would
-# cost a whole extra dispatch (module-level so tests can shrink it)
+# cost a whole extra dispatch (module-level so tests can shrink it;
+# ``DSLIB_SUMMA_MIN_DIM`` overrides at runtime — the bench overlap tier
+# sweeps small dims on host rigs without editing source)
 _SUMMA_MIN_DIM = 256
+
+
+def _summa_min_dim() -> int:
+    """The auto-SUMMA size gate the router actually enforces: the
+    ``DSLIB_SUMMA_MIN_DIM`` env knob when set, else the module default
+    (read per call so an env flip re-routes immediately — routing is a
+    host decision, no retrace subtlety)."""
+    env = os.environ.get("DSLIB_SUMMA_MIN_DIM")
+    return int(env) if env else _SUMMA_MIN_DIM
 
 
 def _pick_algorithm(algorithm, a, b, a_shape, b_shape, dense,
@@ -87,7 +100,7 @@ def _pick_algorithm(algorithm, a, b, a_shape, b_shape, dense,
             raise ValueError(f"bad DSLIB_MATMUL_ALGO={env!r}")
         algorithm = env
     if algorithm == "auto":
-        big = min(a_shape[0], a_shape[1], b_shape[1]) >= _SUMMA_MIN_DIM
+        big = min(a_shape[0], a_shape[1], b_shape[1]) >= _summa_min_dim()
         standalone = dense and not (a.is_lazy or b.is_lazy)
         return "summa" if (standalone and big and summa_supported()
                            and not (transpose_a or transpose_b)) else "xla"
@@ -165,7 +178,12 @@ def _matmul_summa(a, b, transpose_a, transpose_b, policy, out_shape, reg):
     b = _ensure_canonical(b)
     ad, bd = a._data, b._data
     ad, bd = _match_inner(ad, bd, False, False)
-    out = summa_matmul(ad, bd, _mesh.get_mesh(), policy)
+    # panel schedule: resolved HERE (the host routing boundary) so a
+    # DSLIB_OVERLAP flip retraces via the kernel's static, and the run
+    # is observable through the schedule counters
+    sched = _ov.resolve()
+    _prof.count_schedule("summa_matmul", sched)
+    out = summa_matmul(ad, bd, _mesh.get_mesh(), policy, overlap=sched)
     return Array(_crop_or_keep(out, out_shape), out_shape, reg, False)
 
 

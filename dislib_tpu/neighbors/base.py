@@ -32,9 +32,11 @@ from jax.sharding import PartitionSpec as P
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.ops import overlap as _ov
 from dislib_tpu.ops.base import distances_sq, precise
 from dislib_tpu.ops.ring import ring_auto, ring_kneighbors
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.utils import profiling as _prof
 
 
 class NearestNeighbors(BaseEstimator):
@@ -98,9 +100,15 @@ class NearestNeighbors(BaseEstimator):
         if ring_auto(getattr(self, "ring", None), mesh,
                      f.shape[0] >= _RING_MIN) \
                 and mesh.shape[_mesh.ROWS] > 1:
+            # rotate/compute schedule: resolved at this host boundary so a
+            # DSLIB_OVERLAP flip retraces via the kernel static (and the
+            # routing is observable through the schedule counters)
+            sched = _ov.resolve()
+            _prof.count_schedule("ring_kneighbors", sched)
             d, idx = _kneighbors_ring(x._data.astype(jnp.float32),
                                       f._data.astype(jnp.float32),
-                                      mesh, k, x.shape[0], f.shape[0])
+                                      mesh, k, x.shape[0], f.shape[0],
+                                      overlap=sched)
         else:
             d, idx = _kneighbors(x._data, f._data, x.shape, f.shape, k,
                                  chunk=_CHUNK)
@@ -121,9 +129,14 @@ _CHUNK = 4096
 _RING_MIN = 1 << 16
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "mq", "m_fit"))
-def _kneighbors_ring(qp, fp, mesh, k, mq, m_fit):
-    d2, idx = ring_kneighbors(qp, fp, mesh, k, m_fit)
+@partial(_prof.profiled_jit, static_argnames=("mesh", "k", "mq", "m_fit",
+                                              "overlap"),
+         name="ring_kneighbors")
+def _kneighbors_ring(qp, fp, mesh, k, mq, m_fit, overlap="db"):
+    # profiled (round-13): this is a HOST dispatch boundary — one program
+    # per ring kneighbors call — so "the ring schedule is still exactly
+    # one dispatch" is a counter assertion (tests/test_overlap, bench)
+    d2, idx = ring_kneighbors(qp, fp, mesh, k, m_fit, overlap=overlap)
     dist = jnp.sqrt(jnp.maximum(d2, 0.0))
     valid_q = lax.broadcasted_iota(jnp.int32, (dist.shape[0], 1), 0) < mq
     return jnp.where(valid_q, dist, 0.0), jnp.where(valid_q, idx, 0)

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from dislib_tpu.ops.precision import precise  # noqa: F401
 
 
-def distances_sq(a, b, precision=None):
+def distances_sq(a, b, precision=None, use_pallas=False):
     """Pairwise squared euclidean distances (m, k) between rows of `a` (m, d)
     and rows of `b` (k, d): one GEMM + norms (‖a‖² − 2a·bᵀ + ‖b‖²), clamped
     at zero against cancellation.
@@ -32,7 +32,12 @@ def distances_sq(a, b, precision=None):
     :func:`precise`.  At TPU-native bf16 the cross-term error (~‖x‖²/256)
     dwarfs ε-thresholds — a point's distance to ITSELF comes out ≫ 0,
     breaking radius comparisons (DBSCAN/Daura) — so callers outside a
-    ``precise`` kernel should pass an explicit precision."""
+    ``precise`` kernel should pass an explicit precision.
+
+    ``use_pallas=True`` (raw jax operands only) lowers the whole
+    formulation through the ``ops/pallas_kernels`` tile kernel — the
+    ``DSLIB_OVERLAP=pallas`` route for the ring/tiled ε-pass inner loop;
+    callers thread it as a jit static (``ops/overlap.resolve``)."""
     import importlib
     # deferred import, cycle-free at load; the data package re-exports an
     # `array` FUNCTION, so resolve the module by its dotted name
@@ -43,6 +48,9 @@ def distances_sq(a, b, precision=None):
                 "distances_sq over ds-arrays needs BOTH operands as dense "
                 f"Arrays, got {type(a).__name__} and {type(b).__name__}")
         return _arr._array_distances(a, b, precision)
+    if use_pallas:
+        from dislib_tpu.ops import pallas_kernels as _pk
+        return _pk.distances_sq(a, b, precision=precision)
     a_sq = jnp.sum(a * a, axis=1, keepdims=True)
     b_sq = jnp.sum(b * b, axis=1)
     cross = jnp.matmul(a, b.T, precision=precision)

@@ -1,0 +1,146 @@
+"""Pallas fallback kernels for the overlap schedules' hot inner loops.
+
+``DSLIB_OVERLAP=pallas`` routes the two FLOP-dominant inner computations
+of the panel pipelines — SUMMA's per-panel GEMM and the ring ε-pass's
+``distances_sq`` — through explicit Pallas kernels instead of plain HLO.
+The escape hatch exists for backends where XLA's scheduler refuses to
+hide the panel collective under the previous panel's compute (verified
+by the compiled-HLO audit in ``tests/test_overlap``): a Pallas call is
+an opaque compute region the latency-hiding scheduler treats as one
+unit, so the pipelined loop's independent collective can slide past it.
+
+Contract (mirrors ``ops/precision``): operands are rounded to the
+policy's compute dtype, contractions accumulate in the policy's
+accumulation dtype, outputs match what the plain-HLO path produces — the
+Pallas route changes the SCHEDULE, not the numerics contract (values are
+allclose-tested, not bit-tested: a different GEMM tiling reassociates
+sums).  On non-TPU backends the kernels run in Pallas interpret mode —
+semantically identical, which keeps the whole router testable on the CPU
+rig; :func:`available` probes the backend once and the overlap router
+degrades ``pallas`` → ``db`` (with a warning) when the probe fails, so
+the sequential and double-buffered XLA schedules are always available.
+
+Kernels keep the library's precision-lint contract: no hardcoded compute
+dtypes — every cast routes through ``ops/precision`` or derives from a
+value's own dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dislib_tpu.ops import precision as px
+
+# grid tile target for the row-tiled kernels: MXU-friendly on chip, and
+# a no-op cap for the small interpreted blocks on host rigs
+_TILE_ROWS = 128
+
+
+def _interpret() -> bool:
+    """Pallas interpret mode everywhere but real TPUs — same semantics,
+    no Mosaic lowering requirement (the CPU-rig test path)."""
+    return jax.default_backend() != "tpu"
+
+
+_AVAILABLE: bool | None = None
+
+
+def available() -> bool:
+    """One cached probe: can this process run a Pallas kernel at all?
+    (Import failure, an old jaxlib, or a backend without interpret
+    support all land here as False — the overlap router then degrades
+    ``pallas`` to the plain double-buffered schedule.)"""
+    global _AVAILABLE
+    if _AVAILABLE is None:
+        try:
+            import numpy as np
+            x = jnp.ones((8, 4), px.compute_dtype(px.FLOAT32))
+            out = panel_gemm(x, x.T, px.FLOAT32)
+            _AVAILABLE = bool(abs(float(np.asarray(out)[0, 0]) - 4.0) < 1e-6)
+        except Exception:  # noqa: BLE001 — any failure means "not here"
+            _AVAILABLE = False
+    return _AVAILABLE
+
+
+def _row_block(m: int) -> int:
+    """Largest divisor of ``m`` ≤ the tile target (grid blocks must tile
+    the row dim exactly; padded dims are quantum multiples, so this is
+    almost always the target itself)."""
+    for b in range(min(m, _TILE_ROWS), 0, -1):
+        if m % b == 0:
+            return b
+    return m
+
+
+def panel_gemm(a, b, policy=px.FLOAT32):
+    """``A @ B`` as a row-tiled Pallas kernel — the SUMMA panel GEMM.
+
+    Same numerics contract as :func:`ops.precision.pdot`: operands round
+    to the policy compute dtype, the contraction accumulates in the
+    policy accumulation dtype (promoted for x64-mode f64 operands under
+    the float32-floor policy), output is the accumulation dtype."""
+    from jax.experimental import pallas as pl
+
+    a = px.to_compute(a, policy)
+    b = px.to_compute(b, policy)
+    acc_dt = jnp.promote_types(px.accum_dtype(policy),
+                               jnp.promote_types(a.dtype, b.dtype))
+    m, k = a.shape
+    _, n = b.shape
+    bm = _row_block(m)
+
+    def kern(a_ref, b_ref, o_ref):
+        # pdot's MXU-precision guarantee must survive the Pallas route —
+        # without the explicit precision a f32 FLOAT32-policy call
+        # outside a `precise` scope would run the backend default
+        o_ref[:, :] = jnp.dot(a_ref[:, :], b_ref[:, :],
+                              preferred_element_type=acc_dt,
+                              precision=policy.dot_precision)
+
+    return pl.pallas_call(
+        kern,
+        grid=(m // bm,),
+        in_specs=[pl.BlockSpec((bm, k), lambda i: (i, 0)),
+                  pl.BlockSpec((k, n), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((bm, n), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, n), acc_dt),
+        interpret=_interpret(),
+    )(a, b)
+
+
+def distances_sq(a, b, precision=None):
+    """Pairwise squared euclidean distances as a row-tiled Pallas kernel
+    — the ring/tiled ε-pass inner loop (``ops/base.distances_sq``'s
+    ‖a‖² − 2a·bᵀ + ‖b‖² formulation, clamped at zero against
+    cancellation).  Output dtype matches the plain-HLO path (the
+    operands' promoted float dtype); ``precision`` threads to the cross
+    GEMM exactly as the plain path threads it to ``jnp.matmul`` — the
+    Pallas route must not silently drop a caller's explicit MXU
+    precision (``None`` inherits the enclosing scope, as there)."""
+    from jax.experimental import pallas as pl
+
+    out_dt = jnp.promote_types(a.dtype, b.dtype)
+    m, _ = a.shape
+    kf, d = b.shape
+    bm = _row_block(m)
+
+    def kern(a_ref, b_ref, o_ref):
+        av = a_ref[:, :]
+        bv = b_ref[:, :]
+        cross = jnp.dot(av, bv.T, preferred_element_type=out_dt,
+                        precision=precision)
+        a_sq = jnp.sum(av * av, axis=1, keepdims=True)
+        b_sq = jnp.sum(bv * bv, axis=1)
+        o_ref[:, :] = jnp.maximum(a_sq - 2.0 * cross + b_sq[None, :],
+                                  jnp.zeros((), out_dt))
+
+    return pl.pallas_call(
+        kern,
+        grid=(m // bm,),
+        in_specs=[pl.BlockSpec((bm, d), lambda i: (i, 0)),
+                  pl.BlockSpec((kf, d), lambda i: (0, 0))],
+        out_specs=pl.BlockSpec((bm, kf), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((m, kf), out_dt),
+        interpret=_interpret(),
+    )(a, b)

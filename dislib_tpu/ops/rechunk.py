@@ -54,12 +54,14 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dislib_tpu.ops import overlap as _ov
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.utils import profiling as _prof
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
 __all__ = [
     "requantize_body", "repad_axis", "panel_rechunk", "deviceput_rechunk",
-    "reshard", "panel_memory_analysis",
+    "reshard", "panel_memory_analysis", "panel_comm_probe",
 ]
 
 SCHEDULES = ("auto", "xla", "panels", "deviceput")
@@ -171,13 +173,23 @@ def _target_coord_tables(src_mesh: Mesh, dst_mesh: Mesh):
 
 
 @partial(_pjit, static_argnames=("logical_shape", "out_pshape", "src_mesh",
-                                 "dst_shape", "tr_key", "tc_key", "steps"),
+                                 "dst_shape", "tr_key", "tc_key", "steps",
+                                 "overlap", "comm_only"),
          name="rechunk_panels")
 def _panel_exchange(data, logical_shape, out_pshape, src_mesh, dst_shape,
-                    tr_key, tc_key, steps):
+                    tr_key, tc_key, steps, overlap="db", comm_only=False):
     """ONE jitted program: shard_map over the SOURCE mesh; each device
     assembles its TARGET-layout block from ``steps`` masked-psum panel
     broadcasts (the ``ops/summa.py`` collective idiom, ``check_vma`` on).
+
+    The exchange/assemble loop runs through ``ops/overlap.panel_pipeline``
+    (round-13): under the default double-buffered schedule panel t+1's
+    rows-axis broadcast is issued before panel t's cols-broadcast/gather
+    assembly consumes it — one extra in-flight panel of live memory
+    (verified by :func:`panel_memory_analysis`), bit-equal to the
+    sequential schedule (``overlap="seq"``).  ``comm_only=True`` is the
+    bench tier's broadcast-only variant: the identical collectives with
+    the gather/assemble compute replaced by a (1, 1) touch per panel.
 
     ``tr_key``/``tc_key`` are the target-coordinate tables as hashable
     tuples (they ride the jit cache key: a different device mapping is a
@@ -201,31 +213,55 @@ def _panel_exchange(data, logical_shape, out_pshape, src_mesh, dst_shape,
         ri = row0 + lax.iota(jnp.int32, m_loc2)   # global coords of my
         ci = col0 + lax.iota(jnp.int32, n_loc2)   # target block entries
 
-        def step(t, acc):
+        def fetch(t, prev):
+            del prev                        # panels slice by step
             owner_r = t // j
             pan = lax.dynamic_slice(x_loc, ((t % j) * h, 0), (h, n_loc1))
             pan = jnp.where(my_r == owner_r, pan, jnp.zeros((), pan.dtype))
-            pan = lax.psum(pan, _mesh.ROWS)
-            gr0 = owner_r * m_loc1 + (t % j) * h  # panel's global row base
-            r_in = (ri >= gr0) & (ri < gr0 + h)
-            r_idx = jnp.clip(ri - gr0, 0, h - 1)
-            for s in range(cols_s):         # static: one psum per col-rank
+            return lax.psum(pan, _mesh.ROWS)
+
+        def _col_blocks(pan):
+            """The per-col-rank broadcasts of one row panel (static loop:
+            one masked psum per source col-rank)."""
+            for s in range(cols_s):
                 if cols_s > 1:
                     blk = jnp.where(my_c == s, pan,
                                     jnp.zeros((), pan.dtype))
                     blk = lax.psum(blk, _mesh.COLS)
                 else:
                     blk = pan
-                gc0 = s * n_loc1
-                c_in = (ci >= gc0) & (ci < gc0 + n_loc1)
-                c_idx = jnp.clip(ci - gc0, 0, n_loc1 - 1)
-                gathered = blk[r_idx][:, c_idx]
-                acc = jnp.where(r_in[:, None] & c_in[None, :], gathered, acc)
-            return acc
+                yield s, blk
 
-        acc0 = lax.pcast(jnp.zeros((m_loc2, n_loc2), x_loc.dtype),
+        if comm_only:
+            def consume(t, acc, pan):
+                for _, blk in _col_blocks(pan):
+                    acc = acc + blk[:1, :1]
+                return acc
+
+            acc_shape = (1, 1)
+        else:
+            def consume(t, acc, pan):
+                owner_r = t // j
+                gr0 = owner_r * m_loc1 + (t % j) * h  # panel's global rows
+                r_in = (ri >= gr0) & (ri < gr0 + h)
+                r_idx = jnp.clip(ri - gr0, 0, h - 1)
+                for s, blk in _col_blocks(pan):
+                    gc0 = s * n_loc1
+                    c_in = (ci >= gc0) & (ci < gc0 + n_loc1)
+                    c_idx = jnp.clip(ci - gc0, 0, n_loc1 - 1)
+                    gathered = blk[r_idx][:, c_idx]
+                    acc = jnp.where(r_in[:, None] & c_in[None, :],
+                                    gathered, acc)
+                return acc
+
+            acc_shape = (m_loc2, n_loc2)
+
+        acc0 = lax.pcast(jnp.zeros(acc_shape, x_loc.dtype),
                          (_mesh.ROWS, _mesh.COLS), to="varying")
-        acc = lax.fori_loop(0, steps, step, acc0)
+        acc = _ov.panel_pipeline(steps, fetch(0, None), fetch, consume,
+                                 acc0, _ov.overlapped(overlap))
+        if comm_only:
+            return acc
         # re-assert the pad-and-mask invariant on the NEW canvas: entries
         # outside the logical region are zero no matter what the source
         # pad tail carried
@@ -240,9 +276,11 @@ def _panel_exchange(data, logical_shape, out_pshape, src_mesh, dst_shape,
     )(data)
 
 
-def _panel_args(data, logical_shape, dst_mesh, panels):
+def _panel_args(data, logical_shape, dst_mesh, panels, overlap=None):
     """Static argument pack for :func:`_panel_exchange` (shared by the
-    run path and the AOT memory-analysis probe)."""
+    run path and the AOT memory-analysis probe).  ``overlap`` resolves
+    through the ``DSLIB_OVERLAP`` router here, at the host boundary, so
+    an env flip retraces (the precision-policy static contract)."""
     sharding = data.sharding
     src_mesh = sharding.mesh
     out_pshape = _out_pshape(logical_shape, dst_mesh)
@@ -256,7 +294,8 @@ def _panel_args(data, logical_shape, dst_mesh, panels):
                            dst_mesh.shape[_mesh.COLS]),
                 tr_key=tuple(int(v) for v in tr),
                 tc_key=tuple(int(v) for v in tc),
-                steps=rows_s * j)
+                steps=rows_s * j,
+                overlap=_ov.resolve(overlap))
 
 
 def panel_supported(data, dst_mesh) -> bool:
@@ -281,12 +320,15 @@ def panel_supported(data, dst_mesh) -> bool:
     return set(dst_mesh.devices.flat) <= src_devs
 
 
-def panel_rechunk(data, logical_shape, dst_mesh, panels=None):
+def panel_rechunk(data, logical_shape, dst_mesh, panels=None, overlap=None):
     """The explicit collective reshard: ONE jitted panel-exchange program
     over the source mesh, then a ZERO-COPY rewrap of the per-device
     target blocks as a global array of ``dst_mesh`` — no host, no
-    gathered copy, peak in-flight panel bytes ≈ |array| / panels."""
-    kw = _panel_args(data, logical_shape, dst_mesh, panels)
+    gathered copy, peak in-flight panel bytes ≈ |array| / panels (one
+    extra panel under the default double-buffered ``overlap`` schedule —
+    see :func:`panel_memory_analysis`)."""
+    kw = _panel_args(data, logical_shape, dst_mesh, panels, overlap)
+    _prof.count_schedule("rechunk_panels", kw["overlap"])
     out_perm = _panel_exchange(data, **kw)
     out_pshape = kw["out_pshape"]
     by_dev = {s.device: s.data for s in out_perm.addressable_shards}
@@ -295,27 +337,45 @@ def panel_rechunk(data, logical_shape, dst_mesh, panels=None):
         out_pshape, NamedSharding(dst_mesh, P(*_mesh.AXIS_NAMES)), bufs)
 
 
-def panel_memory_analysis(data, logical_shape, dst_mesh, panels=None):
+def panel_comm_probe(data, logical_shape, dst_mesh, panels=None,
+                     overlap="seq"):
+    """Broadcast-only variant of the SAME panel-exchange program — the
+    identical masked-psum collectives with the gather/assemble compute
+    replaced by a (1, 1) touch per panel, so the collectives survive
+    DCE.  The bench overlap tier's t_comm_alone denominator."""
+    kw = _panel_args(data, logical_shape, dst_mesh, panels, overlap)
+    return _panel_exchange(data, comm_only=True, **kw)
+
+
+def panel_memory_analysis(data, logical_shape, dst_mesh, panels=None,
+                          overlap=None):
     """XLA's own memory accounting of the compiled panel-exchange program
     — the bench tier's peak-live-buffer proxy.  Returns a dict with
     ``in_bytes``/``out_bytes``/``temp_bytes`` and ``peak_live_ratio`` =
     (out + temp) / in: a schedule that gathered a full copy would sit at
-    ≥ 2.0; the panel schedule stays ≈ 1 + 1/panels.  ``temp_bytes`` is
-    None when the backend exposes no memory analysis (the analytic panel
-    bound is reported alongside either way)."""
-    kw = _panel_args(data, logical_shape, dst_mesh, panels)
+    ≥ 2.0; the sequential panel schedule stays ≈ 1 + 1/panels and the
+    double-buffered one ≈ 1 + 2/panels (the pipelined carry holds ONE
+    extra in-flight panel, never a copy of the operand — the bench
+    overlap tier's documented bound).  ``temp_bytes`` is None when the
+    backend exposes no memory analysis (the analytic panel bound is
+    reported alongside either way)."""
+    kw = _panel_args(data, logical_shape, dst_mesh, panels, overlap)
     in_bytes = data.size * data.dtype.itemsize
     out_bytes = int(np.prod(kw["out_pshape"])) * data.dtype.itemsize
     n_dev = int(np.prod(kw["src_mesh"].devices.shape))
     # analytic in-flight bound: every device holds one (h, n_loc1) panel
-    # (+ its cols-broadcast twin) during a step
+    # (+ its cols-broadcast twin, + the pipelined next panel when
+    # double-buffered) during a step
     cols_s = kw["src_mesh"].shape[_mesh.COLS]
     panel_bytes = in_bytes // kw["steps"]
-    analytic_temp = panel_bytes * (2 if cols_s > 1 else 1)
+    analytic_temp = panel_bytes * ((2 if cols_s > 1 else 1)
+                                   + (1 if _ov.overlapped(kw["overlap"])
+                                      else 0))
     res = {"in_bytes": in_bytes, "out_bytes": out_bytes,
            "panels": kw["steps"], "analytic_temp_bytes": analytic_temp,
            "analytic_ratio": round((out_bytes + analytic_temp) / in_bytes, 3),
-           "temp_bytes": None, "peak_live_ratio": None, "n_devices": n_dev}
+           "temp_bytes": None, "peak_live_ratio": None, "n_devices": n_dev,
+           "overlap": kw["overlap"]}
     try:
         compiled = _panel_exchange.lower(data, **kw).compile()
         ma = compiled.memory_analysis()
@@ -374,10 +434,12 @@ def pick_schedule(data, dst_mesh, schedule="auto") -> str:
     return "deviceput"
 
 
-def reshard(data, logical_shape, dst_mesh, schedule="auto", panels=None):
+def reshard(data, logical_shape, dst_mesh, schedule="auto", panels=None,
+            overlap=None):
     """Reshard a padded device backing for ``dst_mesh``'s quantum and
     layout.  Returns ``(new_backing, schedule_used)``; never touches the
-    host for an on-device operand."""
+    host for an on-device operand.  ``overlap`` picks the panel
+    exchange's loop schedule (None → the ``DSLIB_OVERLAP`` router)."""
     sched = pick_schedule(data, dst_mesh, schedule)
     if sched == "panels":
         if not panel_supported(data, dst_mesh):
@@ -386,7 +448,8 @@ def reshard(data, logical_shape, dst_mesh, schedule="auto", panels=None):
                 "the named mesh whose device set covers the target mesh — "
                 "use schedule='deviceput' (or 'auto') for a device-set "
                 "change")
-        return panel_rechunk(data, logical_shape, dst_mesh, panels), sched
+        return panel_rechunk(data, logical_shape, dst_mesh, panels,
+                             overlap), sched
     if sched == "deviceput":
         return deviceput_rechunk(data, logical_shape, dst_mesh), sched
     # "xla": one jitted requantize; any residual layout change is the SPMD

@@ -15,6 +15,21 @@ Feature columns stay sharded over 'cols': each step's distance GEMM computes
 a per-cols-shard partial and one `psum` over 'cols' completes it, which also
 makes the result provably replicated across 'cols' (check_vma stays ON,
 SURVEY §6 race-detection row).
+
+Rotate/compute schedule (round-13 overlap PR): both ring kernels run their
+step loop through ``ops/overlap.panel_pipeline``.  Under the default
+double-buffered schedule the NEXT shard's ``ppermute`` hops are issued
+before the current shard's distance fold consumes it, so the rotation
+rides the ICI while the MXU folds — bit-equal to the sequential
+rotate-then-compute schedule (``overlap="seq"``), still one jitted
+program.  ``overlap="pallas"`` additionally lowers the fold's distance
+kernel through ``ops/pallas_kernels``.  The ``overlap`` argument is a
+jit static resolved by the CALLERS via ``ops/overlap.resolve`` (the
+estimator tier pickers), so a ``DSLIB_OVERLAP`` flip retraces; both
+kernels stay plain ``jax.jit`` (NOT profiled) because they are invoked
+from inside other jitted programs — the dispatch-count boundary is their
+outer kernel.  ``comm_only=True`` builds the rotation-only variant of
+the same program (the bench overlap tier's t_comm_alone denominator).
 """
 
 from __future__ import annotations
@@ -26,13 +41,20 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from dislib_tpu.ops import overlap as _ov
 from dislib_tpu.ops.base import distances_sq, precise
 from dislib_tpu.parallel import mesh as _mesh
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "m_fit"))
+def _rotate(perm, *arrays):
+    """One ring hop of every carried array (the panel fetch)."""
+    return tuple(lax.ppermute(a, _mesh.ROWS, perm) for a in arrays)
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "m_fit", "overlap",
+                                   "comm_only"))
 @precise
-def ring_kneighbors(qp, fp, mesh, k, m_fit):
+def ring_kneighbors(qp, fp, mesh, k, m_fit, overlap="db", comm_only=False):
     """(distances², indices) of the k nearest fitted rows per query row.
 
     qp, fp: canonically sharded padded backings (rows over 'rows', features
@@ -50,9 +72,30 @@ def ring_kneighbors(qp, fp, mesh, k, m_fit):
         ids0 = my * mf_loc + lax.broadcasted_iota(jnp.int32, (mf_loc,), 0)
         perm = [(i, (i + 1) % nrows) for i in range(nrows)]
 
-        def step(s, carry):
-            f_cur, fsq_cur, ids_cur, best_d, best_i = carry
-            part = lax.psum(q @ f_cur.T, _mesh.COLS)       # (mq_loc, mf_loc)
+        def fetch(t, prev):
+            return _rotate(perm, *prev)     # one ICI hop per carried array
+
+        pan0 = (f, f_sq0, ids0)
+
+        if comm_only:
+            def consume(t, acc, pan):
+                f_cur, fsq_cur, ids_cur = pan
+                return (acc + f_cur[:1, :1] + fsq_cur[:1][None]
+                        + ids_cur[:1][None].astype(acc.dtype))
+
+            acc0 = lax.pcast(jnp.zeros((1, 1), q.dtype),
+                             (_mesh.ROWS, _mesh.COLS), to="varying")
+            return _ov.panel_pipeline(nrows, pan0, fetch, consume, acc0,
+                                      _ov.overlapped(overlap))
+
+        def consume(t, carry, pan):
+            best_d, best_i = carry
+            f_cur, fsq_cur, ids_cur = pan
+            if overlap == "pallas":
+                from dislib_tpu.ops import pallas_kernels as _pk
+                part = lax.psum(_pk.panel_gemm(q, f_cur.T), _mesh.COLS)
+            else:
+                part = lax.psum(q @ f_cur.T, _mesh.COLS)   # (mq_loc, mf_loc)
             d2 = q_sq[:, None] - 2.0 * part + fsq_cur[None, :]
             d2 = jnp.where(ids_cur[None, :] < m_fit, d2, jnp.inf)
             cand_d = jnp.concatenate([best_d, d2], axis=1)
@@ -62,26 +105,24 @@ def ring_kneighbors(qp, fp, mesh, k, m_fit):
             neg, pos = lax.top_k(-cand_d, k)
             best_d = -neg
             best_i = jnp.take_along_axis(cand_i, pos, axis=1)
-            # rotate the fitted shard one hop around the ring (ICI)
-            f_cur = lax.ppermute(f_cur, _mesh.ROWS, perm)
-            fsq_cur = lax.ppermute(fsq_cur, _mesh.ROWS, perm)
-            ids_cur = lax.ppermute(ids_cur, _mesh.ROWS, perm)
-            return f_cur, fsq_cur, ids_cur, best_d, best_i
+            return best_d, best_i
 
         # the constant top-k seeds become row-varying on the first merge;
         # declaring it up front keeps check_vma provable
-        init = (f, f_sq0, ids0,
-                lax.pcast(jnp.full((q.shape[0], k), jnp.inf, q.dtype),
+        acc0 = (lax.pcast(jnp.full((q.shape[0], k), jnp.inf, q.dtype),
                           (_mesh.ROWS,), to="varying"),
                 lax.pcast(jnp.full((q.shape[0], k), -1, jnp.int32),
                           (_mesh.ROWS,), to="varying"))
-        _, _, _, best_d, best_i = lax.fori_loop(0, nrows, step, init)
+        best_d, best_i = _ov.panel_pipeline(nrows, pan0, fetch, consume,
+                                            acc0, _ov.overlapped(overlap))
         return jnp.maximum(best_d, 0.0), best_i
 
+    out_specs = P(_mesh.ROWS, _mesh.COLS) if comm_only \
+        else (P(_mesh.ROWS, None), P(_mesh.ROWS, None))
     return jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(_mesh.ROWS, _mesh.COLS), P(_mesh.ROWS, _mesh.COLS)),
-        out_specs=(P(_mesh.ROWS, None), P(_mesh.ROWS, None)),
+        out_specs=out_specs,
         check_vma=True,
     )(qp, fp)
 
@@ -105,9 +146,10 @@ def ring_auto(flag, mesh, large):
     return mesh.shape[_mesh.ROWS] > 1 and large
 
 
-@partial(jax.jit, static_argnames=("mesh",))
+@partial(jax.jit, static_argnames=("mesh", "overlap", "comm_only"))
 @precise
-def ring_neigh_count_min(xp, eps2, vals, colmask, sentinel, mesh):
+def ring_neigh_count_min(xp, eps2, vals, colmask, sentinel, mesh,
+                         overlap="db", comm_only=False):
     """Per-row (ε-neighbor count, min over neighbor vals) of a row-sharded
     dataset against itself — `ops/tiled.neigh_count_min` distributed over
     the mesh 'rows' axis.
@@ -117,7 +159,9 @@ def ring_neigh_count_min(xp, eps2, vals, colmask, sentinel, mesh):
     while (shard, vals, colmask, ids) rotate around the 'rows' ring via
     ppermute; each visit streams in (tile × tile) distance pieces so peak
     memory per device is O(tile²).  adj(i,j) = (d²(i,j) ≤ eps2 ∨ i = j) ∧
-    colmask_j, exactly the single-device contract.
+    colmask_j, exactly the single-device contract.  Under the default
+    double-buffered ``overlap`` the next hop's ppermutes are issued before
+    the visiting shard's tile pass consumes it (see module docstring).
 
     xp (mp, np) canonically sharded; vals/colmask (mp,) row-sharded.
     Returns (counts int32 (mp,), mins (mp,) of vals.dtype), row-sharded.
@@ -157,7 +201,8 @@ def ring_neigh_count_min(xp, eps2, vals, colmask, sentinel, mesh):
 
                 def col_body(acc, cx):
                     xcol, cid, vv, cmm = cx
-                    d2 = distances_sq(xrow, xcol)
+                    d2 = distances_sq(xrow, xcol,
+                                      use_pallas=(overlap == "pallas"))
                     adj = ((d2 <= eps2)
                            | (rid[:, None] == cid[None, :])) & cmm[None, :]
                     c_acc = acc[0] + jnp.sum(adj, axis=1)
@@ -174,21 +219,34 @@ def ring_neigh_count_min(xp, eps2, vals, colmask, sentinel, mesh):
                                         (x_t, r_t, cnt_t, mn_t))
             return cnt_o.reshape(m_t), mn_o.reshape(m_t)
 
-        def step(s, carry):
-            xc, idc, vc, cmc, cnt, mn = carry
-            cnt, mn = pair_pass(xc, idc, vc, cmc, cnt, mn)
-            xc = lax.ppermute(xc, _mesh.ROWS, perm)
-            idc = lax.ppermute(idc, _mesh.ROWS, perm)
-            vc = lax.ppermute(vc, _mesh.ROWS, perm)
-            cmc = lax.ppermute(cmc, _mesh.ROWS, perm)
-            return xc, idc, vc, cmc, cnt, mn
+        def fetch(t, prev):
+            return _rotate(perm, *prev)
 
-        init = (x, row_ids, v, cm,
-                lax.pcast(jnp.zeros((m_t,), jnp.int32),
+        pan0 = (x, row_ids, v, cm)
+
+        if comm_only:
+            def consume(t, acc, pan):
+                xc, idc, vc, cmc = pan
+                return (acc + xc[:1, :1] + vc[:1][None]
+                        + idc[:1][None].astype(acc.dtype)
+                        + cmc[:1][None].astype(acc.dtype))
+
+            acc0 = lax.pcast(jnp.zeros((1, 1), x.dtype),
+                             (_mesh.ROWS, _mesh.COLS), to="varying")
+            return _ov.panel_pipeline(nrows, pan0, fetch, consume, acc0,
+                                      _ov.overlapped(overlap))
+
+        def consume(t, acc, pan):
+            xc, idc, vc, cmc = pan
+            cnt, mn = pair_pass(xc, idc, vc, cmc, acc[0], acc[1])
+            return cnt, mn
+
+        acc0 = (lax.pcast(jnp.zeros((m_t,), jnp.int32),
                           (_mesh.ROWS, _mesh.COLS), to="varying"),
                 lax.pcast(jnp.full((m_t,), sentinel, v.dtype),
                           (_mesh.ROWS, _mesh.COLS), to="varying"))
-        _, _, _, _, cnt, mn = lax.fori_loop(0, nrows, step, init)
+        cnt, mn = _ov.panel_pipeline(nrows, pan0, fetch, consume, acc0,
+                                     _ov.overlapped(overlap))
         cnt, mn = cnt[:m_loc], mn[:m_loc]      # crop the tile pad
         # every rank in a mesh row computes identical results from the
         # all-gathered features; pmax makes that invariance provable so
@@ -197,9 +255,11 @@ def ring_neigh_count_min(xp, eps2, vals, colmask, sentinel, mesh):
         mn = lax.pmin(mn, _mesh.COLS)
         return cnt, mn
 
+    out_specs = P(_mesh.ROWS, _mesh.COLS) if comm_only \
+        else (P(_mesh.ROWS), P(_mesh.ROWS))
     return jax.shard_map(
         local, mesh=mesh,
         in_specs=(P(_mesh.ROWS, _mesh.COLS), P(_mesh.ROWS), P(_mesh.ROWS)),
-        out_specs=(P(_mesh.ROWS), P(_mesh.ROWS)),
+        out_specs=out_specs,
         check_vma=True,
     )(xp, vals, colmask)

@@ -7,9 +7,9 @@ panels of the contraction dimension; at each step the column of the grid
 owning the A panel broadcasts it along the mesh rows, the row owning the
 B panel broadcasts it along the mesh columns, and every device
 accumulates one local GEMM.  Peak per-device memory is the two resident
-operand blocks plus ONE (panel-width) broadcast pair plus the output
-block — the panel loop is what keeps paper-scale operands (which exist
-only sharded) from ever materialising per device.
+operand blocks plus the in-flight (panel-width) broadcast pairs plus the
+output block — the panel loop is what keeps paper-scale operands (which
+exist only sharded) from ever materialising per device.
 
 `math.matmul` routes here when the mesh is genuinely 2-D (both axes > 1)
 — the layout where an explicit panel schedule beats leaving the
@@ -18,6 +18,19 @@ all-gather/psum form, so those shapes keep the fusion-graph dot).  The
 broadcast is expressed as a masked ``lax.psum`` — the library's standard
 provably-replicated collective idiom (``check_vma`` stays ON, the
 SURVEY §6 race-detection row), one collective per panel per operand.
+
+Panel schedule (round-13 overlap PR): the loop runs through
+``ops/overlap.panel_pipeline``.  Under the default double-buffered
+schedule panel t+1's broadcast pair is issued BEFORE panel t's local
+GEMM consumes its buffers (prologue fetch, epilogue drain — still ONE
+dispatch, the pipeline lives inside this jitted ``shard_map``), so the
+latency-hiding scheduler can run the next collective under the current
+MXU work; ``overlap="seq"`` restores the strict fetch-then-multiply
+chain, and ``overlap="pallas"`` lowers the panel GEMM through
+``ops/pallas_kernels``.  All schedules consume panels in identical
+order, so ``db`` and ``seq`` are bit-equal (``tests/test_overlap``); the
+double buffer's cost is ONE extra in-flight panel pair, never a copy of
+an operand (bench overlap tier verifies via XLA memory analysis).
 
 Mixed precision: the local panel GEMMs contract via the library precision
 policy (``ops/precision.pdot``) — bf16-compute / f32-accumulate under the
@@ -37,6 +50,7 @@ from jax.sharding import PartitionSpec as P
 
 import jax
 
+from dislib_tpu.ops import overlap as _ov
 from dislib_tpu.ops import precision as px
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
@@ -51,9 +65,21 @@ def summa_supported(mesh=None) -> bool:
     return r > 1 and c > 1
 
 
-@partial(_pjit, static_argnames=("mesh", "policy"), name="summa_matmul")
+def summa_steps(mesh=None) -> int:
+    """Panel count of the SUMMA schedule on ``mesh``: lcm(rows, cols) —
+    the panel width is the largest chunk that lives whole on exactly one
+    cols-rank of A AND one rows-rank of B.  THE step-count formula of
+    :func:`summa_matmul` (the kernel calls this too), exposed so
+    per-panel consumers (the bench overlap tier's one-extra-panel memory
+    gate) stay anchored to the kernel instead of re-deriving it."""
+    r, c = _mesh.mesh_shape(mesh)
+    return r * c // math.gcd(r, c)
+
+
+@partial(_pjit, static_argnames=("mesh", "policy", "overlap", "comm_only"),
+         name="summa_matmul")
 @px.precise
-def summa_matmul(ap, bp, mesh, policy):
+def summa_matmul(ap, bp, mesh, policy, overlap="db", comm_only=False):
     """C = A @ B over canonically (rows, cols)-sharded padded operands.
 
     ``ap`` (M_pad, K_pad) and ``bp`` (K_pad, N_pad) must agree on K_pad
@@ -61,9 +87,18 @@ def summa_matmul(ap, bp, mesh, policy):
     invariant.  Returns the (M_pad, N_pad) product, float32
     (the policy's accumulation dtype), canonically sharded.
 
-    ONE dispatch end to end: the panel loop is a ``lax.fori_loop`` inside
-    this single jitted program — counter-pinned by
-    ``tests/test_precision.py`` and the bench tier's ``dispatches_per_op``.
+    ``overlap`` is the resolved panel schedule (``ops/overlap.resolve``
+    — callers resolve so the ``DSLIB_OVERLAP`` env flip retraces as a
+    static).  ``comm_only=True`` is the bench overlap tier's
+    broadcast-only variant of the SAME program: the identical panel
+    fetch loop with the GEMMs replaced by a (1, 1) touch of each panel
+    (so the collectives survive DCE) — the t_comm_alone denominator of
+    the comm-hidden fraction.
+
+    ONE dispatch end to end under every schedule: the panel loop is a
+    ``lax.fori_loop`` inside this single jitted program — counter-pinned
+    by ``tests/test_precision.py``/``tests/test_overlap.py`` and the
+    bench tier's ``dispatches_per_op``.
     """
     nrows = mesh.shape[_mesh.ROWS]
     ncols = mesh.shape[_mesh.COLS]
@@ -72,10 +107,9 @@ def summa_matmul(ap, bp, mesh, policy):
         raise ValueError(
             f"summa: padded contraction dims differ ({k_pad} vs "
             f"{bp.shape[0]}) — repad before the kernel")
-    # panel width: the largest chunk that lives whole on exactly one
-    # cols-rank of A AND one rows-rank of B (K_pad is a pad_quantum
+    # panel width: lcm(rows, cols) panels (K_pad is a pad_quantum
     # multiple, and pad_quantum = lcm(rows, cols), so this is exact)
-    steps = nrows * ncols // math.gcd(nrows, ncols)       # lcm(R, C)
+    steps = summa_steps(mesh)
     kb = k_pad // steps
 
     def local(a, b):
@@ -92,7 +126,8 @@ def summa_matmul(ap, bp, mesh, policy):
         acc_dt = jnp.promote_types(px.accum_dtype(policy),
                                    jnp.promote_types(ac.dtype, bc.dtype))
 
-        def step(t, acc):
+        def fetch(t, prev):
+            del prev                 # broadcast panels slice by step
             off = t * kb
             # broadcast the A panel from its owner cols-rank along 'cols'
             # (masked psum: non-owners contribute exact zeros); offsets
@@ -111,14 +146,31 @@ def summa_matmul(ap, bp, mesh, policy):
             b_pan = jnp.where(my_r == owner_r, b_pan,
                               jnp.zeros((), b_pan.dtype))
             b_pan = lax.psum(b_pan, _mesh.ROWS)
-            return acc + px.pdot(a_pan, b_pan, policy)
+            return a_pan, b_pan
+
+        if comm_only:
+            def consume(t, acc, pan):
+                a_pan, b_pan = pan
+                return acc + a_pan[:1, :1] + b_pan[:1, :1]
+
+            acc_shape = (1, 1)
+        else:
+            def consume(t, acc, pan):
+                a_pan, b_pan = pan
+                if overlap == "pallas":
+                    from dislib_tpu.ops import pallas_kernels as _pk
+                    return acc + _pk.panel_gemm(a_pan, b_pan, policy)
+                return acc + px.pdot(a_pan, b_pan, policy)
+
+            acc_shape = (m_loc, n_loc)
 
         # seed the accumulator as device-varying up front so the fori_loop
         # carry's replication type is stable round over round (the ring
         # kernels' check_vma idiom)
-        acc0 = lax.pcast(jnp.zeros((m_loc, n_loc), acc_dt),
+        acc0 = lax.pcast(jnp.zeros(acc_shape, acc_dt),
                          (_mesh.ROWS, _mesh.COLS), to="varying")
-        return lax.fori_loop(0, steps, step, acc0)
+        return _ov.panel_pipeline(steps, fetch(0, None), fetch, consume,
+                                  acc0, _ov.overlapped(overlap))
 
     return jax.shard_map(
         local, mesh=mesh,

@@ -36,11 +36,17 @@ def pad_to_tiles(xv, tile):
     return jnp.pad(xv, ((0, n_tiles * tile - xv.shape[0]), (0, 0))), n_tiles
 
 
-def neigh_count_min(xv, eps2, vals, colmask, sentinel, tile):
+def neigh_count_min(xv, eps2, vals, colmask, sentinel, tile,
+                    use_pallas=False):
     """Per-row (count, min) over the ε-adjacency, streamed in tiles.
 
     xv: (mp, n) with mp % tile == 0.  vals/colmask: (mp,).  Rows are NOT
-    masked here — callers mask invalid rows in their own domain."""
+    masked here — callers mask invalid rows in their own domain.
+    ``use_pallas`` routes the tile distance kernel through
+    ``ops/pallas_kernels`` (the ``DSLIB_OVERLAP=pallas`` inner-loop
+    route; a jit static for the enclosing kernel — the single-device
+    tier has no collective to overlap, so this is the only knob that
+    applies to it)."""
     mp, n = xv.shape
     nt = mp // tile
     x_tiles = xv.reshape(nt, tile, n)
@@ -55,7 +61,7 @@ def neigh_count_min(xv, eps2, vals, colmask, sentinel, tile):
         def col_body(acc, cx):
             xcol, coff, v, cm = cx
             col_ids = coff + jnp.arange(tile, dtype=jnp.int32)
-            d2 = distances_sq(xrow, xcol)
+            d2 = distances_sq(xrow, xcol, use_pallas=use_pallas)
             adj = ((d2 <= eps2) | (row_ids[:, None] == col_ids[None, :])) \
                 & cm[None, :]
             cnt = acc[0] + jnp.sum(adj, axis=1)

@@ -119,6 +119,18 @@ class _PendingSave:
             raise self._exc
 
 
+# One in-flight async write per PATH, across FitCheckpoint instances: a
+# preemption-recovery re-run builds a FRESH FitCheckpoint on the same
+# file, and its load() must not read around the PREVIOUS fit's still-
+# in-flight save — the resumed stream reads the checkpoint twice
+# (stream_state, then the loop's restore) and a write landing between
+# the two makes them disagree, re-consuming a batch (review-found flaky
+# resume).  flush() drains the registered write before any read; the
+# owning instance still re-raises its own write failure.
+_PENDING_BY_PATH: dict = {}
+_PENDING_LOCK = threading.Lock()
+
+
 class FitCheckpoint:
     """Snapshot/restore of in-flight fit state.
 
@@ -178,6 +190,8 @@ class FitCheckpoint:
                                   daemon=True)
         self._pending = pending
         self._pending_thread = worker
+        with _PENDING_LOCK:
+            _PENDING_BY_PATH[os.path.abspath(self.path)] = (worker, pending)
         worker.start()
         return pending
 
@@ -187,9 +201,19 @@ class FitCheckpoint:
         before raising `Preempted`, so the snapshot-first contract holds
         with the write off the hot path.  A no-op on the snapshot worker
         itself (its `save` re-enters here and must not wait on its own
-        completion)."""
+        completion).  Also waits out a write started by ANOTHER
+        FitCheckpoint on the same path (the re-run-on-a-fresh-instance
+        case) — without adopting its failure, which the owning instance
+        re-raises at its own next flush."""
         if self._pending_thread is threading.current_thread():
             return
+        with _PENDING_LOCK:
+            entry = _PENDING_BY_PATH.pop(os.path.abspath(self.path), None)
+        if entry is not None:
+            thread, foreign = entry
+            if thread is not threading.current_thread() \
+                    and thread is not self._pending_thread:
+                foreign._done.wait()
         pending, self._pending = self._pending, None
         self._pending_thread = None
         if pending is not None:

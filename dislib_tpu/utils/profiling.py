@@ -77,7 +77,7 @@ class _Counters:
     (asserted against the dispatch counters in ``tests/test_fitloop``)."""
 
     __slots__ = ("dispatches", "traces", "transfers", "dispatch_by",
-                 "trace_by", "resilience")
+                 "trace_by", "resilience", "schedules")
 
     def __init__(self):
         self.dispatches = 0
@@ -86,6 +86,7 @@ class _Counters:
         self.dispatch_by: dict[str, int] = {}
         self.trace_by: dict[str, int] = {}
         self.resilience: dict[str, int] = {}
+        self.schedules: dict[str, int] = {}
 
 
 _COUNTERS = _Counters()
@@ -164,6 +165,26 @@ def resilience_counters() -> dict:
         return dict(_COUNTERS.resilience)
 
 
+def count_schedule(kernel: str, schedule: str, n: int = 1) -> None:
+    """Record that ``kernel`` ran under panel ``schedule`` (round-13
+    overlap PR) — bumped host-side by the routing boundaries (SUMMA's
+    matmul entry, ``panel_rechunk``, the ring estimators' tier pickers),
+    so "which schedule did the router actually run" is a counter
+    assertion, not prose.  Keys are ``f"{kernel}:{schedule}"``."""
+    with _COUNTERS_LOCK:
+        key = f"{kernel}:{schedule}"
+        _COUNTERS.schedules[key] = _COUNTERS.schedules.get(key, 0) + n
+
+
+def schedule_counters() -> dict:
+    """``{"kernel:schedule": count}`` tallies since the last
+    ``reset_counters()`` — the overlap router's observability surface
+    (``DSLIB_OVERLAP`` routing is asserted through this in
+    ``tests/test_overlap.py`` and the bench overlap tier)."""
+    with _COUNTERS_LOCK:
+        return dict(_COUNTERS.schedules)
+
+
 def dispatch_count() -> int:
     """Total library-kernel dispatches since the last `reset_counters()`."""
     return _COUNTERS.dispatches
@@ -183,7 +204,8 @@ def counters() -> dict:
                 "transfers": _COUNTERS.transfers,
                 "dispatch_by": dict(_COUNTERS.dispatch_by),
                 "trace_by": dict(_COUNTERS.trace_by),
-                "resilience": dict(_COUNTERS.resilience)}
+                "resilience": dict(_COUNTERS.resilience),
+                "schedules": dict(_COUNTERS.schedules)}
 
 
 def reset_counters() -> None:
@@ -195,6 +217,7 @@ def reset_counters() -> None:
         _COUNTERS.dispatch_by.clear()
         _COUNTERS.trace_by.clear()
         _COUNTERS.resilience.clear()
+        _COUNTERS.schedules.clear()
 
 
 def memory_stats():

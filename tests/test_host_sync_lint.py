@@ -39,6 +39,10 @@ ESTIMATOR_DIRS = (
     # whole design; a stray per-request sync here is the regression the
     # lint exists for
     "dislib_tpu/serving",
+    # round-13: the overlap/panel kernels (summa, rechunk, ring, tiled,
+    # overlap, pallas_kernels) — a host sync inside a panel loop would
+    # serialize the very schedule the overlap PR exists to pipeline
+    "dislib_tpu/ops",
 )
 
 # (file, enclosing function) pairs allowed to host-sync inside a loop,

@@ -1,0 +1,684 @@
+"""Comm–compute overlap schedules (round-13 PR).
+
+The library-wide panel-schedule contract: the double-buffered (``db``)
+schedules of SUMMA, the panel rechunk and the ring kernels must be
+BIT-EQUAL to their sequential (``seq``) counterparts — same panels, same
+ops, same order — still exactly ONE dispatch, routed by ``DSLIB_OVERLAP``
+(observable through the schedule counters), green under
+``jax_debug_nans``, and the pipelined program must actually decouple the
+next panel's collective from the current panel's compute (compiled-HLO
+audit: in the db while body at least one all-reduce does NOT feed the
+dot; in the seq body every one does).
+"""
+
+import re
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.ops import overlap as _ov
+from dislib_tpu.ops import precision as px
+from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.utils import profiling as _prof
+
+from conftest import skip_unless_devices
+
+
+def _mk(shape, dtype=np.float32, seed=0):
+    return np.random.RandomState(seed).rand(*shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# 1. the DSLIB_OVERLAP router
+# ---------------------------------------------------------------------------
+
+class TestRouter:
+    def test_default_is_double_buffered(self, monkeypatch):
+        monkeypatch.delenv("DSLIB_OVERLAP", raising=False)
+        assert _ov.resolve() == "db"
+
+    @pytest.mark.parametrize("raw,want", [
+        ("db", "db"), ("auto", "db"), ("1", "db"), ("on", "db"),
+        ("seq", "seq"), ("0", "seq"), ("off", "seq"),
+        ("sequential", "seq"),
+    ])
+    def test_aliases(self, raw, want):
+        assert _ov.resolve(raw) == want
+
+    def test_env_routes_the_default(self, monkeypatch):
+        monkeypatch.setenv("DSLIB_OVERLAP", "seq")
+        assert _ov.resolve() == "seq"
+        monkeypatch.setenv("DSLIB_OVERLAP", "pallas")
+        assert _ov.resolve() in ("pallas", "db")   # db iff pallas missing
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError, match="unknown overlap schedule"):
+            _ov.resolve("bogus")
+        with pytest.raises(ValueError):
+            _ov.overlapped("bogus")
+
+    def test_overlapped_predicate(self):
+        assert _ov.overlapped("db") and _ov.overlapped("pallas")
+        assert not _ov.overlapped("seq")
+
+    def test_pallas_degrades_to_db_when_unavailable(self, monkeypatch):
+        from dislib_tpu.ops import pallas_kernels as _pk
+        monkeypatch.setattr(_pk, "_AVAILABLE", False)
+        monkeypatch.setattr(_ov, "_PALLAS_WARNED", False)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert _ov.resolve("pallas") == "db"
+        assert any("falling back" in str(x.message) for x in w), \
+            "the pallas→db degrade must warn (sequential stays explicit)"
+
+    def test_public_observability_entry(self, monkeypatch):
+        monkeypatch.delenv("DSLIB_OVERLAP", raising=False)
+        assert ds.overlap_schedule() == "db"
+
+
+# ---------------------------------------------------------------------------
+# 2. the shared pipeline helper: same folds, either order
+# ---------------------------------------------------------------------------
+
+class TestPanelPipeline:
+    @pytest.mark.parametrize("steps", [1, 2, 3, 5])
+    def test_bit_equal_and_order_preserving(self, steps):
+        vals = jnp.asarray(np.random.RandomState(3).rand(8, 4)
+                           .astype(np.float32))
+
+        def fetch(t, prev):
+            return vals[t]
+
+        def consume(t, acc, pan):
+            # non-commutative fold: order changes the bits, so equality
+            # proves the schedules consume panels identically.  add THEN
+            # scale — a mul+add chain could legally FMA-contract
+            # differently in the two compiled programs (the fusion
+            # layer's documented ±1-ulp divergence), which would test
+            # XLA, not the pipeline
+            return (acc + pan) * (1.0 + (t + 1) * 0.001)
+
+        acc0 = jnp.zeros((4,), jnp.float32)
+        seq = _ov.panel_pipeline(steps, vals[0], fetch, consume, acc0, False)
+        db = _ov.panel_pipeline(steps, vals[0], fetch, consume, acc0, True)
+        np.testing.assert_array_equal(np.asarray(seq), np.asarray(db))
+        # oracle: explicit in-order fold
+        acc = acc0
+        for t in range(steps):
+            acc = consume(t, acc, vals[t])
+        np.testing.assert_array_equal(np.asarray(seq), np.asarray(acc))
+
+    def test_zero_steps_is_identity(self):
+        acc0 = jnp.ones((2,))
+        for ov in (False, True):
+            out = _ov.panel_pipeline(0, None, None, None, acc0, ov)
+            assert out is acc0
+
+
+# ---------------------------------------------------------------------------
+# 3. schedule-equivalence grid: SUMMA
+# ---------------------------------------------------------------------------
+
+class TestSummaSchedules:
+    @pytest.mark.parametrize("grid", [(4, 2), (2, 4)])
+    @pytest.mark.parametrize("policy", ["float32", "bfloat16"])
+    def test_db_bit_equals_seq(self, grid, policy):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.summa import summa_matmul
+        ds.init(grid)
+        mesh = _mesh.get_mesh()
+        a = ds.array(_mk((96, 64))).force()
+        b = ds.array(_mk((64, 80), seed=1)).force()
+        pol = px.resolve(policy)
+        db = np.asarray(summa_matmul(a._data, b._data, mesh, pol,
+                                     overlap="db"))
+        seq = np.asarray(summa_matmul(a._data, b._data, mesh, pol,
+                                      overlap="seq"))
+        np.testing.assert_array_equal(db, seq)
+        # absolute correctness vs the host oracle
+        oracle = _mk((96, 64)) @ _mk((64, 80), seed=1)
+        tol = 2e-2 if policy == "bfloat16" else 1e-5
+        np.testing.assert_allclose(db[:96, :80], oracle, rtol=tol,
+                                   atol=tol * np.abs(oracle).max())
+
+    def test_f64_x64_mode(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.summa import summa_matmul
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        with jax.enable_x64(True):
+            x = _mk((32, 32)).astype(np.float64)
+            ad = jax.device_put(
+                np.pad(x, ((0, 0), (0, 0))), _mesh.data_sharding())
+            db = np.asarray(summa_matmul(ad, ad, mesh, px.FLOAT32,
+                                         overlap="db"))
+            seq = np.asarray(summa_matmul(ad, ad, mesh, px.FLOAT32,
+                                          overlap="seq"))
+            assert db.dtype == np.float64   # f32 floor passes f64 through
+            np.testing.assert_array_equal(db, seq)
+            np.testing.assert_allclose(db, x @ x, rtol=1e-12)
+
+    def test_one_dispatch_per_schedule(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.summa import summa_matmul
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        a = ds.array(_mk((96, 64))).force()
+        b = ds.array(_mk((64, 80), seed=1)).force()
+        for ov in ("db", "seq"):
+            summa_matmul(a._data, b._data, mesh, px.FLOAT32, overlap=ov)
+            _prof.reset_counters()
+            summa_matmul(a._data, b._data, mesh, px.FLOAT32, overlap=ov)
+            assert _prof.dispatch_count() == 1, \
+                f"summa overlap={ov} is not one dispatch"
+
+    def test_env_routes_matmul_and_counts_schedule(self, monkeypatch):
+        skip_unless_devices(8)
+        ds.init((4, 2))
+        a = ds.array(_mk((96, 64))).force()
+        b = ds.array(_mk((64, 80), seed=1)).force()
+        monkeypatch.setenv("DSLIB_OVERLAP", "seq")
+        _prof.reset_counters()
+        ds.matmul(a, b, algorithm="summa").force()
+        assert _prof.schedule_counters().get("summa_matmul:seq") == 1
+        monkeypatch.delenv("DSLIB_OVERLAP", raising=False)
+        _prof.reset_counters()
+        ds.matmul(a, b, algorithm="summa").force()
+        assert _prof.schedule_counters().get("summa_matmul:db") == 1
+
+    def test_db_green_under_debug_nans(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.summa import summa_matmul
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        a = ds.array(_mk((32, 32))).force()
+        jax.config.update("jax_debug_nans", True)
+        try:
+            out = summa_matmul(a._data, a._data, mesh, px.FLOAT32,
+                               overlap="db")
+            np.asarray(out)
+        finally:
+            jax.config.update("jax_debug_nans", False)
+
+
+# ---------------------------------------------------------------------------
+# 4. compiled-HLO overlap audit: the collective/compute dependence shape
+# ---------------------------------------------------------------------------
+
+def _while_body_def_use(hlo):
+    """(def→operands map, all-reduce names, dot names) of the compiled
+    while BODY computation that carries the panel loop (the one holding
+    both an all-reduce and a dot)."""
+    for m in re.finditer(r"body=%([\w\.\-]+)", hlo):
+        name = m.group(1)
+        start = hlo.index("%" + name + " ")
+        block = hlo[start:hlo.index("\n}", start) + 2]
+        if "all-reduce(" not in block or " dot(" not in block:
+            continue
+        defs, ars, dots = {}, [], []
+        for line in block.splitlines():
+            mm = re.match(r"\s*%([\w\.\-]+) = .*?\b([\w\-]+)\(", line)
+            if not mm:
+                continue
+            dst, op = mm.group(1), mm.group(2)
+            rhs = line.split("=", 1)[1]
+            defs[dst] = [t for t in re.findall(r"%([\w\.\-]+)", rhs)
+                         if t != dst]
+            if op == "all-reduce":
+                ars.append(dst)
+            elif op == "dot":
+                dots.append(dst)
+        return defs, ars, dots
+    raise AssertionError("no while body with all-reduce + dot in the HLO")
+
+
+def _transitive_inputs(defs, roots):
+    seen, stack = set(), list(roots)
+    while stack:
+        cur = stack.pop()
+        for op in defs.get(cur, ()):
+            if op not in seen:
+                seen.add(op)
+                stack.append(op)
+    return seen
+
+
+class TestCompiledOverlapAudit:
+    """The tentpole's scheduling claim, verified on the compiled program:
+    in the double-buffered body the prefetched panel's collectives feed
+    the CARRY, not the dot — the dot and at least one all-reduce are
+    data-independent, so the latency-hiding scheduler may overlap them.
+    The sequential body is the contrast: every all-reduce feeds the dot
+    (one strict chain), proving the audit is not vacuous."""
+
+    def _hlo(self, overlap):
+        from dislib_tpu.ops.summa import summa_matmul
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        a = ds.array(_mk((96, 64))).force()
+        b = ds.array(_mk((64, 80), seed=1)).force()
+        return summa_matmul.lower(a._data, b._data, mesh, px.FLOAT32,
+                                  overlap=overlap).compile().as_text()
+
+    def test_db_decouples_collective_from_dot(self):
+        skip_unless_devices(8)
+        defs, ars, dots = _while_body_def_use(self._hlo("db"))
+        assert ars and dots
+        feeding = _transitive_inputs(defs, dots)
+        free = [ar for ar in ars if ar not in feeding]
+        assert free, (
+            "double-buffered SUMMA body serialized every collective into "
+            "the dot's chain — the pipeline structure did not survive "
+            f"compilation (all-reduces: {ars})")
+
+    def test_seq_is_a_strict_chain(self):
+        skip_unless_devices(8)
+        defs, ars, dots = _while_body_def_use(self._hlo("seq"))
+        assert ars and dots
+        feeding = _transitive_inputs(defs, dots)
+        stray = [ar for ar in ars if ar not in feeding]
+        assert not stray, (
+            "sequential SUMMA body has a collective outside the dot "
+            "chain — the seq baseline no longer is the strict-phase "
+            f"schedule (stray: {stray})")
+
+
+# ---------------------------------------------------------------------------
+# 5. schedule-equivalence grid: panel rechunk
+# ---------------------------------------------------------------------------
+
+class TestRechunkSchedules:
+    @pytest.mark.parametrize("dtype", [np.float32, np.int32])
+    def test_db_bit_equals_seq(self, dtype):
+        skip_unless_devices(8)
+        from dislib_tpu.ops import rechunk as _rc
+        ds.init((4, 2))
+        x = (_mk((40, 12)) * 100).astype(dtype)
+        a = ds.array(x).force()
+        ds.init((2, 4))
+        dst = _mesh.get_mesh()
+        db = np.asarray(_rc.panel_rechunk(a._data, a.shape, dst, 4,
+                                          overlap="db"))
+        seq = np.asarray(_rc.panel_rechunk(a._data, a.shape, dst, 4,
+                                           overlap="seq"))
+        np.testing.assert_array_equal(db, seq)
+        np.testing.assert_array_equal(db[:40, :12], x)
+
+    def test_f64_x64_mode(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops import rechunk as _rc
+        with jax.enable_x64(True):
+            ds.init((4, 2))
+            x = _mk((24, 8)).astype(np.float64)
+            a = ds.array(x, dtype=np.float64).force()
+            ds.init((2, 4))
+            dst = _mesh.get_mesh()
+            db = np.asarray(_rc.panel_rechunk(a._data, a.shape, dst, 2,
+                                              overlap="db"))
+            seq = np.asarray(_rc.panel_rechunk(a._data, a.shape, dst, 2,
+                                               overlap="seq"))
+            assert db.dtype == np.float64
+            np.testing.assert_array_equal(db, seq)
+
+    def test_one_dispatch_and_schedule_counter(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops import rechunk as _rc
+        ds.init((4, 2))
+        a = ds.array(_mk((40, 12))).force()
+        ds.init((2, 4))
+        dst = _mesh.get_mesh()
+        _rc.panel_rechunk(a._data, a.shape, dst, 4, overlap="db")  # warm
+        _prof.reset_counters()
+        _rc.panel_rechunk(a._data, a.shape, dst, 4, overlap="db")
+        assert _prof.dispatch_count() == 1
+        assert _prof.schedule_counters().get("rechunk_panels:db") == 1
+
+    def test_db_poisoned_pad_rezeroes(self):
+        """Poisoned-pad regression for the NEW schedule: the
+        double-buffered exchange rebuilds pads from a zero canvas."""
+        skip_unless_devices(8)
+        ds.init((4, 2))
+        x = _mk((20, 6), seed=7)
+        a = ds.array(x).force()
+        bad = a._data.at[20:, :].set(jnp.nan).at[:, 6:].set(jnp.inf)
+        from dislib_tpu.data.array import Array
+        a_bad = Array(bad, (20, 6))
+        ds.init((2, 4))
+        out = ds.rechunk(a_bad, schedule="panels", overlap="db")
+        full = np.asarray(out._data)
+        np.testing.assert_array_equal(full[:20, :6], x)
+        assert np.all(full[20:] == 0) and np.all(full[:, 6:] == 0)
+
+    def test_memory_analysis_reports_db_budget(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops import rechunk as _rc
+        ds.init((4, 2))
+        a = ds.array(_mk((64, 16))).force()
+        ds.init((2, 4))
+        dst = _mesh.get_mesh()
+        ma_db = _rc.panel_memory_analysis(a._data, a.shape, dst, 4,
+                                          overlap="db")
+        ma_seq = _rc.panel_memory_analysis(a._data, a.shape, dst, 4,
+                                           overlap="seq")
+        assert ma_db["overlap"] == "db" and ma_seq["overlap"] == "seq"
+        # the documented analytic budget: exactly one extra in-flight
+        # panel for the double buffer
+        panel = ma_db["in_bytes"] // ma_db["panels"]
+        assert ma_db["analytic_temp_bytes"] \
+            == ma_seq["analytic_temp_bytes"] + panel
+        if ma_db["peak_live_ratio"] is not None:
+            k = 4
+            assert ma_db["peak_live_ratio"] <= min(1 + 2 / k, 1.5), \
+                "double-buffered peak-live exceeds the documented bound"
+
+
+# ---------------------------------------------------------------------------
+# 6. schedule-equivalence grid: ring kernels + estimators
+# ---------------------------------------------------------------------------
+
+class TestRingSchedules:
+    def test_kneighbors_db_bit_equals_seq(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.ring import ring_kneighbors
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        q = ds.array(_mk((37, 5))).force()
+        f = ds.array(_mk((53, 5), seed=1)).force()
+        d_db, i_db = ring_kneighbors(q._data, f._data, mesh, 5, 53,
+                                     overlap="db")
+        d_seq, i_seq = ring_kneighbors(q._data, f._data, mesh, 5, 53,
+                                       overlap="seq")
+        np.testing.assert_array_equal(np.asarray(d_db), np.asarray(d_seq))
+        np.testing.assert_array_equal(np.asarray(i_db), np.asarray(i_seq))
+
+    def test_neigh_count_min_db_bit_equals_seq(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.ring import ring_neigh_count_min
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        a = ds.array(_mk((48, 5))).force()
+        mp = a._data.shape[0]
+        ids = jnp.arange(mp, dtype=jnp.int32)
+        valid = ids < 48
+        outs = {}
+        for ov in ("db", "seq"):
+            c, mn = ring_neigh_count_min(a._data, jnp.float32(0.3), ids,
+                                         valid, jnp.int32(mp), mesh,
+                                         overlap=ov)
+            outs[ov] = (np.asarray(c), np.asarray(mn))
+        np.testing.assert_array_equal(outs["db"][0], outs["seq"][0])
+        np.testing.assert_array_equal(outs["db"][1], outs["seq"][1])
+
+    def test_kneighbors_estimator_one_dispatch_and_env_routing(
+            self, monkeypatch):
+        skip_unless_devices(8)
+        ds.init((4, 2))
+        f = ds.array(_mk((64, 4))).force()
+        q = ds.array(_mk((16, 4), seed=2)).force()
+        nn = ds.NearestNeighbors(n_neighbors=3, ring=True).fit(f)
+        nn.kneighbors(q)                                     # warm
+        _prof.reset_counters()
+        nn.kneighbors(q)
+        assert _prof.counters()["dispatch_by"].get("ring_kneighbors") == 1
+        assert _prof.schedule_counters().get("ring_kneighbors:db") == 1
+        monkeypatch.setenv("DSLIB_OVERLAP", "seq")
+        _prof.reset_counters()
+        d_seq, i_seq = nn.kneighbors(q)
+        assert _prof.schedule_counters().get("ring_kneighbors:seq") == 1
+        monkeypatch.delenv("DSLIB_OVERLAP", raising=False)
+        d_db, i_db = nn.kneighbors(q)
+        np.testing.assert_array_equal(np.asarray(i_db.collect()),
+                                      np.asarray(i_seq.collect()))
+        np.testing.assert_array_equal(np.asarray(d_db.collect()),
+                                      np.asarray(d_seq.collect()))
+
+    def test_ring_dbscan_schedules_agree(self, monkeypatch):
+        skip_unless_devices(8)
+        from dislib_tpu.cluster import dbscan as dbmod
+        ds.init((4, 2))
+        monkeypatch.setattr(dbmod, "_RING", True)
+        x = np.vstack([_mk((40, 4)), _mk((40, 4), seed=1) + 3.0]) \
+            .astype(np.float32)
+        labels = {}
+        for ov in ("db", "seq"):
+            monkeypatch.setenv("DSLIB_OVERLAP", ov)
+            _prof.reset_counters()
+            model = ds.DBSCAN(eps=0.8, min_samples=3).fit(ds.array(x))
+            assert any(k == f"ring_neigh:{ov}"
+                       for k in _prof.schedule_counters()), \
+                f"dbscan ring tier did not record schedule {ov}"
+            labels[ov] = model.labels_.copy()
+        np.testing.assert_array_equal(labels["db"], labels["seq"])
+
+    def test_ring_daura_schedules_agree(self, monkeypatch):
+        skip_unless_devices(8)
+        from dislib_tpu.cluster import daura as damod
+        ds.init((4, 2))
+        monkeypatch.setattr(damod, "_RING", True)
+        x = _mk((60, 6), seed=5)
+        labels = {}
+        for ov in ("db", "seq"):
+            monkeypatch.setenv("DSLIB_OVERLAP", ov)
+            model = ds.Daura(cutoff=0.45).fit(ds.array(x))
+            labels[ov] = model.labels_.copy()
+        np.testing.assert_array_equal(labels["db"], labels["seq"])
+
+    def test_db_poisoned_fit_pad_rows_stay_masked(self):
+        """Poisoned-pad regression for the db ring schedule: garbage in
+        the fitted backing's pad rows must never become a neighbor
+        (the ids >= m_fit mask, preserved by the pipelined fold)."""
+        skip_unless_devices(8)
+        from dislib_tpu.ops.ring import ring_kneighbors
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        q = ds.array(_mk((16, 4))).force()
+        f = ds.array(_mk((20, 4), seed=1)).force()
+        clean = ring_kneighbors(q._data, f._data, mesh, 3, 20, overlap="db")
+        # pad rows moved to the query cloud's center: unmasked, they
+        # would beat most real rows into the top-k
+        poisoned = f._data.at[20:, :].set(0.5)
+        got = ring_kneighbors(q._data, poisoned, mesh, 3, 20, overlap="db")
+        np.testing.assert_array_equal(np.asarray(clean[1]),
+                                      np.asarray(got[1]))
+        np.testing.assert_array_equal(np.asarray(clean[0]),
+                                      np.asarray(got[0]))
+
+    def test_db_green_under_debug_nans(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.ring import ring_neigh_count_min
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        a = ds.array(_mk((24, 4))).force()
+        mp = a._data.shape[0]
+        ids = jnp.arange(mp, dtype=jnp.int32)
+        jax.config.update("jax_debug_nans", True)
+        try:
+            c, _ = ring_neigh_count_min(a._data, jnp.float32(0.3), ids,
+                                        ids < 24, jnp.int32(mp), mesh,
+                                        overlap="db")
+            np.asarray(c)
+        finally:
+            jax.config.update("jax_debug_nans", False)
+
+
+# ---------------------------------------------------------------------------
+# 7. the Pallas fallback route
+# ---------------------------------------------------------------------------
+
+class TestPallasRoute:
+    def test_kernels_available_on_this_rig(self):
+        from dislib_tpu.ops import pallas_kernels as _pk
+        assert _pk.available(), \
+            "pallas interpret mode should run on the CPU rig"
+
+    def test_panel_gemm_matches_pdot(self):
+        from dislib_tpu.ops import pallas_kernels as _pk
+        a = jnp.asarray(_mk((48, 32)))
+        b = jnp.asarray(_mk((32, 40), seed=1))
+        got = np.asarray(_pk.panel_gemm(a, b, px.FLOAT32))
+        want = np.asarray(px.pdot(a, b, px.FLOAT32))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+        assert got.dtype == want.dtype
+
+    def test_distances_matches_xla_formulation(self):
+        from dislib_tpu.ops import pallas_kernels as _pk
+        from dislib_tpu.ops.base import distances_sq
+        a = jnp.asarray(_mk((24, 6)))
+        b = jnp.asarray(_mk((20, 6), seed=1))
+        got = np.asarray(_pk.distances_sq(a, b))
+        want = np.asarray(distances_sq(np.asarray(a), np.asarray(b)))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        assert (got >= 0).all()
+
+    def test_summa_pallas_schedule_matches(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.summa import summa_matmul
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        a = ds.array(_mk((96, 64))).force()
+        b = ds.array(_mk((64, 80), seed=1)).force()
+        db = np.asarray(summa_matmul(a._data, b._data, mesh, px.FLOAT32,
+                                     overlap="db"))
+        pl = np.asarray(summa_matmul(a._data, b._data, mesh, px.FLOAT32,
+                                     overlap="pallas"))
+        np.testing.assert_allclose(pl, db, rtol=1e-6,
+                                   atol=1e-6 * np.abs(db).max())
+
+    def test_ring_pallas_schedule_matches(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.ring import ring_neigh_count_min
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        a = ds.array(_mk((48, 5))).force()
+        mp = a._data.shape[0]
+        ids = jnp.arange(mp, dtype=jnp.int32)
+        valid = ids < 48
+        c_db, m_db = ring_neigh_count_min(a._data, jnp.float32(0.3), ids,
+                                          valid, jnp.int32(mp), mesh,
+                                          overlap="db")
+        c_pl, m_pl = ring_neigh_count_min(a._data, jnp.float32(0.3), ids,
+                                          valid, jnp.int32(mp), mesh,
+                                          overlap="pallas")
+        np.testing.assert_array_equal(np.asarray(c_db), np.asarray(c_pl))
+        np.testing.assert_array_equal(np.asarray(m_db), np.asarray(m_pl))
+
+    def test_distances_threads_explicit_precision(self):
+        """Regression: the pallas branch of ``ops/base.distances_sq`` must
+        pass the caller's explicit MXU precision to the cross GEMM, not
+        silently drop it (review-found)."""
+        from dislib_tpu.ops.base import distances_sq
+        a = jnp.asarray(_mk((24, 6)))
+        b = jnp.asarray(_mk((20, 6), seed=1))
+        got = np.asarray(distances_sq(a, b, precision="highest",
+                                      use_pallas=True))
+        want = np.asarray(distances_sq(a, b, precision="highest"))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("route", ["db", "pallas"])
+    def test_tiled_dbscan_routes_and_matches(self, monkeypatch, route):
+        """The single-device tiled tier has no collective to overlap, but
+        ``DSLIB_OVERLAP=pallas`` must still pick the Pallas inner kernel
+        (observable via the ``tiled_neigh`` schedule counter) and cluster
+        identically (review-found: the knob used to be a silent no-op
+        here)."""
+        from dislib_tpu.cluster import dbscan as dbmod
+        from dislib_tpu.ops import tiled as _tiled
+        if route == "pallas" and _ov.resolve("pallas") != "pallas":
+            pytest.skip("pallas unavailable on this backend")
+        monkeypatch.setattr(dbmod, "_RING", False)
+        monkeypatch.setattr(dbmod, "_DENSE_MAX", 0)
+        monkeypatch.setattr(_tiled, "TILE", 64)
+        x = np.vstack([_mk((25, 4)), _mk((25, 4), seed=1) + 3.0]) \
+            .astype(np.float32)
+        monkeypatch.setenv("DSLIB_OVERLAP", route)
+        _prof.reset_counters()
+        model = ds.DBSCAN(eps=0.8, min_samples=3).fit(ds.array(x))
+        assert _prof.schedule_counters().get(f"tiled_neigh:{route}"), \
+            f"dbscan tiled tier did not record schedule {route}"
+        oracle = ds.DBSCAN(eps=0.8, min_samples=3)
+        monkeypatch.setenv("DSLIB_OVERLAP", "seq")
+        oracle.fit(ds.array(x))
+        np.testing.assert_array_equal(model.labels_, oracle.labels_)
+
+    def test_tiled_daura_routes_pallas(self, monkeypatch):
+        from dislib_tpu.cluster import daura as damod
+        from dislib_tpu.ops import tiled as _tiled
+        if _ov.resolve("pallas") != "pallas":
+            pytest.skip("pallas unavailable on this backend")
+        monkeypatch.setattr(damod, "_RING", False)
+        monkeypatch.setattr(damod, "_DENSE_MAX", 0)
+        monkeypatch.setattr(_tiled, "TILE", 64)
+        x = _mk((40, 6), seed=5)
+        monkeypatch.setenv("DSLIB_OVERLAP", "pallas")
+        _prof.reset_counters()
+        model = ds.Daura(cutoff=0.45).fit(ds.array(x))
+        assert _prof.schedule_counters().get("tiled_neigh:pallas"), \
+            "daura tiled tier did not record the pallas schedule"
+        oracle = ds.Daura(cutoff=0.45)
+        monkeypatch.setenv("DSLIB_OVERLAP", "db")
+        oracle.fit(ds.array(x))
+        np.testing.assert_array_equal(model.labels_, oracle.labels_)
+
+
+# ---------------------------------------------------------------------------
+# 8. the DSLIB_SUMMA_MIN_DIM router knob
+# ---------------------------------------------------------------------------
+
+class TestSummaMinDimKnob:
+    def test_env_knob_routes_small_dims_to_summa(self, monkeypatch):
+        skip_unless_devices(8)
+        ds.init((4, 2))
+        a = ds.array(_mk((64, 64))).force()
+        b = ds.array(_mk((64, 64), seed=1)).force()
+        # default gate (256): a 64-dim CONCRETE product stays on the
+        # fusion-graph XLA path
+        monkeypatch.delenv("DSLIB_SUMMA_MIN_DIM", raising=False)
+        out = ds.matmul(a, b)
+        assert out.is_lazy, "small concrete product left the fusion graph"
+        # knob lowered: the same product auto-routes to SUMMA
+        monkeypatch.setenv("DSLIB_SUMMA_MIN_DIM", "16")
+        _prof.reset_counters()
+        out = ds.matmul(a, b)
+        assert not out.is_lazy
+        assert _prof.counters()["dispatch_by"].get("summa_matmul") == 1
+        assert any(k.startswith("summa_matmul:")
+                   for k in _prof.schedule_counters())
+
+    def test_env_knob_respected_by_module_default(self, monkeypatch):
+        from dislib_tpu.math import base as mb
+        monkeypatch.delenv("DSLIB_SUMMA_MIN_DIM", raising=False)
+        assert mb._summa_min_dim() == mb._SUMMA_MIN_DIM
+        monkeypatch.setenv("DSLIB_SUMMA_MIN_DIM", "512")
+        assert mb._summa_min_dim() == 512
+
+
+# ---------------------------------------------------------------------------
+# 9. comm-only probes: same collectives, no compute (bench denominator)
+# ---------------------------------------------------------------------------
+
+class TestCommOnlyProbes:
+    def test_probes_run_and_shape(self):
+        skip_unless_devices(8)
+        from dislib_tpu.ops.summa import summa_matmul
+        from dislib_tpu.ops.ring import ring_kneighbors
+        from dislib_tpu.ops import rechunk as _rc
+        ds.init((4, 2))
+        mesh = _mesh.get_mesh()
+        a = ds.array(_mk((96, 64))).force()
+        b = ds.array(_mk((64, 80), seed=1)).force()
+        out = summa_matmul(a._data, b._data, mesh, px.FLOAT32,
+                           overlap="seq", comm_only=True)
+        assert out.shape == (4, 2) and np.isfinite(np.asarray(out)).all()
+        f = ds.array(_mk((40, 8), seed=2)).force()
+        q = ds.array(_mk((16, 8), seed=3)).force()
+        out = ring_kneighbors(q._data, f._data, mesh, 3, 40,
+                              overlap="seq", comm_only=True)
+        assert out.shape == (4, 2)
+        ds.init((2, 4))
+        dst = _mesh.get_mesh()
+        probe = _rc.panel_comm_probe(a._data, a.shape, dst, 4)
+        assert np.isfinite(np.asarray(probe)).all()

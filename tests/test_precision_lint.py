@@ -103,6 +103,19 @@ def test_policy_module_is_the_one_scope_site():
     assert hits, "ops/precision.py no longer hosts the matmul scope"
 
 
+def test_overlap_kernel_files_are_in_the_scanned_set():
+    """Round-13 pin: the overlap-schedule kernels (incl. the Pallas
+    fallback, which spells its own dot) must stay inside this lint's
+    scanned set — a refactor that moves them out would let a new kernel
+    hardcode compute dtypes unnoticed."""
+    scanned = {rel for rel, _ in _kernel_files()}
+    for f in ("dislib_tpu/ops/overlap.py", "dislib_tpu/ops/summa.py",
+              "dislib_tpu/ops/rechunk.py", "dislib_tpu/ops/ring.py",
+              "dislib_tpu/ops/tiled.py",
+              "dislib_tpu/ops/pallas_kernels.py"):
+        assert f in scanned, f"{f} escaped the precision lint"
+
+
 def test_public_entries_expose_precision_kwarg():
     """The paper-scale surface must actually accept the policy: matmul,
     qr, polar, svd, tsqr, random_svd, lanczos_svd take ``precision=``

@@ -35,6 +35,10 @@ SECTIONS = [
     ("Precision policy (mixed-precision linalg)", "dislib_tpu.ops.precision",
      ["Policy", "resolve", "to_compute", "f32", "pdot", "peinsum",
       "precise"]),
+    ("Overlap schedules (comm–compute pipelining)", "dislib_tpu.ops.overlap",
+     ["resolve", "overlapped", "panel_pipeline"]),
+    ("Pallas fallback kernels", "dislib_tpu.ops.pallas_kernels",
+     ["available", "panel_gemm", "distances_sq"]),
     ("Decomposition", "dislib_tpu", ["PCA"]),
     ("Clustering", "dislib_tpu.cluster",
      ["KMeans", "MiniBatchKMeans", "GaussianMixture", "DBSCAN", "Daura"]),
@@ -82,7 +86,8 @@ SECTIONS = [
     ("Profiling", "dislib_tpu.utils.profiling",
      ["trace", "annotate", "op_graph", "profiled_jit", "dispatch_count",
       "trace_count", "transfer_count", "counters", "reset_counters",
-      "count_resilience", "resilience_counters"]),
+      "count_resilience", "resilience_counters",
+      "count_schedule", "schedule_counters"]),
     ("Distributed (multi-host)", "dislib_tpu.parallel.distributed",
      ["initialize", "is_initialized", "process_info", "shutdown"]),
 ]
