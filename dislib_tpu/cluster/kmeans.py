@@ -141,11 +141,15 @@ class KMeans(BaseEstimator):
         sparse_in = isinstance(x, SparseArray)
         box = {"x": x, "inertia": None}
         log = verbose_logger("kmeans", self.verbose)
+        # data_rebind handles BOTH backings since round 14: dense arrays
+        # re-canonicalize, sparse arrays reshard their panel buffers on
+        # device — the elastic mesh-shrink tier no longer degrades for
+        # sparse fits
         loop = _fitloop.ChunkedFitLoop(
             "kmeans", checkpoint=checkpoint, health=health,
             max_iter=self.max_iter, carry_names=("centers",),
             carry_shapes=((self.n_clusters, x.shape[1]),),
-            elastic=None if sparse_in else _fitloop.data_rebind(box))
+            elastic=_fitloop.data_rebind(box))
 
         def init(rem):
             box["inertia"] = None
@@ -170,7 +174,7 @@ class KMeans(BaseEstimator):
         def step(st, chunk):
             (centers,) = st.carries
             if sparse_in:
-                data, lrows, cols, rowsq = x.sharded_rows()
+                data, lrows, cols, rowsq = box["x"].sharded_rows()
                 centers, n_done, inertia, shift, hist, hvec = \
                     _kmeans_fit_sparse_sharded(
                         data, lrows, cols, rowsq, centers, x.shape[0], chunk,

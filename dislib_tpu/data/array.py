@@ -1118,7 +1118,7 @@ def _eye_op(pshape, shape, dtype):
 
 
 def rechunk(x: Array, new_blocks=None, mesh=None, *, schedule="auto",
-            panels=None, overlap=None) -> Array:
+            panels=None, overlap=None, nse=None) -> Array:
     """Reshard a ds-array to a new block-size hint and/or mesh layout —
     ON DEVICE, via a collective schedule, never a host materialization
     (round-11 rechunk PR; arXiv:2112.01075 discipline).
@@ -1151,15 +1151,36 @@ def rechunk(x: Array, new_blocks=None, mesh=None, *, schedule="auto",
       (round-13 overlap PR — see the user guide's "Overlap &
       scheduling").
 
+    - ``nse`` (sparse inputs only): target per-shard stored-entry pad —
+      the sparse nse-quantum knob (``None`` keeps the minimum quantum
+      multiple covering the densest target shard).
+
+    SPARSE inputs (:class:`~dislib_tpu.data.sparse.SparseArray`) route
+    through the SAME schedule names over the row-panel-sharded buffers
+    (round-14 sparse PR): ``"xla"`` = fused nse re-pad on the same
+    device grid, ``"panels"`` = one masked-psum panel exchange for a
+    mesh-layout change, ``"deviceput"`` = gather + runtime copy for a
+    device-set change — never the host, never a densification.
+
     The result re-satisfies the pad-and-mask invariant by construction:
     pad slices are exactly zero after the reshard, whatever the input
     tail carried."""
     from dislib_tpu.ops import rechunk as _rc
+    from dislib_tpu.data.sparse import SparseArray
+    if isinstance(x, SparseArray):
+        if panels is not None:
+            raise ValueError(
+                "panels= applies to the DENSE panel exchange only — the "
+                "sparse exchange broadcasts one panel per source "
+                "row-rank (fixed); nse= is the sparse memory knob")
+        out = x.resharded(mesh, schedule=schedule, nse=nse, overlap=overlap)
+        if new_blocks is not None:
+            out._reg_shape = _check_block_size(x._shape, new_blocks)
+        return out
     if not isinstance(x, Array):
         raise TypeError(
-            f"ds.rechunk needs a dense ds-array, got {type(x).__name__} "
-            "(SparseArray backings reshard with their estimator's "
-            "sharded_rows ingest)")
+            f"ds.rechunk needs a ds-array or SparseArray, "
+            f"got {type(x).__name__}")
     reg = _check_block_size(x._shape, new_blocks) if new_blocks is not None \
         else x._reg_shape
     target = mesh if mesh is not None else _mesh.get_mesh()

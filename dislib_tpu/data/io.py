@@ -193,7 +193,8 @@ def _emit_quarantine(source, rows, bad_values, n_clean, bad_labels=None):
     from dislib_tpu.utils.profiling import count_resilience
     count_resilience("quarantined_rows", report.n_quarantined)
     warnings.warn(
-        f"{source}: quarantined {report.n_quarantined} non-finite row(s) "
+        f"{source}: quarantined {report.n_quarantined} bad row(s) "
+        "(non-finite values/labels, or out-of-range feature indices) "
         f"(indices {rows[:8].tolist()}{'...' if len(rows) > 8 else ''}) — "
         "see last_quarantine_report() / the returned array's .quarantine_; "
         "pass quarantine=False (or DSLIB_QUARANTINE=0) to load them raw. "
@@ -481,6 +482,18 @@ def _parse_svmlight_text(lines):
     return rows, labels, max_feat
 
 
+def _require_in_range(csr, source):
+    """A raw (quarantine-off) load may still not ship out-of-range
+    indices to device — they would alias wrong columns or crash the
+    dense scatter.  Raise the typed ingest error instead."""
+    if csr.nnz and (int(csr.indices.min(initial=0)) < 0
+                    or int(csr.indices.max(initial=0)) >= csr.shape[1]):
+        raise ValueError(
+            f"{source}: feature indices outside n_features={csr.shape[1]} "
+            "— raise n_features, or enable quarantine to isolate the "
+            "offending rows")
+
+
 def _svmlight_dense(rows, m_feats):
     dense = np.zeros((len(rows), m_feats), dtype=np.float32)
     for i, feats in enumerate(rows):
@@ -534,25 +547,54 @@ def _load_svmlight_sharded(path, block_size, n_features):
 
 def _quarantine_csr(csr, labels, source, opt):
     """CSR-path quarantine: a row is bad when any stored value — or its
-    label — is non-finite.  Returns (clean_csr, clean_labels, report)."""
+    label — is non-finite, OR any stored column index falls outside the
+    declared shape (a truncating ``n_features=`` or a corrupt stream
+    batch: out-of-range entries would otherwise crash the dense scatter
+    or silently alias a wrong column on device).  Returns
+    (clean_csr, clean_labels, report)."""
     import jax
     if not _quarantine_enabled(opt) or jax.process_count() > 1 \
             or csr.shape[0] == 0:
         return csr, labels, None
     bad_rows = np.zeros(csr.shape[0], bool)
-    bad_vals = np.nonzero(~np.isfinite(csr.data))[0]
-    if bad_vals.size:
+    bad_ent = np.nonzero(~np.isfinite(csr.data)
+                         | (csr.indices < 0)
+                         | (csr.indices >= csr.shape[1]))[0]
+    if bad_ent.size:
         # entry i lives in the row whose indptr window contains i
-        bad_rows[np.searchsorted(csr.indptr, bad_vals, side="right") - 1] = \
+        bad_rows[np.searchsorted(csr.indptr, bad_ent, side="right") - 1] = \
             True
     bad_rows |= ~np.isfinite(np.asarray(labels, np.float64))
     if not bad_rows.any():
         return csr, labels, None
     rows = np.nonzero(bad_rows)[0]
-    clean = csr[~bad_rows]
-    report = _emit_quarantine(source, rows, csr[bad_rows], clean.shape[0],
+    # row selection by raw indptr surgery, NOT csr[mask]: scipy's indexed
+    # slicing validates/clones through code paths that may choke on the
+    # very out-of-range indices being quarantined
+    clean = _csr_take_rows(csr, ~bad_rows)
+    bad = _csr_take_rows(csr, bad_rows, clip=True)
+    report = _emit_quarantine(source, rows, bad, clean.shape[0],
                               bad_labels=labels[bad_rows])
     return clean, labels[~bad_rows], report
+
+
+def _csr_take_rows(csr, mask, clip=False):
+    """Row subset of a CSR by direct indptr/indices surgery (no scipy
+    fancy indexing — see `_quarantine_csr`).  ``clip`` clamps column
+    indices into range so the OFFENDING-rows matrix is still a valid
+    scipy object for offline triage."""
+    import scipy.sparse as sp
+    keep = np.nonzero(mask)[0]
+    lens = np.diff(csr.indptr)[keep]
+    indptr = np.concatenate([[0], np.cumsum(lens)])
+    sel = np.concatenate([np.arange(csr.indptr[r], csr.indptr[r + 1])
+                          for r in keep]) if keep.size else \
+        np.zeros(0, np.int64)
+    indices = csr.indices[sel]
+    if clip:
+        indices = np.clip(indices, 0, csr.shape[1] - 1)
+    return sp.csr_matrix((csr.data[sel], indices, indptr),
+                         shape=(keep.size, csr.shape[1]))
 
 
 @_retrying_loader
@@ -582,6 +624,7 @@ def load_svmlight_file(path, block_size=None, n_features=None,
         csr = sp.csr_matrix((data, indices, indptr), shape=(n, m))
         csr, labels_a, report = _quarantine_csr(csr, labels_a, path,
                                                 quarantine)
+        _require_in_range(csr, path)
         if store_sparse:
             from dislib_tpu.data.sparse import SparseArray
             x = SparseArray.from_scipy(csr, block_size=block_size)
@@ -592,22 +635,34 @@ def load_svmlight_file(path, block_size=None, n_features=None,
         y = _ds_array(labels_a.reshape(-1, 1),
                       block_size=(block_size[0], 1) if block_size else None)
         return x, y
+    import scipy.sparse as sp
     with open(path) as f:
         rows, labels, max_feat = _parse_svmlight_text(f)
     m = n_features if n_features is not None else max_feat
-    dense = _svmlight_dense(rows, m)
-    dense, labels, report = _quarantine_rows(
-        dense, path, quarantine,
-        labels=np.asarray(labels, dtype=np.float32))
+    # build the CSR at the DECLARED width first — a truncating
+    # n_features= leaves out-of-range entries visible for the quarantine
+    # to isolate per row (the same hygiene the values get)
+    indptr = np.zeros(len(rows) + 1, np.int64)
+    idx_l, dat_l = [], []
+    for i, feats in enumerate(rows):
+        idx_l.extend(k - 1 for k in feats)      # svmlight is 1-indexed
+        dat_l.extend(feats.values())
+        indptr[i + 1] = len(idx_l)
+    csr = sp.csr_matrix((np.asarray(dat_l, np.float32),
+                         np.asarray(idx_l, np.int64), indptr),
+                        shape=(len(rows), m))
+    labels_a = np.asarray(labels, np.float32)
+    csr, labels_a, report = _quarantine_csr(csr, labels_a, path, quarantine)
+    _require_in_range(csr, path)
     if store_sparse:
-        import scipy.sparse as sp
         from dislib_tpu.data.sparse import SparseArray
-        x = SparseArray.from_scipy(sp.csr_matrix(dense), block_size=block_size)
+        x = SparseArray.from_scipy(csr, block_size=block_size)
     else:
-        x = _ds_array(dense, block_size=block_size)
+        x = _ds_array(csr.toarray().astype(np.float32),
+                      block_size=block_size)
     x.quarantine_ = report
-    y = _ds_array(np.asarray(labels, dtype=np.float32).reshape(-1, 1),
-                   block_size=(block_size[0], 1) if block_size else None)
+    y = _ds_array(labels_a.reshape(-1, 1),
+                  block_size=(block_size[0], 1) if block_size else None)
     return x, y
 
 

@@ -36,6 +36,10 @@ TPU-native design and its honest limits:
 
 from __future__ import annotations
 
+import math
+import os
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -44,19 +48,299 @@ from jax.experimental import sparse as jsparse
 from dislib_tpu.data.array import Array
 from dislib_tpu.ops.base import precise
 from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.utils.profiling import count_transfer as _count_transfer
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
-__all__ = ["SparseArray"]
+__all__ = ["SparseArray", "ShardedSparse", "nse_quantum"]
+
+
+def nse_quantum() -> int:
+    """Per-shard nse (stored-entry) pad quantum: every shard's
+    rectangular buffers are padded to a multiple of this, so two sparse
+    arrays with similar per-shard fill share compiled kernel shapes (the
+    dense pad-quantum discipline applied to the nse axis).
+    ``DSLIB_SPARSE_NSE_QUANTUM`` overrides; default 64."""
+    return max(1, int(os.environ.get("DSLIB_SPARSE_NSE_QUANTUM", "64")))
+
+
+def densify_budget_bytes() -> int:
+    """The byte budget above which densifying a SparseArray raises
+    instead of silently OOMing a chip (``DSLIB_SPARSE_DENSIFY_BUDGET``,
+    default 4 GiB) — consulted by the lazy dense escape hatch AND the
+    ``math.matmul`` spmm/densify router."""
+    return int(os.environ.get("DSLIB_SPARSE_DENSIFY_BUDGET", 4 << 30))
+
+
+class ShardedSparse:
+    """Row-panel-sharded sparse storage: the device-resident layout every
+    sparse fast path (SpMM, sharded ALS, sharded KMeans, the ring tiers)
+    consumes, and the unit the sparse ``ds.rechunk`` schedules move.
+
+    Device buffers, each ``NamedSharding(mesh, P('rows'))``-sharded over
+    the mesh row axis (``p`` = row-rank count):
+
+    - ``data``  (p, nse) — entry values (float32, or float64 under x64);
+    - ``lrows`` (p, nse) — shard-LOCAL row ids (global row − s·m_local);
+    - ``cols``  (p, nse) — column ids;
+    - ``counts_dev`` (p,) — per-shard live-entry count (the in-kernel
+      slot-validity mask: ``iota < count`` — pads stay non-load-bearing
+      even when poisoned).
+
+    Layout invariants (what the rechunk schedules preserve/rebuild):
+
+    - **canonical row split**: ``m_local = padded_rows(m) / p`` — the SAME
+      row partition as a canonically sharded dense array, so SpMM's output
+      block boundaries line up with the dense (rows, cols) sharding;
+    - **row-sorted, tail-padded**: live entries are sorted by global row
+      and occupy slots ``[0, counts[s])``; the global entry stream is the
+      shard-major concatenation of the live slots (this is what makes
+      relayout pure static addressing — arXiv:2112.01075's portable
+      redistribution needs only offset tables);
+    - **uniform nse pad** (``nse`` a :func:`nse_quantum` multiple, equal
+      on every shard): pad entries are (value 0, row 0, column 0 — the
+      sentinel column), so they are additive no-ops under every
+      segment-sum even before the slot mask re-zeroes them — the
+      poisoned-pad discipline.
+
+    Host metadata (control plane only — never a device transfer):
+    ``counts`` (tuple of per-shard ints), ``row_nnz`` (int64 (m,) per-row
+    entry histogram, layout-independent: relayout target shapes are
+    computed from it on host, so no device sync ever decides a shape).
+    """
+
+    __slots__ = ("data", "lrows", "cols", "_counts_dev", "counts",
+                 "row_nnz", "shape", "mesh", "m_local", "nse", "_rowsq")
+
+    def __init__(self, data, lrows, cols, counts_dev, counts, row_nnz,
+                 shape, mesh):
+        self.data = data
+        self.lrows = lrows
+        self.cols = cols
+        self._counts_dev = counts_dev
+        self.counts = tuple(int(c) for c in counts)
+        self.row_nnz = row_nnz
+        self.shape = (int(shape[0]), int(shape[1]))
+        self.mesh = mesh
+        self.m_local = _padded_rows(shape[0], mesh) // int(data.shape[0])
+        self.nse = int(data.shape[1])
+        self._rowsq = None
+
+    @property
+    def counts_dev(self):
+        """Device (p,) per-shard live counts (the kernels' slot-mask
+        operand), materialised LAZILY as a jit-embedded constant from
+        the host metadata — a reshard-produced representation acquires
+        it without a host→device transfer (transfer-guard clean)."""
+        if self._counts_dev is None:
+            self._counts_dev = _counts_kernel(self.counts, self.mesh)
+        return self._counts_dev
+
+    @property
+    def p(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def nnz(self) -> int:
+        return int(sum(self.counts))
+
+    def __repr__(self):
+        return (f"ShardedSparse(shape={self.shape}, p={self.p}, "
+                f"nse={self.nse}, nnz={self.nnz})")
+
+    @classmethod
+    def build(cls, rows, cols, vals, shape, mesh=None, nse=None):
+        """Bucket host (row, col, val) triplets into the sharded layout
+        (ingest: the one host-side construction path; on-device arrays
+        move between layouts via the sparse rechunk schedules)."""
+        mesh = mesh or _mesh.get_mesh()
+        p = mesh.shape[_mesh.ROWS]
+        m, n = (int(s) for s in shape)
+        m_local = _padded_rows(m, mesh) // p
+        rows = np.asarray(rows, np.int64)
+        cols = np.asarray(cols, np.int64)
+        vals = np.asarray(vals)
+        if rows.size and (rows.min() < 0 or rows.max() >= m
+                          or cols.min() < 0 or cols.max() >= n):
+            raise ValueError(
+                f"sparse indices out of range for shape {(m, n)} — "
+                "quarantine the offending rows at ingest "
+                "(load_svmlight_file / SparseArray.from_scipy do)")
+        order = np.argsort(rows, kind="stable")
+        rows, cols, vals = rows[order], cols[order], vals[order]
+        row_nnz = np.bincount(rows, minlength=m).astype(np.int64)
+        shard = rows // m_local
+        counts = np.bincount(shard, minlength=p).astype(np.int64)
+        nse_eff = _round_nse(int(counts.max(initial=0)), nse)
+        data = np.zeros((p, nse_eff), vals.dtype if vals.dtype == np.float64
+                        else np.float32)
+        lr = np.zeros((p, nse_eff), np.int32)
+        cc = np.zeros((p, nse_eff), np.int32)
+        start = np.concatenate([[0], np.cumsum(counts)])
+        slot = np.arange(rows.size) - start[shard]
+        data[shard, slot] = vals
+        lr[shard, slot] = rows - shard * m_local
+        cc[shard, slot] = cols
+        return cls._place(data, lr, cc, counts, row_nnz, (m, n), mesh)
+
+    @classmethod
+    def _place(cls, data, lr, cc, counts, row_nnz, shape, mesh):
+        sh1 = jax.sharding.NamedSharding(mesh,
+                                         jax.sharding.PartitionSpec(_mesh.ROWS))
+        return cls(jax.device_put(jnp.asarray(data), sh1),
+                   jax.device_put(jnp.asarray(lr), sh1),
+                   jax.device_put(jnp.asarray(cc), sh1),
+                   jax.device_put(jnp.asarray(np.asarray(counts, np.int32)),
+                                  sh1),
+                   counts, row_nnz, shape, mesh)
+
+    def rowsq(self):
+        """Device (p, m_local) per-row ‖x_i‖² — the KMeans/kNN distance
+        term, derived ON DEVICE from the buffers (one jitted kernel,
+        cached), so a rechunk-produced representation never touches the
+        host to serve it."""
+        if self._rowsq is None:
+            self._rowsq = _rowsq_kernel(self.data, self.lrows,
+                                        self.counts_dev, self.mesh,
+                                        self.m_local)
+        return self._rowsq
+
+    def host_triplets(self):
+        """(rows, cols, vals) global host triplets — the collect path
+        (counts ONE host transfer via the blessed counter)."""
+        _count_transfer()
+        d = np.asarray(jax.device_get(self.data))
+        lr = np.asarray(jax.device_get(self.lrows))
+        cc = np.asarray(jax.device_get(self.cols))
+        rows_l, cols_l, vals_l = [], [], []
+        for s, k in enumerate(self.counts):
+            rows_l.append(lr[s, :k].astype(np.int64) + s * self.m_local)
+            cols_l.append(cc[s, :k].astype(np.int64))
+            vals_l.append(d[s, :k])
+        cat = (np.concatenate(x) if x else np.zeros(0)
+               for x in (rows_l, cols_l, vals_l))
+        return tuple(cat)
+
+
+def _padded_rows(m, mesh):
+    from dislib_tpu.data.array import _padded_shape
+    return _padded_shape((m, 1), _mesh.pad_quantum(mesh))[0]
+
+
+def _round_nse(nse_min, explicit=None):
+    q = nse_quantum()
+    need = max(int(nse_min), 1)
+    if explicit is not None:
+        if int(explicit) < need:
+            raise ValueError(
+                f"requested nse {explicit} < the densest shard's "
+                f"{need} live entries")
+        need = int(explicit)
+    return int(math.ceil(need / q) * q)
+
+
+@partial(_pjit, static_argnames=("counts", "mesh"), name="sparse_counts")
+def _counts_kernel(counts, mesh):
+    tab = jnp.asarray(np.asarray(counts, np.int32))
+    return jax.lax.with_sharding_constraint(
+        tab, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(_mesh.ROWS)))
+
+
+@partial(_pjit, static_argnames=("mesh", "m_local"), name="sparse_rowsq")
+def _rowsq_kernel(data, lrows, counts, mesh, m_local):
+    from jax.sharding import PartitionSpec as P
+
+    def local(d_s, lr_s, cnt_s):
+        d, lr, cnt = d_s[0], lr_s[0], cnt_s[0]
+        ok = jax.lax.broadcasted_iota(jnp.int32, d.shape, 0) < cnt
+        v = jnp.where(ok, d, jnp.zeros((), d.dtype))
+        return jax.ops.segment_sum(v * v, lr,
+                                   num_segments=m_local)[None, :]
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(_mesh.ROWS), P(_mesh.ROWS), P(_mesh.ROWS)),
+        out_specs=P(_mesh.ROWS),
+        check_vma=True,
+    )(data, lrows, counts)
 
 
 class SparseArray:
-    """A 2-D sparse matrix on device, BCOO-backed (the CSR-block role)."""
+    """A 2-D sparse matrix on device (the CSR-block role).
 
-    def __init__(self, bcoo: jsparse.BCOO, reg_shape=None):
-        self._bcoo = bcoo
-        self._shape = (int(bcoo.shape[0]), int(bcoo.shape[1]))
+    Two backings, one API: a single-device BCOO (ingest / host staging),
+    and/or the row-panel-sharded :class:`ShardedSparse` buffers (the fast
+    path — SpMM, sharded fits, serving, the sparse ``ds.rechunk``
+    schedules).  A sharded-only array (the product of an on-device
+    rechunk) materialises its BCOO lazily, on host, ONLY when a legacy
+    path asks for it — the fast paths never do."""
+
+    def __init__(self, bcoo: jsparse.BCOO | None = None, reg_shape=None,
+                 *, sharded: "ShardedSparse | None" = None):
+        if (bcoo is None) == (sharded is None):
+            if bcoo is None:
+                raise ValueError("SparseArray needs a BCOO or a "
+                                 "ShardedSparse backing")
+        self._bcoo_val = bcoo
+        self._sharded_rep = sharded
+        src = bcoo if bcoo is not None else sharded
+        self._shape = (int(src.shape[0]), int(src.shape[1]))
         self._reg_shape = reg_shape or self._shape
         self._sparse = True
         self._dense_cache = None
+
+    @property
+    def _bcoo(self) -> jsparse.BCOO:
+        """The single-device BCOO view, built from the sharded buffers on
+        first touch for sharded-only arrays (a host materialisation — the
+        blessed legacy escape hatch, counted as a transfer)."""
+        if self._bcoo_val is None:
+            rows, cols, vals = self._sharded_rep.host_triplets()
+            idx = np.stack([rows, cols], axis=1).astype(np.int32)
+            self._bcoo_val = jsparse.BCOO(
+                (jnp.asarray(vals), jnp.asarray(idx)), shape=self._shape)
+        return self._bcoo_val
+
+    # -- sharded representation (the fast-path backing) ----------------------
+
+    def sharded(self, mesh=None) -> "ShardedSparse":
+        """The :class:`ShardedSparse` buffers for ``mesh`` (default: the
+        library mesh) — the sparse analog of ``ensure_canonical``.  A
+        matching backing returns as-is; a backing laid out for ANOTHER
+        mesh re-lands ON DEVICE through the sparse rechunk schedules
+        (never the host, never dense); a BCOO-only array buckets its host
+        triplets once (ingest) and caches the result."""
+        mesh = mesh or _mesh.get_mesh()
+        rep = self._sharded_rep
+        if rep is not None:
+            if rep.mesh is mesh:
+                return rep
+            from dislib_tpu.ops import rechunk as _rc
+            rep = _rc.reshard_sparse(rep, mesh)
+            self._sharded_rep = rep
+            return rep
+        idx = np.asarray(jax.device_get(self._bcoo.indices))
+        val = np.asarray(jax.device_get(self._bcoo.data))
+        rep = ShardedSparse.build(idx[:, 0], idx[:, 1], val, self._shape,
+                                  mesh)
+        self._sharded_rep = rep
+        return rep
+
+    def resharded(self, mesh=None, *, schedule="auto", nse=None,
+                  overlap=None) -> "SparseArray":
+        """A NEW SparseArray whose sharded backing is laid out for
+        ``mesh`` / ``nse`` — the ``ds.rechunk`` sparse entry.  On-device
+        for an already-sharded source (fused nse re-pad / masked-psum
+        panel exchange / deviceput, per the schedule router)."""
+        from dislib_tpu.ops import rechunk as _rc
+        mesh = mesh or _mesh.get_mesh()
+        src = self._sharded_rep
+        if src is None:
+            src = self.sharded(mesh if schedule in ("auto", "xla")
+                               else _mesh.get_mesh())
+        rep = _rc.reshard_sparse(src, mesh, schedule=schedule, nse=nse,
+                                 overlap=overlap)
+        return SparseArray(sharded=rep, reg_shape=self._reg_shape)
 
     @property
     def _data(self):
@@ -68,14 +352,12 @@ class SparseArray:
         ``DSLIB_SPARSE_DENSIFY_BUDGET`` byte budget (default 4 GiB) raises
         instead of silently OOMing a chip — raise the env var to opt out."""
         if self._dense_cache is None:
-            import os
             from dislib_tpu.data.array import _padded_shape
             # the dense backing is PADDED to the mesh quantum — budget on
             # the real allocation, not the logical shape
             pm, pn = _padded_shape(self._shape, _mesh.pad_quantum())
             need = 4 * pm * pn                                  # f32 bytes
-            budget = int(os.environ.get("DSLIB_SPARSE_DENSIFY_BUDGET",
-                                        4 << 30))
+            budget = densify_budget_bytes()
             if need > budget:
                 raise MemoryError(
                     f"densifying this {self._shape} SparseArray needs "
@@ -92,16 +374,44 @@ class SparseArray:
     # -- construction --------------------------------------------------------
 
     @classmethod
-    def from_scipy(cls, mat, block_size=None) -> "SparseArray":
+    def from_scipy(cls, mat, block_size=None, dtype=None,
+                   quarantine=False, labels=None) -> "SparseArray":
+        """Build from a scipy sparse matrix.
+
+        ``dtype`` — entry dtype (default float32; float64 passes through
+        on x64 rigs for the full-precision grid).  ``quarantine=True``
+        routes the rows through the ingest hygiene (non-finite stored
+        values quarantined per row, reported to the process
+        :class:`~dislib_tpu.data.io.QuarantineLedger` with a label-aligned
+        ``keep_mask``) — the row-batch sparse STREAM entry: a
+        ``partial_fit`` producer building one SparseArray per batch gets
+        the same hygiene as the dense loaders.  Returns the array (its
+        ``.quarantine_`` carries the report); pass ``labels`` to get
+        ``(array, clean_labels)`` back, kept row-aligned."""
+        report = None
+        if quarantine:
+            from dislib_tpu.data.io import _quarantine_csr
+            mat = mat.tocsr()
+            y = np.zeros(mat.shape[0], np.float32) if labels is None \
+                else np.asarray(labels)
+            mat, y, report = _quarantine_csr(mat, y, "SparseArray.from_scipy",
+                                             True)
+            labels = None if labels is None else y
         coo = mat.tocoo()
-        data = jnp.asarray(coo.data.astype(np.float32))
+        dt = np.float64 if (dtype is not None
+                            and np.dtype(dtype) == np.float64) else np.float32
+        data = jnp.asarray(coo.data.astype(dt))
         idx = jnp.asarray(np.stack([coo.row, coo.col], axis=1).astype(np.int32))
         bcoo = jsparse.BCOO((data, idx), shape=mat.shape)
-        return cls(bcoo, reg_shape=block_size)
+        out = cls(bcoo, reg_shape=block_size)
+        out.quarantine_ = report
+        return out if labels is None else (out, labels)
 
     @classmethod
-    def from_dense(cls, x, block_size=None) -> "SparseArray":
-        x = np.asarray(x, dtype=np.float32)
+    def from_dense(cls, x, block_size=None, dtype=None) -> "SparseArray":
+        dt = np.float64 if (dtype is not None
+                            and np.dtype(dtype) == np.float64) else np.float32
+        x = np.asarray(x, dtype=dt)
         return cls(jsparse.BCOO.fromdense(jnp.asarray(x)), reg_shape=block_size)
 
     # -- metadata ------------------------------------------------------------
@@ -116,6 +426,8 @@ class SparseArray:
 
     @property
     def nnz(self) -> int:
+        if self._bcoo_val is None:      # sharded-only: exact host metadata
+            return self._sharded_rep.nnz
         return int(self._bcoo.nse)
 
     @property
@@ -136,7 +448,18 @@ class SparseArray:
         return sp.csr_matrix((data, (idx[:, 0], idx[:, 1])), shape=self._shape)
 
     def to_dense(self) -> Array:
-        """Densify onto the mesh (the reference's `.toarray()` escape hatch)."""
+        """Densify onto the mesh (the reference's `.toarray()` escape
+        hatch).  A sharded-backed array densifies ON DEVICE (one jitted
+        scatter onto the canonical zero canvas — the matmul router's
+        ``algorithm="densify"`` path never detours through the host)."""
+        if self._sharded_rep is not None:
+            from dislib_tpu.data.array import _padded_shape
+            rep = self._sharded_rep
+            pshape = _padded_shape(self._shape, _mesh.pad_quantum(rep.mesh))
+            out = _densify_kernel(rep.data, rep.lrows, rep.cols,
+                                  rep.counts_dev, pshape, rep.m_local,
+                                  rep.mesh)
+            return Array(out, self._shape, reg_shape=self._reg_shape)
         return Array._from_logical(self._bcoo.todense())
 
     def _csr(self):
@@ -174,15 +497,14 @@ class SparseArray:
         return self.transpose()
 
     def __matmul__(self, other):
-        """sparse @ dense → dense Array (one bcoo_dot_general, MXU-lowered)."""
-        if isinstance(other, Array):
-            rhs = other._data[: other.shape[0], : other.shape[1]]
-        else:
-            rhs = jnp.asarray(np.asarray(other, dtype=np.float32))
-        if self._shape[1] != rhs.shape[0]:
-            raise ValueError(f"matmul shape mismatch {self._shape} @ {rhs.shape}")
-        out = _spmm(self._bcoo, rhs)
-        return Array._from_logical(out)
+        """sparse @ dense → dense Array, through the ``math.matmul``
+        spmm/densify router (the sharded masked-psum SpMM when density is
+        low, one densified GEMM when it is not)."""
+        from dislib_tpu.math import matmul as _matmul
+        if not isinstance(other, Array):
+            other = Array._from_logical(
+                jnp.asarray(np.asarray(other, dtype=np.float32)))
+        return _matmul(self, other)
 
     def sum(self, axis=0) -> Array:
         if axis not in (0, 1, None):
@@ -272,40 +594,13 @@ class SparseArray:
 
     def sharded_rows(self, mesh=None):
         """(data, local_rows, cols, rowsq) rectangular per-shard buffers,
-        leading axis = shard over the mesh 'rows' axis; padding entries are
-        (v=0, row=0, col=0) so they contribute nothing.  Cached per mesh
-        OBJECT (not shard count): a re-initialised mesh with the same p but
-        a different device order would otherwise be handed buffers
-        device_put with the stale mesh's NamedSharding."""
-        mesh = mesh or _mesh.get_mesh()
-        p = mesh.shape[_mesh.ROWS]
-        cached = getattr(self, "_sharded_cache", None)
-        if cached is not None and cached[0] is mesh:
-            return cached[1]
-        m = self._shape[0]
-        m_local = -(-m // p)
-        idx = np.asarray(jax.device_get(self._bcoo.indices))
-        val = np.asarray(jax.device_get(self._bcoo.data))
-        shard = idx[:, 0] // m_local
-        counts = np.bincount(shard, minlength=p)
-        nnz_max = max(1, int(counts.max()))
-        data = np.zeros((p, nnz_max), np.float32)
-        lrows = np.zeros((p, nnz_max), np.int32)
-        cols = np.zeros((p, nnz_max), np.int32)
-        for s in range(p):
-            sel = shard == s
-            k = int(counts[s])
-            data[s, :k] = val[sel]
-            lrows[s, :k] = idx[sel, 0] - s * m_local
-            cols[s, :k] = idx[sel, 1]
-        rowsq = np.zeros((p, m_local), np.float32)
-        np.add.at(rowsq, (shard, idx[:, 0] - shard * m_local), val * val)
-        sh = jax.sharding.NamedSharding(mesh,
-                                        jax.sharding.PartitionSpec(_mesh.ROWS))
-        out = tuple(jax.device_put(jnp.asarray(a), sh)
-                    for a in (data, lrows, cols, rowsq))
-        self._sharded_cache = (mesh, out)
-        return out
+        leading axis = shard over the mesh 'rows' axis; padding entries
+        are (v=0, row=0, col=0) so they contribute nothing.  A view over
+        :meth:`sharded` (the :class:`ShardedSparse` backing), kept for
+        the kernels that predate it (sharded KMeans, the kNN ring
+        tier)."""
+        rep = self.sharded(mesh)
+        return (rep.data, rep.lrows, rep.cols, rep.rowsq())
 
 
     def ell(self, budget=None):
@@ -407,3 +702,23 @@ class SparseArray:
 def _spmm(bcoo, rhs):
     return jsparse.bcoo_dot_general(
         bcoo, rhs, dimension_numbers=(([1], [0]), ([], [])))
+
+
+@partial(_pjit, static_argnames=("pshape", "m_local", "mesh"),
+         name="sparse_densify")
+@precise
+def _densify_kernel(data, lrows, cols, counts, pshape, m_local, mesh):
+    """Sharded buffers → canonical dense padded canvas, ON DEVICE: one
+    masked scatter-add onto zeros (the ``algorithm="densify"`` route and
+    ``to_dense`` for sharded-backed arrays).  The slot mask keeps
+    poisoned pads out; the canvas starts zero, so the pad-and-mask
+    invariant holds by construction."""
+    p, nse = data.shape
+    slot_ok = jax.lax.broadcasted_iota(jnp.int32, (p, nse), 1) \
+        < counts[:, None]
+    v = jnp.where(slot_ok, data, jnp.zeros((), data.dtype))
+    grow = lrows + (jax.lax.broadcasted_iota(jnp.int32, (p, nse), 0)
+                    * m_local)
+    out = jnp.zeros(pshape, data.dtype)
+    out = out.at[grow.ravel(), cols.ravel()].add(v.ravel())
+    return jax.lax.with_sharding_constraint(out, _mesh.data_sharding(mesh))

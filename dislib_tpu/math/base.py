@@ -126,7 +126,20 @@ def matmul(a: Array, b: Array, transpose_a: bool = False,
     ``precision``: the mixed-precision policy (None → the
     ``DSLIB_MATMUL_PRECISION`` default) — ``"bfloat16"`` contracts
     bf16-compute / f32-accumulate with the documented error bounds
-    (``ops/precision.ERROR_BOUNDS``); the default is float32-faithful."""
+    (``ops/precision.ERROR_BOUNDS``); the default is float32-faithful.
+
+    SPARSE lhs (:class:`~dislib_tpu.data.sparse.SparseArray`): a second
+    router — ``algorithm="auto"|"spmm"|"densify"`` — keyed on density ×
+    the densify budget.  ``"spmm"`` runs the sharded masked-psum SpMM
+    (``ops/spmm``, O(nnz) memory, one dispatch, overlap-scheduled);
+    ``"densify"`` materialises the dense operand on device (budget-
+    guarded) and takes the dense path; ``"auto"`` picks spmm at or below
+    ``DSLIB_SPMM_MAX_DENSITY`` (default 0.1) or whenever densifying
+    would blow ``DSLIB_SPARSE_DENSIFY_BUDGET``, densify otherwise."""
+    from dislib_tpu.data.sparse import SparseArray
+    if isinstance(a, SparseArray) or isinstance(b, SparseArray):
+        return _matmul_sparse(a, b, transpose_a, transpose_b, algorithm,
+                              precision)
     policy = px.resolve(precision)
     a_shape = (a.shape[1], a.shape[0]) if transpose_a else a.shape
     b_shape = (b.shape[1], b.shape[0]) if transpose_b else b.shape
@@ -159,6 +172,62 @@ def matmul(a: Array, b: Array, transpose_a: bool = False,
     out = _matmul_kernel(ad, bd, transpose_a, transpose_b, a_shape, b_shape,
                          policy)
     return Array(_crop_or_keep(out, out_shape), out_shape, reg, False)
+
+
+def _spmm_max_density() -> float:
+    """The density at which auto stops preferring SpMM over one dense
+    GEMM: SpMM's arithmetic is ~nnz · panel-count scatter work vs the
+    MXU-shaped m·n dense contraction, so the crossover sits around
+    1/steps — 0.1 covers the common mesh row counts.
+    ``DSLIB_SPMM_MAX_DENSITY`` overrides at runtime."""
+    return float(os.environ.get("DSLIB_SPMM_MAX_DENSITY", "0.1"))
+
+
+def _pick_sparse_algorithm(a, algorithm):
+    """The sparse matmul routing rule: explicit ``algorithm=`` wins;
+    auto keys on density × the densify budget — spmm at/below the
+    density threshold, densify above it UNLESS the dense materialisation
+    would blow the byte budget (then spmm regardless: O(nnz) always
+    fits where the data itself fits)."""
+    from dislib_tpu.data.array import _padded_shape
+    from dislib_tpu.data.sparse import densify_budget_bytes
+    if algorithm not in ("auto", "spmm", "densify"):
+        raise ValueError(
+            f"unknown sparse matmul algorithm {algorithm!r}: expected "
+            "'auto', 'spmm' or 'densify'")
+    if algorithm != "auto":
+        return algorithm
+    m, n = a.shape
+    density = a.nnz / max(m * n, 1)
+    if density <= _spmm_max_density():
+        return "spmm"
+    pm, pn = _padded_shape(a.shape, _mesh.pad_quantum())
+    return "spmm" if 4 * pm * pn > densify_budget_bytes() else "densify"
+
+
+def _matmul_sparse(a, b, transpose_a, transpose_b, algorithm, precision):
+    """The sparse fast-path entry: SparseArray @ dense ds-array via the
+    spmm/densify router.  Transposed and sparse-rhs/sparse-sparse forms
+    have no sharded schedule — they densify EXPLICITLY (never silently:
+    a typed error names the escape hatch)."""
+    from dislib_tpu.data.array import Array
+    from dislib_tpu.data.sparse import SparseArray
+    from dislib_tpu.ops.spmm import spmm as _spmm_entry
+    if isinstance(b, SparseArray) or not isinstance(a, SparseArray) \
+            or transpose_a or transpose_b:
+        raise TypeError(
+            "the sparse matmul fast path covers sparse @ dense with no "
+            "transposes — transpose via SparseArray.T (sparse, O(nnz)) "
+            "or densify explicitly with .to_dense() for other forms")
+    if not isinstance(b, Array):
+        raise TypeError(f"matmul rhs must be a dense ds-array, "
+                        f"got {type(b).__name__}")
+    if a.shape[1] != b.shape[0]:
+        raise ValueError(f"matmul shape mismatch: {a.shape} @ {b.shape}")
+    algo = _pick_sparse_algorithm(a, algorithm)
+    if algo == "spmm":
+        return _spmm_entry(a, b, precision=precision)
+    return matmul(a.to_dense(), b, precision=precision)
 
 
 def _matmul_summa(a, b, transpose_a, transpose_b, policy, out_shape, reg):

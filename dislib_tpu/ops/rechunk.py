@@ -62,6 +62,7 @@ from dislib_tpu.utils.profiling import profiled_jit as _pjit
 __all__ = [
     "requantize_body", "repad_axis", "panel_rechunk", "deviceput_rechunk",
     "reshard", "panel_memory_analysis", "panel_comm_probe",
+    "reshard_sparse", "pick_sparse_schedule",
 ]
 
 SCHEDULES = ("auto", "xla", "panels", "deviceput")
@@ -458,3 +459,266 @@ def reshard(data, logical_shape, dst_mesh, schedule="auto", panels=None,
     out = _requantize_op(data, tuple(int(s) for s in logical_shape),
                          out_pshape, dst_mesh)
     return out, sched
+
+
+# ---------------------------------------------------------------------------
+# sparse rechunk: the same three-schedule router over the row-panel-sharded
+# sparse representation (round-14 sparse PR).  Block size / nse quantum /
+# mesh shape are deployment details for sparse arrays too: the schedules
+# move the ShardedSparse buffers between layouts ON DEVICE — never the
+# host, never a densification.
+#
+# What makes sparse relayout cheap here is the representation's
+# row-sorted / tail-padded invariant (data/sparse.py): the live entries
+# form ONE global stream ordered by row, so any target layout is pure
+# STATIC addressing — per-shard stream offsets computed on host from the
+# layout-independent `row_nnz` histogram (control plane), with the data
+# plane moved by masked-psum panel broadcasts (the summa idiom) or one
+# gather.  arXiv:2112.01075's portable-redistribution shape, applied to
+# a sparse payload.
+# ---------------------------------------------------------------------------
+
+
+def _sparse_layout(rep, dst_mesh, nse=None):
+    """Host-side target-layout plan: per-dest-shard stream offsets and
+    counts from the row histogram, the uniform target nse, and the
+    source stream offsets from the source counts.  All O(device-count)
+    host metadata — no device sync ever decides a shape."""
+    from dislib_tpu.data.sparse import _padded_rows, _round_nse
+    m = rep.shape[0]
+    p2 = dst_mesh.shape[_mesh.ROWS]
+    m_local2 = _padded_rows(m, dst_mesh) // p2
+    cum = np.concatenate([[0], np.cumsum(rep.row_nnz)])
+    e0_dst = tuple(int(cum[min(s * m_local2, m)]) for s in range(p2 + 1))
+    cnt_dst = tuple(e0_dst[s + 1] - e0_dst[s] for s in range(p2))
+    e0_src = tuple(int(v) for v in
+                   np.concatenate([[0], np.cumsum(rep.counts)]))
+    nse2 = _round_nse(max(cnt_dst, default=0), nse)
+    return dict(e0_src=e0_src, e0_dst=e0_dst, cnt_dst=cnt_dst,
+                nse2=nse2, m_local2=m_local2, p2=p2)
+
+
+def pick_sparse_schedule(rep, dst_mesh, schedule="auto") -> str:
+    """The sparse rechunk routing rule (the dense ``pick_schedule``
+    pattern, same env override): same-device-grid moves take the fused
+    nse requantize ("xla"), a relayout whose target devices all hold
+    source shards takes the explicit masked-psum panel exchange
+    ("panels"), a device-set change takes the gather + runtime
+    device-to-device copy ("deviceput")."""
+    if schedule not in SCHEDULES:
+        raise ValueError(f"unknown rechunk schedule {schedule!r}: expected "
+                         f"one of {SCHEDULES}")
+    if schedule == "auto":
+        env = os.environ.get("DSLIB_RECHUNK_SCHEDULE", "auto")
+        if env not in SCHEDULES:
+            raise ValueError(f"bad DSLIB_RECHUNK_SCHEDULE={env!r}")
+        schedule = env
+    if schedule != "auto":
+        return schedule
+    src = rep.mesh
+    if src.devices.shape == dst_mesh.devices.shape and \
+            (src.devices == dst_mesh.devices).all():
+        return "xla"
+    if set(dst_mesh.devices.flat) <= set(src.devices.flat):
+        return "panels"
+    return "deviceput"
+
+
+def reshard_sparse(rep, dst_mesh, schedule="auto", nse=None, overlap=None):
+    """Re-lay out a :class:`~dislib_tpu.data.sparse.ShardedSparse` for
+    ``dst_mesh`` (and/or a new uniform ``nse``) on device.  Returns the
+    new representation; every schedule rebuilds the nse pads from zero
+    (value 0 at the sentinel column — the poisoned-pad discipline), so a
+    poisoned input tail cannot survive the reshard."""
+    from dislib_tpu.data.sparse import ShardedSparse
+    sched = pick_sparse_schedule(rep, dst_mesh, schedule)
+    plan = _sparse_layout(rep, dst_mesh, nse)
+    if sched == "xla":
+        if not (rep.mesh.devices.shape == dst_mesh.devices.shape
+                and (rep.mesh.devices == dst_mesh.devices).all()):
+            raise ValueError(
+                "schedule='xla' is the same-device-grid nse requantize — "
+                "use 'panels'/'deviceput' (or 'auto') for a layout change")
+        if plan["nse2"] == rep.nse and dst_mesh is rep.mesh:
+            return rep                  # already canonical: metadata no-op
+        d, lr, cc = _sparse_requantize(rep.data, rep.lrows, rep.cols,
+                                       rep.counts_dev, plan["nse2"],
+                                       dst_mesh)
+        counts_dev = rep.counts_dev if dst_mesh is rep.mesh else None
+        return ShardedSparse(d, lr, cc, counts_dev, rep.counts,
+                             rep.row_nnz, rep.shape, dst_mesh)
+    if sched == "panels":
+        if not set(dst_mesh.devices.flat) <= set(rep.mesh.devices.flat):
+            raise ValueError(
+                "schedule='panels' needs every target device to hold a "
+                "source shard — use schedule='deviceput' (or 'auto') for "
+                "a device-set change")
+        return _sparse_panels_run(rep, dst_mesh, plan, overlap)
+    # "deviceput": one gather re-bucketing under the source mesh, then
+    # the runtime's device-to-device copy onto the target sharding
+    idxmap = _sparse_index_map(plan, rep.nse)
+    d, lr, cc = _sparse_regather(rep.data, rep.lrows, rep.cols,
+                                 jnp.asarray(idxmap),
+                                 rep.m_local, plan["m_local2"], rep.nse)
+    sh1 = NamedSharding(dst_mesh, P(_mesh.ROWS))
+    return ShardedSparse(
+        jax.device_put(d, sh1), jax.device_put(lr, sh1),
+        jax.device_put(cc, sh1), None,
+        plan["cnt_dst"], rep.row_nnz, rep.shape, dst_mesh)
+
+
+def _sparse_index_map(plan, nse1):
+    """(p2, nse2) int32 table: flat source slot feeding each target slot
+    (−1 = pad) — host-built from the static stream offsets."""
+    p2, nse2 = plan["p2"], plan["nse2"]
+    e0s = np.asarray(plan["e0_src"], np.int64)
+    out = np.full((p2, nse2), -1, np.int32)
+    for s2 in range(p2):
+        k = plan["cnt_dst"][s2]
+        if not k:
+            continue
+        g = plan["e0_dst"][s2] + np.arange(k, dtype=np.int64)
+        src = np.searchsorted(e0s, g, side="right") - 1
+        out[s2, :k] = src * nse1 + (g - e0s[src])
+    return out
+
+
+@partial(_pjit, static_argnames=("nse2", "mesh"),
+         name="rechunk_sparse_requantize")
+def _sparse_requantize(data, lrows, cols, counts, nse2, mesh):
+    """Fused nse re-pad: crop/zero-grow every buffer's nse axis to the
+    new quantum and re-zero the slots past each shard's live count —
+    pads rebuilt from the zero canvas whatever the input tail carried.
+    ONE dispatch for all three buffers."""
+    sharding = NamedSharding(mesh, P(_mesh.ROWS))
+    p = data.shape[0]
+    ok = lax.broadcasted_iota(jnp.int32, (p, nse2), 1) < counts[:, None]
+
+    def one(x):
+        keep = min(int(x.shape[1]), nse2)
+        out = jnp.zeros((p, nse2), x.dtype)
+        out = lax.dynamic_update_slice(out, x[:, :keep], (0, 0))
+        out = jnp.where(ok, out, jnp.zeros((), x.dtype))
+        return lax.with_sharding_constraint(out, sharding)
+
+    return one(data), one(lrows), one(cols)
+
+
+@partial(_pjit, static_argnames=("m_local1", "m_local2", "nse1"),
+         name="rechunk_sparse_gather")
+def _sparse_regather(data, lrows, cols, idxmap, m_local1, m_local2, nse1):
+    """Re-bucket the entry stream via the host-built index map (the
+    "xla"-collectives gather: the SPMD partitioner owns the movement) —
+    the deviceput schedule's compute half.  Local row ids rebase from
+    the source/target shard strides; pads land exactly (0, 0, 0)."""
+    ok = idxmap >= 0
+    li = jnp.clip(idxmap, 0, None)
+    src_shard = li // nse1
+    dst_shard = lax.broadcasted_iota(jnp.int32, idxmap.shape, 0)
+    gd = data.reshape(-1)[li.reshape(-1)].reshape(idxmap.shape)
+    glr = lrows.reshape(-1)[li.reshape(-1)].reshape(idxmap.shape) \
+        + src_shard * m_local1 - dst_shard * m_local2
+    gcc = cols.reshape(-1)[li.reshape(-1)].reshape(idxmap.shape)
+    z32 = jnp.zeros((), jnp.int32)
+    return (jnp.where(ok, gd, jnp.zeros((), data.dtype)),
+            jnp.where(ok, glr.astype(jnp.int32), z32),
+            jnp.where(ok, gcc, z32))
+
+
+def _sparse_panels_run(rep, dst_mesh, plan, overlap=None):
+    """The explicit sparse panel exchange: ONE jitted shard_map over the
+    SOURCE mesh (one masked-psum broadcast of each source shard's
+    buffers along 'rows', every device assembling its TARGET shard by
+    static stream addressing), then a zero-copy rewrap onto the target
+    mesh — the dense ``panel_rechunk`` shape with a sparse payload."""
+    from dislib_tpu.data.sparse import ShardedSparse
+    src_mesh = rep.mesh
+    tr, _ = _target_coord_tables(src_mesh, dst_mesh)
+    sched = _ov.resolve(overlap)
+    _prof.count_schedule("rechunk_sparse_panels", sched)
+    outs = _sparse_panel_exchange(
+        rep.data, rep.lrows, rep.cols,
+        src_mesh=src_mesh, tr_key=tuple(int(v) for v in tr),
+        e0_src=plan["e0_src"], e0_dst=plan["e0_dst"],
+        cnt_dst=plan["cnt_dst"], m_local1=rep.m_local,
+        m_local2=plan["m_local2"], nse2=plan["nse2"], overlap=sched)
+    sh1 = NamedSharding(dst_mesh, P(_mesh.ROWS))
+    p2, nse2 = plan["p2"], plan["nse2"]
+
+    def rewrap(arr):
+        by_dev = {s.device: s.data for s in arr.addressable_shards}
+        bufs = [by_dev[d] for d in dst_mesh.devices.flat]
+        return jax.make_array_from_single_device_arrays(
+            (p2, nse2), sh1, bufs)
+
+    d, lr, cc = (rewrap(a) for a in outs)
+    return ShardedSparse(d, lr, cc, None, plan["cnt_dst"],
+                         rep.row_nnz, rep.shape, dst_mesh)
+
+
+@partial(_pjit, static_argnames=("src_mesh", "tr_key", "e0_src", "e0_dst",
+                                 "cnt_dst", "m_local1", "m_local2", "nse2",
+                                 "overlap"),
+         name="rechunk_sparse_panels")
+def _sparse_panel_exchange(data, lrows, cols, src_mesh, tr_key, e0_src,
+                           e0_dst, cnt_dst, m_local1, m_local2, nse2,
+                           overlap="db"):
+    """One masked-psum broadcast per source shard (the panel loop, run
+    through ``ops/overlap.panel_pipeline`` under the ``DSLIB_OVERLAP``
+    router); every device assembles its target shard's (nse2,) buffers
+    by static stream addressing — slot i of target shard s' is global
+    entry e0_dst[s'] + i, gathered out of whichever source panel's
+    stream range covers it.  Pads assemble from the zero accumulator:
+    (value 0, row 0, sentinel column 0) by construction."""
+    rows_s = src_mesh.shape[_mesh.ROWS]
+    cols_s = src_mesh.shape[_mesh.COLS]
+    nse1 = data.shape[1]
+    steps = rows_s
+
+    def local(d_s, lr_s, cc_s):
+        d, lr, cc = d_s[0], lr_s[0], cc_s[0]
+        my_r = lax.axis_index(_mesh.ROWS)
+        my_c = lax.axis_index(_mesh.COLS)
+        my_lin = my_r * cols_s + my_c
+        # stream ids fit int32: one relayout moves < 2^31 stored
+        # entries (the int32 ceiling of the BCOO indices themselves)
+        tr_tab = jnp.asarray(np.asarray(tr_key, np.int32))
+        e0s = jnp.asarray(np.asarray(e0_src, np.int32))
+        e0d = jnp.asarray(np.asarray(e0_dst, np.int32))
+        cnt_tab = jnp.asarray(np.asarray(cnt_dst, np.int32))
+        me = tr_tab[my_lin]                 # my TARGET row-rank
+        i = lax.iota(jnp.int32, nse2)
+        g = e0d[me] + i                     # global stream ids I assemble
+        ok_i = i < cnt_tab[me]
+
+        def fetch(t, prev):
+            del prev                        # panels broadcast by source rank
+            pan = tuple(jnp.where(my_r == t, x, jnp.zeros((), x.dtype))
+                        for x in (d, lr, cc))
+            return tuple(lax.psum(x, _mesh.ROWS) for x in pan)
+
+        def consume(t, acc, pan):
+            pd, plr, pcc = pan
+            loc = g - e0s[t]
+            ok = ok_i & (loc >= 0) & (g < e0s[t + 1])
+            li = jnp.clip(loc, 0, nse1 - 1)
+            glr = plr[li] + t * m_local1 - me * m_local2
+            ad, alr, acc_cc = acc
+            return (jnp.where(ok, pd[li], ad),
+                    jnp.where(ok, glr, alr),
+                    jnp.where(ok, pcc[li], acc_cc))
+
+        acc0 = tuple(
+            lax.pcast(jnp.zeros((nse2,), dt), (_mesh.ROWS, _mesh.COLS),
+                      to="varying")
+            for dt in (d.dtype, jnp.int32, jnp.int32))
+        out = _ov.panel_pipeline(steps, fetch(0, None), fetch, consume,
+                                 acc0, _ov.overlapped(overlap))
+        return tuple(x[None, :] for x in out)
+
+    return jax.shard_map(
+        local, mesh=src_mesh,
+        in_specs=(P(_mesh.ROWS), P(_mesh.ROWS), P(_mesh.ROWS)),
+        out_specs=(P(_mesh.ROWS, _mesh.COLS),) * 3,
+        check_vma=True,
+    )(data, lrows, cols)

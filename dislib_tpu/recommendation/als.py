@@ -31,6 +31,7 @@ TPU-native redesign:
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import jax
@@ -112,14 +113,6 @@ class ALS(BaseEstimator):
             raise ValueError("max_iter must be >= 1")
         from dislib_tpu.data.sparse import SparseArray
         sparse_in = isinstance(x, SparseArray)
-        if sparse_in:
-            # true sparse path: the normal equations are built by
-            # segment-sums over the observed (user, item, rating) triplets —
-            # O(nnz·f²) work/memory instead of the dense path's O(m·n·f²)
-            # mask GEMM; no densification ever happens
-            rows_d, cols_d, vals = _triplets(x)
-            t_trip = (rows_d, cols_d, vals) if test is None \
-                else _test_triplets(test, x.shape)
         t_host = None
         if not sparse_in and test is not None:
             import scipy.sparse as sp
@@ -135,17 +128,30 @@ class ALS(BaseEstimator):
         box = {"x": x, "lam": float(self.lambda_), "rmse": np.inf}
 
         def _bind_test():
-            if not sparse_in:
+            if sparse_in:
+                # true sparse path: row-panel-sharded buffers for the
+                # ratings AND the held-out test entries — O(nnz) storage,
+                # no densification ever happens
+                box["rep"] = box["x"].sharded()
+                if "t_sa" not in box:
+                    box["t_sa"] = None if test is None \
+                        else _test_sparse(test, x.shape)
+                box["trep"] = box["rep"] if box["t_sa"] is None \
+                    else box["t_sa"].sharded()
+            else:
                 box["test_p"] = box["x"]._data if t_host is None \
                     else _pad_like(t_host, box["x"])
         _bind_test()
 
         def rebind(mesh):
             if mesh is None:            # pre-switch: force pending chains
-                box["x"].force()
+                if not sparse_in:
+                    box["x"].force()
                 return
-            box["x"] = _ensure_canonical(box["x"])
-            _bind_test()
+            if not sparse_in:
+                box["x"] = _ensure_canonical(box["x"])
+            _bind_test()                # sparse: reps reshard ON DEVICE
+                                        # through the sparse rechunk router
 
         log = verbose_logger("als", self.verbose)
         loop = _fitloop.ChunkedFitLoop(
@@ -153,7 +159,7 @@ class ALS(BaseEstimator):
             max_iter=self.max_iter, carry_names=("users", "items"),
             carry_shapes=((x.shape[0], int(self.n_f)),
                           (x.shape[1], int(self.n_f))),
-            elastic=None if sparse_in else rebind)
+            elastic=rebind)
 
         def init(rem):
             # ALS damping: the 'halve' tier raises the per-row ridge λ·n_u
@@ -181,8 +187,15 @@ class ALS(BaseEstimator):
                     f"n_f={self.n_f}) — stale or foreign snapshot")
             box["lam"] = float(self.lambda_) * rem.damping
             box["rmse"] = float(snap["rmse"])
-            tu = x.shape[0] if sparse_in else box["x"]._data.shape[0]
-            tv = x.shape[1] if sparse_in else box["x"]._data.shape[1]
+            if sparse_in:
+                # the sharded kernel carries U padded to the CURRENT
+                # mesh's row quantum and V at its logical length
+                from dislib_tpu.data.sparse import _padded_rows
+                tu = _padded_rows(x.shape[0], _mesh.get_mesh())
+                tv = x.shape[1]
+            else:
+                tu = box["x"]._data.shape[0]
+                tv = box["x"]._data.shape[1]
             return _fitloop.LoopState(
                 (jnp.asarray(rem.perturb(_repad_rows(snap["users"], sm, tu))),
                  jnp.asarray(rem.perturb(_repad_rows(snap["items"], sn, tv)))),
@@ -193,10 +206,13 @@ class ALS(BaseEstimator):
         def step(st, chunk):
             state = (*st.carries, st.extra) if st.carries else None
             if sparse_in:
+                rep, trep = box["rep"], box["trep"]
                 u, v, rmse_dev, n_done, conv, hist, hvec = _als_fit_sparse(
-                    rows_d, cols_d, vals, *t_trip, x.shape[0], x.shape[1],
+                    rep.data, rep.lrows, rep.cols, rep.counts_dev,
+                    trep.data, trep.lrows, trep.cols, trep.counts_dev,
+                    x.shape[0], x.shape[1],
                     int(self.n_f), box["lam"], float(self.tol),
-                    chunk, int(seed), init_state=state)
+                    chunk, int(seed), _mesh.get_mesh(), init_state=state)
             else:
                 u, v, rmse_dev, n_done, conv, hist, hvec = _als_fit(
                     box["x"]._data, box["test_p"], x.shape, int(self.n_f),
@@ -248,11 +264,13 @@ class ALS(BaseEstimator):
         from dislib_tpu.data.sparse import SparseArray
         seed = self.random_state if self.random_state is not None else 0
         if isinstance(x, SparseArray):
-            rows_d, cols_d, vals = _triplets(x)
-            out = _als_fit_sparse(rows_d, cols_d, vals, rows_d, cols_d, vals,
+            rep = x.sharded()
+            bufs = (rep.data, rep.lrows, rep.cols, rep.counts_dev)
+            out = _als_fit_sparse(*bufs, *bufs,
                                   x.shape[0], x.shape[1], int(self.n_f),
                                   float(self.lambda_), float(self.tol),
-                                  self.max_iter, int(seed))
+                                  self.max_iter, int(seed),
+                                  _mesh.get_mesh())
         else:
             out = _als_fit(x._data, x._data, x.shape, int(self.n_f),
                            float(self.lambda_), float(self.tol),
@@ -278,49 +296,97 @@ class ALS(BaseEstimator):
             raise IndexError(f"user_id {user_id} out of range")
         return self.users_[user_id] @ self.items_.T
 
+    def fold_in(self, ratings) -> np.ndarray:
+        """Score BRAND-NEW users against the trained item factors with no
+        refit — the core recommendation-at-scale operation (ROADMAP item
+        1's online fold-in): solve each new user's regularized normal
+        equations ``(Σ_{j∈Ω} v_j v_jᵀ + λ n I) u = Σ_j r_j v_j`` against
+        the FROZEN ``items_`` and return predicted ratings for every
+        item, all in ONE fused dispatch (solve + predict GEMM; the item
+        factors are device-cached across calls via the serving-layer
+        leaf cache, so a warm fold-in re-transfers nothing).
+
+        ``ratings``: one user's ratings or a (k, n_items) batch —
+        SparseArray, scipy sparse, ndarray (0 = unobserved), or a
+        pre-padded device pair ``(cols, vals)`` of shape (k, s) with
+        (column 0, value 0) pads — the zero-host-transfer serving form.
+
+        Returns the (k, n_items) predicted-ratings ndarray."""
+        preds = self._fold_in_device(ratings)
+        return np.asarray(_fetch(preds))
+
+    def _fold_in_device(self, ratings, precision=None):
+        """The device half of :meth:`fold_in`: returns the predictions
+        as a device array, unfetched — what the sparse serving pipeline
+        consumes (its response fetch is the one blessed sync)."""
+        self._check_fitted()
+        from dislib_tpu.ops import precision as _px
+        if isinstance(ratings, tuple) and len(ratings) == 2:
+            cols, vals = (jnp.asarray(a) for a in ratings)
+            if not jnp.issubdtype(cols.dtype, jnp.integer):
+                # the serving encoding carries ids as float32 (exact
+                # below 2^24) — the gather needs integer indices
+                cols = cols.astype(jnp.int32)
+        else:
+            cols, vals = _fold_in_pack(ratings, self.items_.shape[0])
+        if cols.ndim == 1:
+            cols, vals = cols[None, :], vals[None, :]
+        (items,) = self._predict_leaves(self.items_)
+        _, preds = _als_fold_in(vals, cols, items, float(self.lambda_),
+                                int(self.n_f), _px.resolve(precision))
+        return preds
+
     def _check_fitted(self):
         if not hasattr(self, "users_"):
             raise RuntimeError("ALS is not fitted")
 
 
-def _test_triplets(test, want_shape):
-    """Held-out ratings → (rows, cols, vals) triplets with 0 = unobserved;
-    accepts SparseArray, scipy sparse, ds-array, or ndarray without ever
-    densifying a sparse input."""
+def _test_sparse(test, want_shape):
+    """Held-out ratings → a SparseArray (0 = unobserved) whose sharded
+    buffers feed the fit kernel; accepts SparseArray, scipy sparse,
+    ds-array, or ndarray without ever densifying a sparse input."""
     from dislib_tpu.data.sparse import SparseArray
     import scipy.sparse as sp
     t = test
     if isinstance(t, Array) and not isinstance(t, SparseArray):
         t = t.collect()
     if not (isinstance(t, SparseArray) or sp.issparse(t)):
-        t = np.asarray(t)
+        t = sp.csr_matrix(np.asarray(t, np.float32))
     if tuple(t.shape) != tuple(want_shape):
         raise ValueError(f"test ratings shape {tuple(t.shape)} != "
                          f"ratings shape {tuple(want_shape)}")
     if isinstance(t, SparseArray):
-        return _triplets(t)
-    if sp.issparse(t):
-        coo = t.tocoo()
-        keep = coo.data != 0
-        return (jnp.asarray(coo.row[keep], jnp.int32),
-                jnp.asarray(coo.col[keep], jnp.int32),
-                jnp.asarray(coo.data[keep], jnp.float32))
-    tr, tc = np.nonzero(t)
-    return (jnp.asarray(tr, jnp.int32), jnp.asarray(tc, jnp.int32),
-            jnp.asarray(t[tr, tc], jnp.float32))
+        return t
+    return SparseArray.from_scipy(t)
 
 
-def _triplets(x):
-    """(rows, cols, vals) int32/f32 device triplets of a SparseArray with
-    explicit zeros dropped — 0 means unobserved everywhere in ALS, matching
-    the dense-with-mask path, so an explicitly-stored 0 must not become an
-    observed rating."""
-    idx = np.asarray(jax.device_get(x._bcoo.indices))
-    val = np.asarray(jax.device_get(x._bcoo.data))
-    keep = val != 0
-    return (jnp.asarray(idx[keep, 0], jnp.int32),
-            jnp.asarray(idx[keep, 1], jnp.int32),
-            jnp.asarray(val[keep], jnp.float32))
+def _fold_in_pack(ratings, n_items):
+    """Host packing of new-user ratings into padded (cols, vals) device
+    pairs — per-user nse = the batch's densest row (quantized up), pads
+    at (column 0, value 0) so they are additive no-ops in the fold-in
+    normal equations (the library pad discipline)."""
+    import scipy.sparse as sp
+    from dislib_tpu.data.sparse import SparseArray, nse_quantum
+    t = ratings
+    if isinstance(t, SparseArray):
+        t = t.collect()
+    if not sp.issparse(t):
+        t = sp.csr_matrix(np.atleast_2d(np.asarray(t, np.float32)))
+    t = t.tocsr()
+    if t.shape[1] != n_items:
+        raise ValueError(f"fold_in ratings have {t.shape[1]} items, the "
+                         f"model was trained on {n_items}")
+    k = t.shape[0]
+    row_nnz = np.diff(t.indptr)
+    q = nse_quantum()
+    s = int(math.ceil(max(int(row_nnz.max(initial=1)), 1) / q) * q)
+    cols = np.zeros((k, s), np.int32)
+    vals = np.zeros((k, s), np.float32)
+    for i in range(k):
+        lo, hi = t.indptr[i], t.indptr[i + 1]
+        cols[i, : hi - lo] = t.indices[lo:hi]
+        vals[i, : hi - lo] = t.data[lo:hi]
+    return jnp.asarray(cols), jnp.asarray(vals)
 
 
 def _pad_like(t: np.ndarray, x: Array):
@@ -399,90 +465,149 @@ def _als_fit(rp, test_p, shape, n_f, lambda_, tol, max_iter, seed,
     return u, v, cur, n_iter, conv, hist, hvec
 
 
-@partial(_pjit, static_argnames=("m", "n", "n_f", "max_iter"),
+@partial(_pjit, static_argnames=("m", "n", "n_f", "max_iter", "mesh"),
          donate_argnames=("init_state",), name="als_fit_sparse")
 @precise
-def _als_fit_sparse(rows, cols, vals, trows, tcols, tvals, m, n, n_f,
-                    lambda_, tol, max_iter, seed, init_state=None):
-    """ALS over observed triplets only: per-row normal equations assembled
-    with `segment_sum` over the nnz entries (the reference's CSR-block
-    `_update_chunk` role, collapsed to two segment reductions + one batched
-    Cholesky per half-step).  The (chunk, f²) outer-product intermediate is
-    streamed over nnz chunks so peak memory is O(chunk·f²) + O((m+n)·f²),
-    never O(nnz·f²).  Device placement: single-program (factors replicated);
-    the per-entry gathers/scatters don't shard cleanly across a mesh — the
-    recorded scale ceiling is (m+n)·f² factor storage per device."""
-    key = jax.random.PRNGKey(seed)
-    ku, kv = jax.random.split(key)
-    u0 = jax.random.uniform(ku, (m, n_f), vals.dtype)
-    v0 = jax.random.uniform(kv, (n, n_f), vals.dtype)
-    prev0 = jnp.asarray(jnp.inf, vals.dtype)
-    if init_state is not None:                 # mid-fit checkpoint resume
+def _als_fit_sparse(data, lrows, cols, counts, tdata, tlrows, tcols, tcounts,
+                    m, n, n_f, lambda_, tol, max_iter, seed, mesh,
+                    init_state=None):
+    """Sharded sparse ALS: ONE jitted ``shard_map`` over the row-sharded
+    :class:`~dislib_tpu.data.sparse.ShardedSparse` ratings buffers, the
+    whole while_loop inside (round-14 sparse PR — the fit rides the same
+    machinery as the SpMM fast path instead of the old replicated
+    single-program kernel).
+
+    DrJAX's per-shard-update + cross-shard-reduce decomposition
+    (arXiv:2403.07128), literally: the USER half-step is fully
+    shard-local (each shard owns its users' entries, so their normal
+    equations — segment-sums of v_j v_jᵀ outer products streamed over nse
+    chunks, O(chunk·f²) peak — never leave the shard; U stays row-sharded
+    for the whole fit), and the ITEM half-step is a shard-local partial
+    A_i/b_i plus ONE ``psum`` over the rows axis (V is the replicated
+    small factor).  The convergence RMSE reduces the same way.  Per-shard
+    memory is O(nnz/p · f) + O(n·f²) — the factors of the paper-scale
+    recommender shard with the data.
+
+    Entry weights are ``(slot < count) & (value != 0)``: 0 = unobserved
+    (the dense-with-mask semantics) AND the nse pads — even poisoned
+    ones — carry weight zero (the slot mask, defense in depth over the
+    zero-value sentinel-column pad discipline)."""
+    p = mesh.shape[_mesh.ROWS]
+    from dislib_tpu.data.sparse import _padded_rows
+    m_local = _padded_rows(m, mesh) // p
+    nse = data.shape[1]
+    nse_t = tdata.shape[1]
+    chunk = max(1, min(nse, _SPARSE_CHUNK, _SPARSE_BUDGET // (n_f * n_f)))
+    n_chunks = -(-nse // chunk)
+    pad = n_chunks * chunk - nse
+
+    def shard_fn(d_s, lr_s, cc_s, cnt_s, td_s, tlr_s, tcc_s, tcnt_s, u0_s,
+                 v0_r, prev_r):
+        d_e, lr, cc, cnt = d_s[0], lr_s[0], cc_s[0], cnt_s[0]
+        td, tlr, tcc, tcnt = td_s[0], tlr_s[0], tcc_s[0], tcnt_s[0]
+        slot_ok = lax.broadcasted_iota(jnp.int32, (nse,), 0) < cnt
+        w = (slot_ok & (d_e != 0)).astype(d_e.dtype)
+        # chunk-pad the entry stream (pads carry weight 0 → additive no-op)
+        d_p = jnp.pad(d_e * w, (0, pad))
+        lr_p = jnp.pad(lr, (0, pad))
+        cc_p = jnp.pad(cc, (0, pad))
+        w_p = jnp.pad(w, (0, pad))
+        tok = lax.broadcasted_iota(jnp.int32, (nse_t,), 0) < tcnt
+        tw = (tok & (td != 0)).astype(d_e.dtype)
+        eye = jnp.eye(n_f, dtype=d_e.dtype)
+
+        def solve(seg_c, other, idx_c, nseg, reduce_rows):
+            """Normal equations streamed over nse chunks; the item step
+            (``reduce_rows``) combines per-shard partials with one psum."""
+
+            def body(acc, cx):
+                sc, ic, vc, wc = cx
+                g = other[ic] * wc[:, None]           # pad rows → all-zero
+                b = jax.ops.segment_sum(vc[:, None] * g, sc,
+                                        num_segments=nseg)
+                outer = (g[:, :, None] * g[:, None, :]) \
+                    .reshape(chunk, n_f * n_f)
+                a = jax.ops.segment_sum(outer, sc, num_segments=nseg)
+                cnt_ = jax.ops.segment_sum(wc, sc, num_segments=nseg)
+                return (acc[0] + a, acc[1] + b, acc[2] + cnt_), None
+
+            acc0 = (jnp.zeros((nseg, n_f * n_f), d_e.dtype),
+                    jnp.zeros((nseg, n_f), d_e.dtype),
+                    jnp.zeros((nseg,), d_e.dtype))
+            (a, b, cnts), _ = lax.scan(
+                body, acc0,
+                (seg_c.reshape(n_chunks, chunk),
+                 idx_c.reshape(n_chunks, chunk),
+                 d_p.reshape(n_chunks, chunk),
+                 w_p.reshape(n_chunks, chunk)))
+            if reduce_rows:               # cross-shard reduce: the ONE psum
+                a = lax.psum(a, _mesh.ROWS)
+                b = lax.psum(b, _mesh.ROWS)
+                cnts = lax.psum(cnts, _mesh.ROWS)
+            a = a.reshape(nseg, n_f, n_f)
+            # unobserved rows: A = λ·I, b = 0 → zero factors (harmless)
+            reg = lambda_ * jnp.maximum(cnts, 1.0)
+            a = a + reg[:, None, None] * eye
+            chol = jax.scipy.linalg.cho_factor(a)
+            return jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
+
+        def rmse(u, v):
+            pred = jnp.sum(u[tlr] * v[tcc], axis=1)
+            se = lax.psum(jnp.sum(tw * (pred - td) ** 2), _mesh.ROWS)
+            cnt_t = lax.psum(jnp.sum(tw), _mesh.ROWS)
+            return jnp.sqrt(se / jnp.maximum(cnt_t, 1.0))
+
+        def step(carry):
+            u, v, prev_rmse, it, _, hist = carry
+            u = solve(lr_p, v, cc_p, m_local, False)   # users: shard-local
+            v = solve(cc_p, u, lr_p, n, True)          # items: psum-reduced
+            cur = rmse(u, v)
+            conv = jnp.abs(prev_rmse - cur) < tol
+            return u, v, cur, it + 1, conv, hist.at[it].set(cur)
+
+        def cond(carry):
+            _, _, _, it, conv, _ = carry
+            return (it < max_iter) & (~conv)
+
+        if u0_s is None:
+            key = jax.random.PRNGKey(seed)
+            ku, kv = jax.random.split(key)
+            ku = jax.random.fold_in(ku, lax.axis_index(_mesh.ROWS))
+            u0 = jax.random.uniform(ku, (m_local, n_f), d_e.dtype)
+            v0 = jax.random.uniform(kv, (n, n_f), d_e.dtype)
+            prev0 = jnp.asarray(jnp.inf, d_e.dtype)
+        else:
+            u0 = u0_s
+            v0 = v0_r
+            prev0 = jnp.asarray(prev_r, d_e.dtype)
+        # vma: a fresh u0 is rows-varying via the fold_in of axis_index;
+        # v0/prev0 are replicated (same key / same scalar on every rank)
+        init = (u0, v0, prev0, jnp.int32(0), jnp.asarray(False),
+                jnp.zeros((max_iter,), d_e.dtype))
+        u, v, cur, n_iter, conv, hist = lax.while_loop(cond, step, init)
+        return u, v, cur, n_iter, conv, hist
+
+    from jax.sharding import PartitionSpec as P
+    row_spec = (P(_mesh.ROWS),) * 4
+    if init_state is None:
+        extra_specs = ()
+        args = ()
+    else:
         u0, v0, prev0 = init_state
-        prev0 = jnp.asarray(prev0, vals.dtype)
-    eye = jnp.eye(n_f, dtype=vals.dtype)
+        extra_specs = (P(_mesh.ROWS), P(), P())
+        args = (u0, v0, jnp.asarray(prev0))
 
-    nnz = vals.shape[0]
-    # chunk scales inversely with f² so the (chunk, f²) outer-product
-    # intermediate stays within a fixed element budget at any factor count;
-    # max(1, ...) keeps the nnz == 0 edge (no observed ratings → A = λI,
-    # zero factors, rmse 0) well-formed
-    chunk = max(1, min(nnz, _SPARSE_CHUNK, _SPARSE_BUDGET // (n_f * n_f)))
-    n_chunks = -(-nnz // chunk)
-    pad = n_chunks * chunk - nnz
-    # pad triplets with (row 0, col 0, val 0) + zero weight so they add 0
-    rows_p = jnp.pad(rows, (0, pad))
-    cols_p = jnp.pad(cols, (0, pad))
-    vals_p = jnp.pad(vals, (0, pad))
-    w_p = jnp.pad(jnp.ones_like(vals), (0, pad))
+    def wrapper(*ops):
+        if init_state is None:
+            return shard_fn(*ops, None, None, None)
+        return shard_fn(*ops)
 
-    def solve(seg_c, other, idx_c, nseg):
-        """Stream the normal-equation sums over nnz chunks: seg_c/idx_c are
-        (n_chunks, chunk) row/col ids, `other` the opposite factor matrix."""
-
-        def body(acc, cx):
-            sc, ic, vc, wc = cx
-            g = other[ic] * wc[:, None]               # pad rows → all-zero
-            b = jax.ops.segment_sum(vc[:, None] * g, sc, num_segments=nseg)
-            outer = (g[:, :, None] * g[:, None, :]).reshape(chunk, n_f * n_f)
-            a = jax.ops.segment_sum(outer, sc, num_segments=nseg)
-            cnt = jax.ops.segment_sum(wc, sc, num_segments=nseg)
-            return (acc[0] + a, acc[1] + b, acc[2] + cnt), None
-
-        acc0 = (jnp.zeros((nseg, n_f * n_f), vals.dtype),
-                jnp.zeros((nseg, n_f), vals.dtype),
-                jnp.zeros((nseg,), vals.dtype))
-        (a, b, counts), _ = lax.scan(
-            body, acc0,
-            (seg_c.reshape(n_chunks, chunk), idx_c.reshape(n_chunks, chunk),
-             vals_p.reshape(n_chunks, chunk), w_p.reshape(n_chunks, chunk)))
-        a = a.reshape(nseg, n_f, n_f)
-        # unobserved rows: A = λ·I, b = 0 → zero factors (harmless)
-        reg = lambda_ * jnp.maximum(counts, 1.0)
-        a = a + reg[:, None, None] * eye
-        chol = jax.scipy.linalg.cho_factor(a)
-        return jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
-
-    def rmse(u, v):
-        pred = jnp.sum(u[trows] * v[tcols], axis=1)
-        return jnp.sqrt(jnp.sum((pred - tvals) ** 2)
-                        / jnp.maximum(tvals.shape[0], 1))
-
-    def step(carry):
-        u, v, prev_rmse, it, _, hist = carry
-        u = solve(rows_p, v, cols_p, m)
-        v = solve(cols_p, u, rows_p, n)
-        cur = rmse(u, v)
-        conv = jnp.abs(prev_rmse - cur) < tol
-        return u, v, cur, it + 1, conv, hist.at[it].set(cur)
-
-    def cond(carry):
-        _, _, _, it, conv, _ = carry
-        return (it < max_iter) & (~conv)
-
-    init = (u0, v0, prev0, jnp.int32(0), jnp.asarray(False),
-            jnp.zeros((max_iter,), vals.dtype))
-    u, v, cur, n_iter, conv, hist = lax.while_loop(cond, step, init)
+    u, v, cur, n_iter, conv, hist = jax.shard_map(
+        wrapper, mesh=mesh,
+        in_specs=row_spec + row_spec + extra_specs,
+        out_specs=(P(_mesh.ROWS), P(), P(), P(), P(), P()),
+        check_vma=True,
+    )(data, lrows, cols, counts, tdata, tlrows, tcols, tcounts, *args)
     # fused health vector — same program, zero extra dispatches
     from dislib_tpu.runtime import health as _health
     hvec = _health.health_vec(carries=(u, v), hist=hist, n_done=n_iter)
@@ -493,3 +618,53 @@ def _als_fit_sparse(rows, cols, vals, trows, tcols, tvals, m, n, n_f,
 # budget for the (chunk, f²) intermediate (chunk·f² ≤ _SPARSE_BUDGET)
 _SPARSE_CHUNK = 1 << 18
 _SPARSE_BUDGET = 1 << 22
+
+
+def _fold_in_body(vals, cols, items, lambda_, n_f, policy):
+    """The fold-in math: per-user regularized normal equations against
+    the frozen item factors, then one predict GEMM — entirely traced, so
+    the serving pipeline's packed variant fuses it into the same single
+    dispatch.  (value != 0) doubles as the observation mask AND the pad
+    mask (pads are value-0 at the sentinel column)."""
+    from dislib_tpu.ops import precision as px
+    # weight = observed AND in-range: an out-of-range id (corrupt
+    # request past the pack-time validation) becomes a no-op instead of
+    # silently scoring against the clipped last item — the slot-mask
+    # defense-in-depth discipline at the serving boundary
+    in_range = (cols >= 0) & (cols < items.shape[0])
+    w = ((vals != 0) & in_range).astype(items.dtype)
+    g = items[jnp.clip(cols, 0, items.shape[0] - 1)] * w[..., None]
+    a = px.peinsum("ksf,ksg->kfg", g, g, policy)           # (k, f, f)
+    cnt = jnp.sum(w, axis=1)
+    reg = lambda_ * jnp.maximum(cnt, 1.0)
+    a = a + reg[:, None, None] * jnp.eye(n_f, dtype=a.dtype)
+    b = px.peinsum("ks,ksf->kf", vals.astype(items.dtype) * w, g, policy)
+    chol = jax.scipy.linalg.cho_factor(a)
+    factors = jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
+    preds = px.pdot(factors, items.T, policy)              # (k, n_items)
+    return factors, preds
+
+
+# lambda_ is STATIC: it is per-model configuration (one retrace per
+# fitted model), and a dynamic scalar operand would cost one
+# host->device scalar transfer per served batch — the zero-transfer
+# serving boundary is counter-asserted in tests/test_spmm.py
+@partial(_pjit, static_argnames=("lambda_", "n_f", "policy"),
+         name="als_fold_in")
+@precise
+def _als_fold_in(vals, cols, items, lambda_, n_f, policy):
+    return _fold_in_body(vals, cols, items, lambda_, n_f, policy)
+
+
+@partial(_pjit, static_argnames=("lambda_", "n_f", "policy"),
+         name="als_fold_in_packed")
+@precise
+def _als_fold_in_packed(buf, items, lambda_, n_f, policy):
+    """Serving entry: one PACKED sparse batch — each request row is
+    ``[cols | vals]`` (2·s floats, pads (0, 0)) — split and cast ON
+    DEVICE so a served batch stays ONE fused dispatch.  Column ids ride
+    float32 exactly below 2^24; the pipeline validates the item count."""
+    s = buf.shape[1] // 2
+    cols = buf[:, :s].astype(jnp.int32)
+    vals = buf[:, s:]
+    return _fold_in_body(vals, cols, items, lambda_, n_f, policy)

@@ -109,12 +109,20 @@ def data_rebind(holder, key="x"):
     (``{key: ds_array}``): force the pending op chain BEFORE the mesh
     switch (the fusion layer's device-set contract — the driver calls the
     hook with ``mesh=None`` for this phase), re-canonicalize onto the new
-    mesh after.  Estimators with extra rebinding (ALS's padded test
-    matrix) wrap or replace it."""
+    mesh after.  SPARSE holders (``SparseArray``) re-land their sharded
+    buffers through the sparse rechunk schedules instead (no op chains
+    to force, still never the host) — the round-14 sparse elastic rung.
+    Estimators with extra rebinding (ALS's padded test matrix) wrap or
+    replace it."""
     def hook(mesh):
         from dislib_tpu.data.array import ensure_canonical
-        holder[key] = holder[key].force() if mesh is None \
-            else ensure_canonical(holder[key])
+        from dislib_tpu.data.sparse import SparseArray
+        x = holder[key]
+        if isinstance(x, SparseArray):
+            if mesh is not None:
+                x.sharded(mesh)         # on-device reshard of the backing
+            return
+        holder[key] = x.force() if mesh is None else ensure_canonical(x)
     return hook
 
 

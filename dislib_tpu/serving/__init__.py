@@ -28,6 +28,12 @@ end-to-end**, from four pieces that compose:
   rotating ``FitCheckpoint`` through the ``runtime.adoption`` gate: serve
   generation N while N+1 trains, adopting N+1 only after its checksum
   verifies and its warmup predict passes the health guard.
+- **sparse fold-in serving** (``sparse.py``, round 14) — recommender
+  requests arrive as PADDED SPARSE batches (``[cols | vals]`` rows, the
+  fixed-width encoding) and serve through the same bucket
+  ladder/server/pool machinery as one fused ALS fold-in dispatch per
+  batch: score a brand-new user against the trained factors with no
+  refit and no densified request vector.
 
 See the user guide's "Serving & hot-swap" section for the end-to-end
 story and `bench.py::bench_serving` for the regression-gated numbers.
@@ -39,9 +45,10 @@ from dislib_tpu.serving.cache import ProgramCache
 from dislib_tpu.serving.hotswap import ModelPool
 from dislib_tpu.serving.pipeline import ServePipeline
 from dislib_tpu.serving.server import PredictServer, ServeResponse
+from dislib_tpu.serving.sparse import SparseFoldInPipeline, pack_sparse_rows
 
 __all__ = [
     "DEFAULT_BUCKETS", "bucket_ladder", "bucket_for", "split_rows",
     "ProgramCache", "ServePipeline", "PredictServer", "ServeResponse",
-    "ModelPool",
+    "ModelPool", "SparseFoldInPipeline", "pack_sparse_rows",
 ]

@@ -32,3 +32,19 @@ scores = als.predict_user(user)
 unseen = np.asarray(ratings[user].todense()).ravel() == 0
 top = np.argsort(-np.where(unseen, scores, -np.inf))[:5]
 print(f"top-5 unseen items for user {user}: {top.tolist()}")
+
+# -- a BRAND-NEW user: fold-in, no refit (round 14) -------------------------
+new_user = np.where(rng.rand(120) < 0.2,
+                    rng.rand(6).astype(np.float32) @ true_v.T, 0.0) \
+    .astype(np.float32)
+preds = als.fold_in(new_user)           # one fused dispatch
+print(f"fold-in: predicted {preds.shape[1]} item scores for a new user")
+
+# -- and the same scoring served as padded sparse batches -------------------
+from dislib_tpu.serving import PredictServer, SparseFoldInPipeline
+
+pipe = SparseFoldInPipeline(als, nse_cap=64)
+with PredictServer(pipeline=pipe, buckets=(1, 8, 64)) as srv:
+    out = srv.predict(pipe.pack(new_user))
+    top_new = np.argsort(-out[0])[:5]
+print(f"served top-5 for the folded-in user: {top_new.tolist()}")

@@ -1,0 +1,109 @@
+"""Densify lint (round-14 sparse PR satellite; the host-sync / precision
+lint pattern): estimator and serving code may not densify a sparse
+operand — ``.to_dense()`` / ``.toarray()`` is O(rows·cols) memory and
+FLOPs for O(nnz) information, exactly the escape hatch the sparse fast
+path (sharded SpMM, sparse rechunk, fold-in serving) exists to retire.
+
+A new ``.to_dense()`` in estimator/serving code is a test failure unless
+the site is consciously allowlisted with a reason (each entry is a
+HOST-side staging/triage boundary, never the ratings/feature matrix on
+the fit or serve path).  The ``math.matmul`` ``algorithm="densify"``
+route lives in ``dislib_tpu/math`` — deliberate, budget-guarded, and
+outside this lint's scanned set by design (it is the one blessed
+densify entry)."""
+
+import ast
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCANNED_DIRS = (
+    "dislib_tpu/cluster",
+    "dislib_tpu/classification",
+    "dislib_tpu/recommendation",
+    "dislib_tpu/trees",
+    "dislib_tpu/regression",
+    "dislib_tpu/decomposition",
+    "dislib_tpu/neighbors",
+    "dislib_tpu/optimization",
+    "dislib_tpu/model_selection",
+    "dislib_tpu/preprocessing",
+    "dislib_tpu/serving",
+)
+
+# (file, enclosing function) pairs allowed to densify, with reasons:
+ALLOWLIST = {
+    # dense-path ALS accepting a SPARSE held-out test matrix: the dense
+    # fit kernel needs the padded test canvas anyway (dense-with-mask),
+    # and the conversion is host-side ingest of the small TEST ratings —
+    # the sparse FIT path never touches this branch
+    ("dislib_tpu/recommendation/als.py", "fit"),
+    # cascade SVM stages its support-vector ROWS as host CSR→dense at
+    # adoption time (SURVEY §3.3 host-planned tier) — a per-node subset,
+    # never the full feature matrix
+    ("dislib_tpu/classification/csvm.py", "fit"),
+    # cascade SVM's per-node sub-Gram: (sub @ subᵀ).todense() is the
+    # small (cap, cap) KERNEL BLOCK the dual solve needs dense anyway —
+    # the full matrix stays CSR (the function's docstring contract)
+    ("dislib_tpu/classification/csvm.py", "k_of"),
+}
+
+_DENSIFY_ATTRS = ("to_dense", "toarray", "todense")
+
+
+def _densify_calls(path):
+    tree = ast.parse(open(path, encoding="utf-8").read())
+
+    def walk(node, fname):
+        for child in ast.iter_child_nodes(node):
+            cname = fname
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cname = child.name
+            if isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr in _DENSIFY_ATTRS:
+                yield fname, child.lineno, child.func.attr
+            yield from walk(child, cname)
+
+    yield from walk(tree, "<module>")
+
+
+def _scanned_files():
+    for d in SCANNED_DIRS:
+        full = os.path.join(REPO, d)
+        for fn in sorted(os.listdir(full)):
+            if fn.endswith(".py"):
+                yield f"{d}/{fn}", os.path.join(full, fn)
+
+
+def test_no_densification_in_estimator_or_serving_code():
+    offenders = []
+    for rel, full in _scanned_files():
+        for fname, lineno, attr in _densify_calls(full):
+            if (rel, fname) not in ALLOWLIST:
+                offenders.append(f"{rel}:{lineno} in {fname}(): .{attr}()")
+    assert not offenders, (
+        "sparse operand densified in estimator/serving code — route "
+        "through the sparse fast path (ops/spmm, sharded buffers, the "
+        "matmul densify router), or consciously extend the lint "
+        "ALLOWLIST with a reason:\n  " + "\n  ".join(offenders))
+
+
+def test_allowlist_entries_still_exist():
+    """A refactor that renames or removes an allowlisted site must prune
+    the list — dead entries would quietly bless future regressions."""
+    live = set()
+    for rel, full in _scanned_files():
+        for fname, _, _ in _densify_calls(full):
+            live.add((rel, fname))
+    dead = {site for site in ALLOWLIST if site not in live}
+    assert not dead, f"densify allowlist entries match no code: {dead}"
+
+
+def test_sparse_fit_and_serve_paths_scanned():
+    """The sparse fast path's own homes stay in the scanned set."""
+    scanned = {rel for rel, _ in _scanned_files()}
+    for f in ("dislib_tpu/recommendation/als.py",
+              "dislib_tpu/serving/sparse.py",
+              "dislib_tpu/cluster/kmeans.py"):
+        assert f in scanned, f"{f} escaped the densify lint"

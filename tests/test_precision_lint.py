@@ -32,6 +32,18 @@ KERNEL_DIRS = (
     "dislib_tpu/math",
     "dislib_tpu/ops",
     "dislib_tpu/decomposition",
+    # round-14: the sparse fast path spells its own contractions (the
+    # fold-in peinsum/pdot, the SpMM gather/segment contraction) — its
+    # homes may not hardcode compute dtypes either
+    "dislib_tpu/recommendation",
+)
+
+# single FILES scanned alongside the dirs (their siblings are host
+# ingest/serialization code whose float casts are dtype policy, not
+# kernel compute decisions)
+KERNEL_FILES = (
+    "dislib_tpu/data/sparse.py",
+    "dislib_tpu/serving/sparse.py",
 )
 
 # the ONE module allowed to spell compute dtypes / precision literals
@@ -77,6 +89,8 @@ def _kernel_files():
         for fn in sorted(os.listdir(full)):
             if fn.endswith(".py"):
                 yield f"{d}/{fn}", os.path.join(full, fn)
+    for rel in KERNEL_FILES:
+        yield rel, os.path.join(REPO, rel)
 
 
 def test_no_hardcoded_compute_dtypes_in_kernels():
@@ -112,7 +126,12 @@ def test_overlap_kernel_files_are_in_the_scanned_set():
     for f in ("dislib_tpu/ops/overlap.py", "dislib_tpu/ops/summa.py",
               "dislib_tpu/ops/rechunk.py", "dislib_tpu/ops/ring.py",
               "dislib_tpu/ops/tiled.py",
-              "dislib_tpu/ops/pallas_kernels.py"):
+              "dislib_tpu/ops/pallas_kernels.py",
+              # round-14 sparse fast path
+              "dislib_tpu/ops/spmm.py",
+              "dislib_tpu/recommendation/als.py",
+              "dislib_tpu/data/sparse.py",
+              "dislib_tpu/serving/sparse.py"):
         assert f in scanned, f"{f} escaped the precision lint"
 
 

@@ -131,13 +131,17 @@ class TestEquivalenceGrid:
         c = a.rechunk((3, 3))                      # method parity
         assert c._concrete is a._concrete and c.block_size == (3, 3)
 
-    def test_sparse_array_rejected_with_clear_error(self):
+    def test_sparse_array_accepted_since_round_14(self):
+        """The PR-6 typed rejection is GONE: SparseArray routes through
+        the sparse schedules (tests/test_spmm.py owns the equivalence
+        grid; this pins the entry accepting it at all)."""
         from dislib_tpu.data.sparse import SparseArray
         import scipy.sparse as sp
-        s = SparseArray.from_scipy(sp.random(8, 8, 0.5, format="csr",
-                                             random_state=0))
-        with pytest.raises(TypeError, match="dense ds-array"):
-            ds.rechunk(s)
+        mat = sp.random(8, 8, 0.5, format="csr", random_state=0)
+        s = SparseArray.from_scipy(mat)
+        out = ds.rechunk(s)
+        assert isinstance(out, SparseArray)
+        np.testing.assert_allclose(out.collect().toarray(), mat.toarray())
 
 
 # ---------------------------------------------------------------------------

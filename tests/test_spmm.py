@@ -1,0 +1,533 @@
+"""Round-14 distributed-sparse fast path.
+
+Pins the tentpole's claims: the masked-psum SpMM equals the densify
+oracle over a (density × mesh × dtype incl. x64-f64 × overlap-schedule)
+grid and is BIT-equal across overlap schedules; the sparse rechunk
+schedules reproduce a host scipy relayout exactly and rebuild poisoned
+nse pads from zero; the ``math.matmul`` spmm/densify router keys on
+density × the densify budget; ALS ``fold_in`` matches the normal-
+equation oracle in one dispatch; the sparse serving pipeline serves
+padded sparse batches through the PredictServer bucket ladder; and the
+fit → fold-in → serve pipeline runs with zero host transfers of the
+ratings/factors and ZERO densifications (monkeypatch-banned).
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import dislib_tpu as ds
+from dislib_tpu.data.sparse import SparseArray, nse_quantum
+from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.utils import profiling as prof
+
+MESHES = [(8, 1), (4, 2), (2, 4)]
+
+
+def _mk(rng, m, n, density, dtype=np.float32):
+    dense = (rng.rand(m, n) * (rng.rand(m, n) < density)).astype(dtype)
+    return dense, SparseArray.from_scipy(sp.csr_matrix(dense), dtype=dtype)
+
+
+def _triplet_dense(sa):
+    """Rebuild the logical dense matrix from the SHARDED buffers."""
+    rep = sa.sharded()
+    out = np.zeros(sa.shape, np.asarray(rep.data).dtype)
+    rows, cols, vals = rep.host_triplets()
+    np.add.at(out, (rows.astype(int), cols.astype(int)), vals)
+    return out
+
+
+def _poison_pads(sa):
+    """Overwrite every pad slot of the sharded buffers with garbage
+    (NaN values, in-range-but-wrong columns/rows) — the pads must stay
+    non-load-bearing through every kernel and schedule."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = sa.sharded()
+    d = np.asarray(rep.data).copy()
+    lr = np.asarray(rep.lrows).copy()
+    cc = np.asarray(rep.cols).copy()
+    for s, k in enumerate(rep.counts):
+        d[s, k:] = np.nan
+        lr[s, k:] = (s + 1) % max(rep.m_local, 1)
+        cc[s, k:] = min(rep.shape[1] - 1, 1)
+    sh = NamedSharding(rep.mesh, P(_mesh.ROWS))
+    rep.data = jax.device_put(jnp.asarray(d), sh)
+    rep.lrows = jax.device_put(jnp.asarray(lr), sh)
+    rep.cols = jax.device_put(jnp.asarray(cc), sh)
+    rep._rowsq = None
+    return sa
+
+
+# ---------------------------------------------------------------------------
+# SpMM vs the densify oracle
+# ---------------------------------------------------------------------------
+
+class TestSpmmOracle:
+    @pytest.mark.parametrize("mesh", MESHES)
+    @pytest.mark.parametrize("density", [0.01, 0.3])
+    def test_matches_densify_oracle(self, rng, mesh, density):
+        from conftest import skip_unless_devices
+        skip_unless_devices(8)
+        ds.init(mesh)
+        dense, xs = _mk(rng, 54, 37, density)
+        b = rng.rand(37, 13).astype(np.float32)
+        from dislib_tpu.ops.spmm import spmm
+        out = np.asarray(spmm(xs, ds.array(b)).collect())
+        np.testing.assert_allclose(out, dense @ b, rtol=1e-5, atol=1e-5)
+
+    def test_f64_x64_mode(self, rng):
+        with jax.enable_x64(True):
+            ds.init((4, 2))
+            dense = (np.asarray(rng.rand(40, 24) * (rng.rand(40, 24) < 0.1))
+                     .astype(np.float64))
+            xs = SparseArray.from_scipy(sp.csr_matrix(dense),
+                                        dtype=np.float64)
+            b = rng.rand(24, 8)
+            from dislib_tpu.ops.spmm import spmm
+            out = spmm(xs, ds.array(b, dtype=np.float64))
+            assert out.dtype == np.float64
+            np.testing.assert_allclose(np.asarray(out.collect()),
+                                       dense @ b, rtol=1e-12)
+
+    def test_overlap_schedules_bit_equal_and_counted(self, rng):
+        """db / seq / pallas consume panels in identical order — outputs
+        are BIT-equal, and each run is observable as a spmm:<sched>
+        schedule counter (1 dispatch each)."""
+        from dislib_tpu.ops.spmm import spmm
+        ds.init((4, 2))
+        dense, xs = _mk(rng, 48, 32, 0.1)
+        b = ds.array(rng.rand(32, 8).astype(np.float32))
+        outs = {}
+        prof.reset_counters()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # pallas fallback warn off-TPU
+            for sched in ("db", "seq", "pallas"):
+                outs[sched] = np.asarray(spmm(xs, b, overlap=sched)
+                                         .collect())
+        assert (outs["db"] == outs["seq"]).all()
+        assert (outs["db"] == outs["pallas"]).all()
+        sc = prof.schedule_counters()
+        assert sc.get("spmm:db", 0) >= 1 and sc.get("spmm:seq", 0) == 1
+
+    def test_one_dispatch(self, rng):
+        from dislib_tpu.ops.spmm import spmm
+        ds.init((8, 1))
+        _, xs = _mk(rng, 40, 16, 0.1)
+        b = ds.array(rng.rand(16, 4).astype(np.float32)).force()
+        xs.sharded()                        # ingest outside the window
+        spmm(xs, b)                         # warm
+        prof.reset_counters()
+        spmm(xs, b)
+        assert prof.counters()["dispatch_by"].get("spmm_panels") == 1
+        assert prof.trace_count() == 0
+
+    def test_poisoned_pads_are_inert(self, rng):
+        from dislib_tpu.ops.spmm import spmm
+        ds.init((4, 2))
+        dense, xs = _mk(rng, 30, 20, 0.2)
+        b = ds.array(rng.rand(20, 6).astype(np.float32))
+        want = np.asarray(spmm(xs, b).collect())
+        _poison_pads(xs)
+        got = np.asarray(spmm(xs, b).collect())
+        assert np.isfinite(got).all()
+        np.testing.assert_array_equal(got, want)
+
+    def test_memory_is_o_nnz_not_o_dense(self, rng):
+        """XLA's own accounting: the compiled SpMM's temporaries stay
+        below one densified-A allocation at low density."""
+        from dislib_tpu.ops.spmm import spmm_memory_analysis
+        ds.init((8, 1))
+        _, xs = _mk(rng, 256, 256, 0.01)
+        b = ds.array(rng.rand(256, 32).astype(np.float32))
+        res = spmm_memory_analysis(xs, b)
+        if res["temp_bytes"] is None:
+            pytest.skip("backend exposes no memory analysis")
+        assert res["temp_vs_dense"] < 1.0, res
+
+
+# ---------------------------------------------------------------------------
+# the matmul spmm/densify router
+# ---------------------------------------------------------------------------
+
+class TestMatmulRouter:
+    def test_auto_low_density_routes_spmm(self, rng):
+        ds.init((8, 1))
+        dense, xs = _mk(rng, 64, 40, 0.02)
+        bh = rng.rand(40, 8).astype(np.float32)
+        b = ds.array(bh)
+        prof.reset_counters()
+        out = ds.matmul(xs, b)
+        assert prof.counters()["dispatch_by"].get("spmm_panels") == 1
+        np.testing.assert_allclose(np.asarray(out.collect()), dense @ bh,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_auto_high_density_routes_densify(self, rng):
+        ds.init((8, 1))
+        dense, xs = _mk(rng, 30, 20, 0.6)
+        bh = rng.rand(20, 4).astype(np.float32)
+        b = ds.array(bh)
+        prof.reset_counters()
+        out = ds.matmul(xs, b)
+        assert "spmm_panels" not in prof.counters()["dispatch_by"]
+        np.testing.assert_allclose(np.asarray(out.collect()), dense @ bh,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_densify_budget_forces_spmm(self, rng, monkeypatch):
+        """Over the densify byte budget, auto takes spmm even at high
+        density — O(nnz) always fits where the data fits."""
+        ds.init((8, 1))
+        dense, xs = _mk(rng, 30, 20, 0.6)
+        bh = rng.rand(20, 4).astype(np.float32)
+        b = ds.array(bh)
+        monkeypatch.setenv("DSLIB_SPARSE_DENSIFY_BUDGET", "16")
+        prof.reset_counters()
+        out = ds.matmul(xs, b)
+        assert prof.counters()["dispatch_by"].get("spmm_panels") == 1
+        np.testing.assert_allclose(np.asarray(out.collect()), dense @ bh,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_explicit_algorithms_and_typed_errors(self, rng):
+        ds.init((8, 1))
+        dense, xs = _mk(rng, 24, 16, 0.3)
+        b = ds.array(rng.rand(16, 4).astype(np.float32))
+        a1 = np.asarray(ds.matmul(xs, b, algorithm="spmm").collect())
+        a2 = np.asarray(ds.matmul(xs, b, algorithm="densify").collect())
+        np.testing.assert_allclose(a1, a2, rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError, match="algorithm"):
+            ds.matmul(xs, b, algorithm="nope")
+        with pytest.raises(TypeError, match="sparse @ dense"):
+            ds.matmul(xs, b, transpose_a=True)
+        with pytest.raises(TypeError, match="sparse @ dense"):
+            ds.matmul(b, xs)
+
+    def test_operator_still_routes(self, rng):
+        ds.init((8, 1))
+        dense, xs = _mk(rng, 24, 16, 0.05)
+        b = rng.rand(16, 4).astype(np.float32)
+        np.testing.assert_allclose(np.asarray((xs @ b).collect()),
+                                   dense @ b, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sparse rechunk: schedules vs the host scipy relayout oracle
+# ---------------------------------------------------------------------------
+
+class TestSparseRechunk:
+    @pytest.mark.parametrize("pair", [((8, 1), (4, 2)), ((4, 2), (2, 4)),
+                                      ((2, 4), (8, 1))])
+    def test_panel_exchange_equals_scipy_relayout(self, rng, pair):
+        from conftest import skip_unless_devices
+        skip_unless_devices(8)
+        src, dst = pair
+        ds.init(src)
+        dense, xs = _mk(rng, 61, 23, 0.25)
+        xs.sharded()                         # lay out under the SOURCE mesh
+        dst_mesh = _mesh.init(dst)
+        prof.reset_counters()
+        out = ds.rechunk(xs, mesh=dst_mesh, schedule="panels")
+        assert out._sharded_rep.mesh is dst_mesh
+        # oracle: the host scipy matrix relaid out is ... the same matrix
+        np.testing.assert_allclose(_triplet_dense(out), dense)
+        assert any(k.startswith("rechunk_sparse_panels:")
+                   for k in prof.schedule_counters())
+        # and the fast path consumes the relaid buffers directly
+        b = rng.rand(23, 5).astype(np.float32)
+        from dislib_tpu.ops.spmm import spmm
+        np.testing.assert_allclose(
+            np.asarray(spmm(out, ds.array(b)).collect()), dense @ b,
+            rtol=1e-5, atol=1e-5)
+
+    def test_nse_requantize_fused(self, rng):
+        ds.init((8, 1))
+        dense, xs = _mk(rng, 40, 16, 0.2)
+        xs.sharded()
+        q = nse_quantum()
+        out = ds.rechunk(xs, nse=3 * q, schedule="xla")
+        assert out._sharded_rep.nse == 3 * q
+        np.testing.assert_allclose(_triplet_dense(out), dense)
+        # a too-small explicit nse is a typed error, not silent truncation
+        with pytest.raises(ValueError, match="nse"):
+            ds.rechunk(xs, nse=0, schedule="xla")
+
+    def test_deviceput_device_set_change(self, rng):
+        from conftest import skip_unless_devices
+        skip_unless_devices(8)
+        ds.init((8, 1))
+        dense, xs = _mk(rng, 33, 17, 0.3)
+        xs.sharded()
+        half = _mesh.init((2, 2), devices=jax.devices()[:4])
+        out = ds.rechunk(xs, mesh=half, schedule="deviceput")
+        assert out._sharded_rep.p == 2
+        np.testing.assert_allclose(_triplet_dense(out), dense)
+
+    @pytest.mark.parametrize("sched", ["panels", "deviceput"])
+    def test_poisoned_pads_rebuilt_per_schedule(self, rng, sched):
+        ds.init((8, 1))
+        dense, xs = _mk(rng, 29, 11, 0.3)
+        _poison_pads(xs)
+        dst = _mesh.init((4, 2))
+        out = ds.rechunk(xs, mesh=dst, schedule=sched)
+        rep = out._sharded_rep
+        assert np.isfinite(np.asarray(rep.data)).all()
+        np.testing.assert_allclose(_triplet_dense(out), dense)
+
+    def test_sharded_ingest_guard_relands_on_device(self, rng):
+        """`sharded(mesh)` on a backing laid out for ANOTHER mesh — the
+        estimator ingest-guard path — reshards without a host hop."""
+        ds.init((8, 1))
+        dense, xs = _mk(rng, 26, 14, 0.3)
+        xs.sharded()
+        dst = _mesh.init((4, 2))
+        with jax.transfer_guard("disallow"):
+            rep = xs.sharded(dst)
+        assert rep.mesh is dst and rep.p == 4
+
+    def test_rechunk_dense_still_rejects_garbage(self):
+        with pytest.raises(TypeError, match="ds-array or SparseArray"):
+            ds.rechunk([[1, 2]])
+
+    def test_panels_kwarg_rejected_for_sparse(self, rng):
+        """panels= tunes the DENSE exchange only; silently ignoring it
+        on sparse would read as a working memory knob (review-found) —
+        nse= is the sparse knob, and the entry says so."""
+        _, xs = _mk(rng, 16, 8, 0.3)
+        with pytest.raises(ValueError, match="nse"):
+            ds.rechunk(xs, panels=8)
+
+
+# ---------------------------------------------------------------------------
+# ALS fold-in
+# ---------------------------------------------------------------------------
+
+def _als_fixture(rng, m=30, n=20, f=4):
+    u = rng.rand(m, f).astype(np.float32)
+    v = rng.rand(n, f).astype(np.float32)
+    full = u @ v.T
+    r = np.where(rng.rand(m, n) < 0.4, full, 0.0).astype(np.float32)
+    from dislib_tpu.recommendation import ALS
+    als = ALS(n_f=f, lambda_=0.002, max_iter=30, tol=1e-7,
+              random_state=0).fit(SparseArray.from_scipy(sp.csr_matrix(r)))
+    return als, v, full
+
+
+class TestFoldIn:
+    def test_matches_normal_equation_oracle(self, rng):
+        als, v, full = _als_fixture(rng)
+        new = np.where(rng.rand(20) < 0.5,
+                       rng.rand(4).astype(np.float32) @ v.T, 0.0) \
+            .astype(np.float32)
+        prof.reset_counters()
+        pred = als.fold_in(new)
+        assert prof.counters()["dispatch_by"].get("als_fold_in") == 1
+        obs = new != 0
+        vo = als.items_[obs]
+        lam = als.lambda_ * max(obs.sum(), 1)
+        fac = np.linalg.solve(vo.T @ vo + lam * np.eye(4),
+                              vo.T @ new[obs])
+        np.testing.assert_allclose(pred[0], fac @ als.items_.T,
+                                   rtol=1e-4, atol=1e-4)
+        # the folded-in user predicts its own observed ratings well
+        assert np.abs(pred[0][obs] - new[obs]).mean() < 0.15
+
+    def test_input_forms_agree(self, rng):
+        als, v, _ = _als_fixture(rng)
+        new = np.where(rng.rand(2, 20) < 0.5, 1.0, 0.0).astype(np.float32)
+        a = als.fold_in(new)
+        b = als.fold_in(sp.csr_matrix(new))
+        c = als.fold_in(SparseArray.from_scipy(sp.csr_matrix(new)))
+        np.testing.assert_allclose(a, b, atol=1e-6)
+        np.testing.assert_allclose(a, c, atol=1e-6)
+
+    def test_wrong_width_raises(self, rng):
+        als, _, _ = _als_fixture(rng)
+        with pytest.raises(ValueError, match="items"):
+            als.fold_in(np.zeros((1, 7), np.float32))
+
+    def test_unfitted_raises(self):
+        from dislib_tpu.recommendation import ALS
+        with pytest.raises(RuntimeError):
+            ALS().fold_in(np.zeros(3))
+
+    def test_float32_cols_tuple_form(self, rng):
+        """The pre-padded (cols, vals) device form accepts float32 ids —
+        the serving encoding's dtype (review-found: the gather needs an
+        int cast the packed kernel had but the tuple form lacked)."""
+        als, _, _ = _als_fixture(rng)
+        cols = np.array([[1, 5, 0, 0]], np.float32)
+        vals = np.array([[2.0, 3.0, 0, 0]], np.float32)
+        a = als.fold_in((cols, vals))
+        b = als.fold_in((cols.astype(np.int32), vals))
+        np.testing.assert_array_equal(a, b)
+
+    def test_out_of_range_id_is_a_no_op(self, rng):
+        """A corrupt id past pack-time validation must not silently
+        score against the clipped LAST item (review-found): the fold-in
+        weight masks out-of-range entries to nothing."""
+        als, _, _ = _als_fixture(rng)
+        good = als.fold_in((np.array([[1, 5]], np.int32),
+                            np.array([[2.0, 3.0]], np.float32)))
+        with_bad = als.fold_in((np.array([[1, 5, 10_000]], np.int32),
+                                np.array([[2.0, 3.0, 4.0]], np.float32)))
+        np.testing.assert_allclose(with_bad, good, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sparse serving: padded sparse batches through the bucket ladder
+# ---------------------------------------------------------------------------
+
+class TestSparseServing:
+    def test_server_bucket_ladder_serves_padded_sparse(self, rng):
+        from dislib_tpu.serving import PredictServer, SparseFoldInPipeline
+        als, v, _ = _als_fixture(rng)
+        pipe = SparseFoldInPipeline(als, nse_cap=16)
+        new = np.where(rng.rand(5, 20) < 0.4,
+                       rng.rand(5, 4).astype(np.float32) @ v.T, 0.0) \
+            .astype(np.float32)
+        packed = pipe.pack(new)
+        assert packed.shape == (5, 32)
+        with PredictServer(pipeline=pipe, buckets=(1, 8, 64)) as srv:
+            prof.reset_counters()
+            out = srv.predict(packed)
+            stats = srv.stats()
+        assert stats["dispatches_per_batch_max"] == 1
+        np.testing.assert_allclose(out, als.fold_in(new), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_pack_guards(self, rng):
+        from dislib_tpu.serving import SparseFoldInPipeline
+        als, _, _ = _als_fixture(rng)
+        pipe = SparseFoldInPipeline(als, nse_cap=2)
+        dense_row = np.ones((1, 20), np.float32)     # 20 observed > cap 2
+        with pytest.raises(ValueError, match="nse_cap"):
+            pipe.pack(dense_row)
+        with pytest.raises(ValueError, match="out of range"):
+            pipe.pack([(np.array([25]), np.array([1.0]))])
+        with pytest.raises(ValueError, match="pack"):
+            pipe.predict_bucket(np.zeros((1, 7), np.float32), 8)
+
+    def test_padded_rows_are_zero_observation_users(self, rng):
+        """A pad row (all zeros) solves λI·u = 0 → zero predictions —
+        it can never affect real rows (the bucket-pad contract)."""
+        from dislib_tpu.serving import SparseFoldInPipeline
+        als, v, _ = _als_fixture(rng)
+        pipe = SparseFoldInPipeline(als, nse_cap=8)
+        one = pipe.pack(np.where(rng.rand(1, 20) < 0.3, 1.0, 0.0)
+                        .astype(np.float32))
+        alone = pipe.predict_bucket(one, 8)
+        assert alone.shape[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# the pipeline proof: fit -> fold-in -> serve, zero densify, zero transfers
+# ---------------------------------------------------------------------------
+
+class TestZeroDensifyPipeline:
+    def test_fit_foldin_serve_never_densifies(self, rng, monkeypatch):
+        """The WHOLE sparse recommender pipeline under a densify ban:
+        to_dense / the dense escape hatch raising proves zero
+        densifications of the ratings matrix, end to end."""
+        from dislib_tpu.recommendation import ALS
+        from dislib_tpu.serving import PredictServer, SparseFoldInPipeline
+
+        def boom(*a, **k):
+            raise AssertionError("pipeline densified the ratings matrix")
+        monkeypatch.setattr(SparseArray, "to_dense", boom)
+        monkeypatch.setattr(SparseArray, "_data", property(boom))
+        u = rng.rand(30, 4).astype(np.float32)
+        v = rng.rand(20, 4).astype(np.float32)
+        r = np.where(rng.rand(30, 20) < 0.4, u @ v.T, 0.0) \
+            .astype(np.float32)
+        xs = SparseArray.from_scipy(sp.csr_matrix(r))
+        als = ALS(n_f=4, lambda_=0.002, max_iter=20, tol=1e-7,
+                  random_state=0).fit(xs)
+        assert als.rmse_ < 0.1
+        pipe = SparseFoldInPipeline(als, nse_cap=16)
+        new = np.where(rng.rand(2, 20) < 0.4, 1.0, 0.0).astype(np.float32)
+        with PredictServer(pipeline=pipe, buckets=(1, 8)) as srv:
+            out = srv.predict(pipe.pack(new))
+        assert out.shape == (2, 20) and np.isfinite(out).all()
+
+    def test_model_boundary_crosses_at_zero_transfers(self, rng):
+        """After warmup, the fit → fold-in → serve DEVICE boundary is
+        transfer-free: counter-asserted AND under
+        jax.transfer_guard('disallow') — the PR-6 pipeline-boundary
+        discipline extended to the sparse recommender."""
+        from dislib_tpu.recommendation import ALS
+        u = rng.rand(30, 4).astype(np.float32)
+        v = rng.rand(20, 4).astype(np.float32)
+        r = np.where(rng.rand(30, 20) < 0.4, u @ v.T, 0.0) \
+            .astype(np.float32)
+        xs = SparseArray.from_scipy(sp.csr_matrix(r))
+        als = ALS(n_f=4, lambda_=0.002, max_iter=10, tol=1e-7,
+                  random_state=0).fit(xs)
+        from dislib_tpu.recommendation.als import _fold_in_pack
+        cols, vals = _fold_in_pack(
+            np.where(rng.rand(2, 20) < 0.4, 1.0, 0.0).astype(np.float32),
+            20)
+        jax.block_until_ready(als._fold_in_device((cols, vals)))  # warm
+        prof.reset_counters()
+        with jax.transfer_guard("disallow"):
+            rep = xs.sharded()          # the fit's backing: already placed
+            preds = als._fold_in_device((cols, vals))
+            assert rep.nnz >= 0
+        jax.block_until_ready(preds)
+        assert prof.transfer_count() == 0
+        assert np.isfinite(np.asarray(preds)).all()
+
+
+# ---------------------------------------------------------------------------
+# the sparse elastic rung (the PR-10 ladder's mesh-shrink tier)
+# ---------------------------------------------------------------------------
+
+class TestSparseElastic:
+    def test_sparse_kmeans_mesh_shrink_heals_to_oracle(self, rng, tmp_path):
+        from conftest import skip_unless_devices
+        skip_unless_devices(8)
+        from dislib_tpu.cluster import KMeans
+        from dislib_tpu.utils import faults
+        from dislib_tpu.utils.checkpoint import FitCheckpoint
+        xm = rng.rand(200, 6).astype(np.float32)
+        xm[xm < np.median(xm)] = 0
+        init = np.ascontiguousarray(xm[[0, 70, 140]])
+        kw = dict(n_clusters=3, init=init, max_iter=12, tol=0.0)
+        ds.init((8, 1), devices=jax.devices()[:8])
+        full = KMeans(**kw).fit(SparseArray.from_scipy(sp.csr_matrix(xm)))
+        ds.init((8, 1), devices=jax.devices()[:8])
+        pol = faults.FaultAtTier(tiers=2, at_chunk=2, max_restarts=3,
+                                 elastic_attempts=1)
+        res = KMeans(**kw).fit(
+            SparseArray.from_scipy(sp.csr_matrix(xm)),
+            checkpoint=FitCheckpoint(str(tmp_path / "k.npz"), every=2),
+            health=pol)
+        assert pol.healed and res.fit_info_["mesh_shrinks"] == 1
+        assert ds.get_mesh().shape["rows"] == 4
+        np.testing.assert_allclose(res.centers_, full.centers_,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sparse_als_mesh_shrink_heals_to_oracle(self, rng, tmp_path):
+        from conftest import skip_unless_devices
+        skip_unless_devices(8)
+        from dislib_tpu.recommendation import ALS
+        from dislib_tpu.utils import faults
+        from dislib_tpu.utils.checkpoint import FitCheckpoint
+        u, v = rng.rand(30, 4), rng.rand(20, 4)
+        r = ((u @ v.T) * (rng.rand(30, 20) < 0.6)).astype(np.float32)
+        akw = dict(n_f=4, max_iter=8, tol=-1.0, random_state=0)
+        ds.init((8, 1), devices=jax.devices()[:8])
+        full = ALS(**akw).fit(SparseArray.from_scipy(sp.csr_matrix(r)))
+        ds.init((8, 1), devices=jax.devices()[:8])
+        pol = faults.FaultAtTier(tiers=2, at_chunk=2, max_restarts=3,
+                                 elastic_attempts=1)
+        res = ALS(**akw).fit(
+            SparseArray.from_scipy(sp.csr_matrix(r)),
+            checkpoint=FitCheckpoint(str(tmp_path / "a.npz"), every=2),
+            health=pol)
+        assert pol.healed and res.fit_info_["mesh_shrinks"] == 1
+        assert res.fit_info_["escalations"]["elastic"] == 1
+        np.testing.assert_allclose(res.users_, full.users_,
+                                   rtol=2e-2, atol=2e-3)

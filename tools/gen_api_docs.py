@@ -29,6 +29,10 @@ SECTIONS = [
      ["load_txt_file", "load_svmlight_file", "load_npy_file",
       "load_mdcrd_file", "save_txt"]),
     ("Array / SparseArray", "dislib_tpu", ["Array", "SparseArray"]),
+    ("Sharded sparse fast path", "dislib_tpu.data.sparse",
+     ["ShardedSparse", "nse_quantum"]),
+    ("Sparse matmul (masked-psum SpMM)", "dislib_tpu.ops.spmm",
+     ["spmm", "spmm_steps", "spmm_memory_analysis"]),
     ("Blocked linear algebra", "dislib_tpu",
      ["matmul", "kron", "svd", "qr", "polar", "tsqr", "random_svd",
       "lanczos_svd"]),
@@ -74,7 +78,8 @@ SECTIONS = [
      ["Adoption", "AdoptionRejected", "adopt_latest", "generation_token"]),
     ("Serving", "dislib_tpu.serving",
      ["ServePipeline", "PredictServer", "ServeResponse", "ModelPool",
-      "ProgramCache", "bucket_ladder", "bucket_for", "split_rows"]),
+      "ProgramCache", "bucket_ladder", "bucket_for", "split_rows",
+      "SparseFoldInPipeline", "pack_sparse_rows"]),
     ("Ingest quarantine", "dislib_tpu",
      ["QuarantineReport", "QuarantineLedger", "last_quarantine_report",
       "quarantine_ledger"]),
