@@ -50,7 +50,8 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array, _repad, fused_kernel
+from dislib_tpu.data.array import Array, _repad, ensure_canonical, \
+    fused_kernel
 from dislib_tpu.ops import distances_sq
 from dislib_tpu.ops.base import precise
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
@@ -172,7 +173,29 @@ class CascadeSVM(BaseEstimator):
         nodes0 = _pack_nodes([np.arange(s, min(s + part, m))
                               for s in range(0, m, part)])
 
-        box = {"sv_idx": None, "last_w": None}
+        box = {"sv_idx": None, "last_w": None, "x": x,
+               "xv": xv, "yv": yv, "ell": ell}
+
+        def rebind(mesh):
+            # elastic re-staging (round 16): the cascade's node solves
+            # read the staged rows, so a mesh change re-stages them —
+            # dense re-canonicalizes x and re-pads y to the new quantum,
+            # the sparse ELL layout re-lands its backing (the host-CSR
+            # fallback and `k_of` are mesh-independent and stay put)
+            if sparse_in:
+                if mesh is not None:
+                    box["x"].sharded(mesh)
+                    if x_csr is None:
+                        box["ell"] = box["x"].ell()
+                return
+            from dislib_tpu.data.array import ensure_canonical
+            xb = box["x"]
+            box["x"] = xb.force() if mesh is None else ensure_canonical(xb)
+            if mesh is not None:
+                xv2 = box["x"]._data
+                box["xv"] = xv2
+                box["yv"] = jnp.asarray(
+                    np.pad(y_pm, (0, xv2.shape[0] - m)))
         self.converged_ = False
         fp = digest = None
         if checkpoint is not None:
@@ -213,7 +236,8 @@ class CascadeSVM(BaseEstimator):
         loop = _fitloop.ChunkedFitLoop(
             "csvm", checkpoint=checkpoint, health=health,
             max_iter=self.max_iter, chunk_iters=1,
-            save_every=checkpoint.every if checkpoint is not None else 1)
+            save_every=checkpoint.every if checkpoint is not None else 1,
+            elastic=rebind)
 
         def init(rem):
             box.update(sv_idx=None, sv_alpha=None, last_w=None)
@@ -244,11 +268,13 @@ class CascadeSVM(BaseEstimator):
                 nodes = nodes0
             # cascade reduction to one node
             while True:
-                alphas, objs = _solve_level_batched(xv, yv, nodes,
+                alphas, objs = _solve_level_batched(box["xv"], box["yv"],
+                                                    nodes,
                                                     float(self.c), n,
                                                     self.kernel, gamma,
                                                     k_of=k_of, y_host=y_pm,
-                                                    ell=ell, solver=solver)
+                                                    ell=box["ell"],
+                                                    solver=solver)
                 if nodes.shape[0] == 1:
                     break
                 nodes = self._merge_level(nodes, np.asarray(alphas))
@@ -308,13 +334,13 @@ class CascadeSVM(BaseEstimator):
         # gather SV rows only (n_sv × n, never the dataset): from the host
         # CSR on the sparse path, on device for dense inputs
         if sparse_in:
-            if ell is not None:
+            if box["ell"] is not None:
                 self._sv_x = _fetch(_ell_rows_dense(
-                    ell[0], ell[1], jnp.asarray(sv_idx), n))
+                    box["ell"][0], box["ell"][1], jnp.asarray(sv_idx), n))
             else:
                 self._sv_x = np.asarray(x_csr[sv_idx].toarray(), np.float32)
         else:
-            self._sv_x = _fetch(x._data[jnp.asarray(sv_idx), : n])
+            self._sv_x = _fetch(box["x"]._data[jnp.asarray(sv_idx), : n])
         self._sv_y = y_pm[sv_idx]
         self._gamma_fit = gamma
         self.support_vectors_count_ = len(sv_idx)
@@ -355,6 +381,9 @@ class CascadeSVM(BaseEstimator):
                                    self.kernel, self._gamma_fit)
             return Array._from_logical_padded(_repad(dec, (x.shape[0], 1)),
                                               (x.shape[0], 1))
+        # serve on the CURRENT mesh: an input built before an elastic
+        # resize re-lands on device (never the host) — round 16
+        x = ensure_canonical(x)
         sv_x, sv_y, sv_alpha, gamma = self._predict_leaves(
             self._sv_x, self._sv_y, self._sv_alpha, self._gamma_leaf())
         return fused_kernel(
@@ -378,6 +407,7 @@ class CascadeSVM(BaseEstimator):
             out = jnp.asarray(labels.astype(dt)[:, None])
             return Array._from_logical_padded(_repad(out, (x.shape[0], 1)),
                                               (x.shape[0], 1))
+        x = ensure_canonical(x)     # serve on the CURRENT mesh (round 16)
         sv_x, sv_y, sv_alpha, gamma, classes = self._predict_leaves(
             self._sv_x, self._sv_y, self._sv_alpha, self._gamma_leaf(),
             self._classes_leaf())

@@ -141,58 +141,84 @@ class Daura(BaseEstimator):
         """Chunked fit: `every` cluster extractions per dispatch, the
         greedy state snapshotted between chunks.  The ring tier is picked
         by the same policy as the plain fit (scale-out + fault tolerance
-        compose); the pad width in the fingerprint pins the tier so a
-        resume can't mix label paddings."""
+        compose).  The greedy state is all frame ids and −1/False fills —
+        pad-width independent — so the pad width is NOT fingerprinted
+        (round 16): a snapshot resumes on any mesh/tier and the elastic
+        rebind re-stages the extraction closure for the new topology."""
         from dislib_tpu.utils.checkpoint import data_digest, validate_snapshot
         cutoff = float(self.cutoff)
-        ring = ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX)
-        if ring:
-            mp = x._data.shape[0]
-            sched = _ov.resolve()
-            _prof.count_schedule("ring_neigh", sched)
+        m = x.shape[0]
+        box = {"x": x}
 
-            def extract(active, labels, medoids, cid):
-                return _daura_extract_ring(
-                    x._data, cutoff, n_atoms, mesh, active, labels,
-                    medoids, cid, max_new=checkpoint.every, overlap=sched)
-        else:
-            # tiles-padded row count, computed arithmetically (pad_to_tiles'
-            # own formula) — no eager padded copy of the dataset
-            mp = -(-x._data.shape[0] // _tiled.TILE) * _tiled.TILE
-            # single-device tiled tier: the pallas route picks the inner
-            # kernel (no collective to overlap)
-            sched = _ov.resolve()
-            _prof.count_schedule("tiled_neigh", sched)
+        def _stage(cur_mesh):
+            xd = box["x"]._data
+            if ring_auto(_RING, cur_mesh, xd.shape[0] > _DENSE_MAX):
+                mp = xd.shape[0]
+                sched = _ov.resolve()
+                _prof.count_schedule("ring_neigh", sched)
 
-            def extract(active, labels, medoids, cid):
-                return _daura_extract_tiled(
-                    x._data, x.shape, cutoff, n_atoms, _tiled.TILE, active,
-                    labels, medoids, cid, max_new=checkpoint.every,
-                    use_pallas=(sched == "pallas"))
-        fp = np.asarray([x.shape[0], x.shape[1], cutoff, mp], np.float64)
+                def extract(active, labels, medoids, cid):
+                    return _daura_extract_ring(
+                        xd, cutoff, n_atoms, cur_mesh, active, labels,
+                        medoids, cid, max_new=checkpoint.every,
+                        overlap=sched)
+            else:
+                # tiles-padded row count, computed arithmetically
+                # (pad_to_tiles' own formula) — no eager padded copy
+                mp = -(-xd.shape[0] // _tiled.TILE) * _tiled.TILE
+                # single-device tiled tier: the pallas route picks the
+                # inner kernel (no collective to overlap)
+                sched = _ov.resolve()
+                _prof.count_schedule("tiled_neigh", sched)
+
+                def extract(active, labels, medoids, cid):
+                    return _daura_extract_tiled(
+                        xd, x.shape, cutoff, n_atoms, _tiled.TILE, active,
+                        labels, medoids, cid, max_new=checkpoint.every,
+                        use_pallas=(sched == "pallas"))
+            box.update(mp=mp, extract=extract)
+
+        _stage(mesh)
+        _data_hook = _fitloop.data_rebind(box)
+
+        def rebind(new_mesh):
+            _data_hook(new_mesh)        # force chains / re-canonicalize x
+            if new_mesh is not None:
+                _stage(new_mesh)
+
+        fp = np.asarray([x.shape[0], x.shape[1], cutoff], np.float64)
         digest = data_digest(x._data)
         loop = _fitloop.ChunkedFitLoop("daura", checkpoint=checkpoint,
-                                       health=health)
+                                       health=health, elastic=rebind)
 
         def init(rem):
+            mp = box["mp"]
             return _fitloop.LoopState(
                 (jnp.full((mp,), -1, jnp.int32),),
-                extra=(jnp.arange(mp, dtype=jnp.int32) < x.shape[0],
+                extra=(jnp.arange(mp, dtype=jnp.int32) < m,
                        jnp.full((mp,), -1, jnp.int32), jnp.int32(0)))
 
         def restore(snap, rem):
             validate_snapshot(snap, fp, digest)
+            mp = box["mp"]
+            # the greedy state stores frame ids (< m) with −1 fills and a
+            # False active mask on pads — crop to the logical rows and
+            # re-pad for THIS pad width, exact under any resize
+            lab = np.pad(np.asarray(snap["labels"])[:m], (0, mp - m),
+                         constant_values=-1)
+            act = np.pad(np.asarray(snap["active"])[:m], (0, mp - m))
+            med = np.pad(np.asarray(snap["medoids"])[:m], (0, mp - m),
+                         constant_values=-1)
             return _fitloop.LoopState(
-                (jnp.asarray(snap["labels"]),),
-                extra=(jnp.asarray(snap["active"]),
-                       jnp.asarray(snap["medoids"]),
+                (jnp.asarray(lab),),
+                extra=(jnp.asarray(act), jnp.asarray(med),
                        jnp.int32(int(snap["cid"]))))
 
         def step(st, chunk):
             (labels,) = st.carries
             active, medoids, cid = st.extra
-            active, labels, medoids, cid, hvec = extract(active, labels,
-                                                         medoids, cid)
+            active, labels, medoids, cid, hvec = box["extract"](
+                active, labels, medoids, cid)
             # state deferred: the watchdogged hvec read (the chunk force
             # point) precedes the active-set convergence fetch
             return _fitloop.ChunkOutcome(

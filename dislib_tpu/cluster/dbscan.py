@@ -176,61 +176,96 @@ class DBSCAN(BaseEstimator):
         rather than mixing label paddings)."""
         from dislib_tpu.utils.checkpoint import data_digest, validate_snapshot
         eps, ms = float(self.eps), int(self.min_samples)
-        if ring_auto(_RING, mesh, x._data.shape[0] > _DENSE_MAX):
-            mp = x._data.shape[0]
-            sched = _ov.resolve()
-            _prof.count_schedule("ring_neigh", sched)
+        m = x.shape[0]
+        box = {"x": x}
 
-            def setup():
-                return _dbscan_setup_ring(x._data, x.shape, eps, ms, mesh,
-                                          overlap=sched)
+        def _stage(cur_mesh):
+            # tier selection + the tier closures, re-run by the elastic
+            # rebind: a mesh change re-picks the ring/tiled tier for the
+            # NEW topology and re-binds the closures to the re-laid-out
+            # backing (the snapshot format is tier-independent — labels
+            # are core row ids with a sentinel the restore re-bases)
+            xd = box["x"]._data
+            if ring_auto(_RING, cur_mesh, xd.shape[0] > _DENSE_MAX):
+                mp = xd.shape[0]
+                sched = _ov.resolve()
+                _prof.count_schedule("ring_neigh", sched)
 
-            def propagate(lab, core):
-                return _dbscan_propagate_ring(
-                    x._data, eps, lab, core, mesh,
-                    max_rounds=checkpoint.every, overlap=sched)
+                def setup():
+                    return _dbscan_setup_ring(xd, x.shape, eps, ms,
+                                              cur_mesh, overlap=sched)
 
-            def finalize(lab, core):
-                return _dbscan_finalize_ring(x._data, x.shape, eps, lab,
-                                             core, mesh, overlap=sched)
-        else:
-            mp = -(-x._data.shape[0] // _tiled.TILE) * _tiled.TILE
-            # single-device tiled tier: the pallas route picks the inner
-            # kernel (no collective to overlap)
-            sched = _ov.resolve()
-            _prof.count_schedule("tiled_neigh", sched)
-            pall = sched == "pallas"
+                def propagate(lab, core):
+                    return _dbscan_propagate_ring(
+                        xd, eps, lab, core, cur_mesh,
+                        max_rounds=checkpoint.every, overlap=sched)
 
-            def setup():
-                return _dbscan_setup_tiled(x._data, x.shape, eps, ms,
-                                           _tiled.TILE, use_pallas=pall)
+                def finalize(lab, core):
+                    return _dbscan_finalize_ring(xd, x.shape, eps, lab,
+                                                 core, cur_mesh,
+                                                 overlap=sched)
+            else:
+                mp = -(-xd.shape[0] // _tiled.TILE) * _tiled.TILE
+                # single-device tiled tier: the pallas route picks the
+                # inner kernel (no collective to overlap)
+                sched = _ov.resolve()
+                _prof.count_schedule("tiled_neigh", sched)
+                pall = sched == "pallas"
 
-            def propagate(lab, core):
-                return _dbscan_propagate_tiled(
-                    x._data, x.shape, eps, lab, core, _tiled.TILE,
-                    max_rounds=checkpoint.every, use_pallas=pall)
+                def setup():
+                    return _dbscan_setup_tiled(xd, x.shape, eps, ms,
+                                               _tiled.TILE, use_pallas=pall)
 
-            def finalize(lab, core):
-                return _dbscan_finalize_tiled(x._data, x.shape, eps, lab,
-                                              core, _tiled.TILE,
-                                              use_pallas=pall)
-        fp = np.asarray([x.shape[0], x.shape[1], eps, ms, mp], np.float64)
+                def propagate(lab, core):
+                    return _dbscan_propagate_tiled(
+                        xd, x.shape, eps, lab, core, _tiled.TILE,
+                        max_rounds=checkpoint.every, use_pallas=pall)
+
+                def finalize(lab, core):
+                    return _dbscan_finalize_tiled(xd, x.shape, eps, lab,
+                                                  core, _tiled.TILE,
+                                                  use_pallas=pall)
+            box.update(mp=mp, setup=setup, propagate=propagate,
+                       finalize=finalize)
+
+        _stage(mesh)
+        _data_hook = _fitloop.data_rebind(box)
+
+        def rebind(new_mesh):
+            _data_hook(new_mesh)        # force chains / re-canonicalize x
+            if new_mesh is not None:
+                _stage(new_mesh)
+
+        # the pad width is NOT fingerprinted (round 16): labels re-base
+        # their sentinel on restore, so a snapshot resumes on any
+        # mesh/tier instead of refusing on a pad-width mismatch
+        fp = np.asarray([x.shape[0], x.shape[1], eps, ms], np.float64)
         digest = data_digest(x._data)
         loop = _fitloop.ChunkedFitLoop("dbscan", checkpoint=checkpoint,
-                                       health=health)
+                                       health=health, elastic=rebind)
 
         def init(rem):
-            core, label = setup()
+            core, label = box["setup"]()
             return _fitloop.LoopState((label,), extra=core)
 
         def restore(snap, rem):
             validate_snapshot(snap, fp, digest)
-            return _fitloop.LoopState((jnp.asarray(snap["label"]),),
-                                      extra=jnp.asarray(snap["core"]))
+            mp = box["mp"]
+            lab = np.asarray(snap["label"])
+            core = np.asarray(snap["core"])
+            # sentinel re-base: labels are core ROW ids (always < m) with
+            # "no label" = the WRITER's pad width; crop to the logical
+            # rows, re-base the sentinel to THIS pad width, and re-pad —
+            # pad rows are never core, so sentinel/False fills are exact
+            lab = np.where(lab[:m] < m, lab[:m], mp).astype(lab.dtype)
+            lab = np.pad(lab, (0, mp - m), constant_values=mp)
+            core = np.pad(core[:m], (0, mp - m))
+            return _fitloop.LoopState((jnp.asarray(lab),),
+                                      extra=jnp.asarray(core))
 
         def step(st, chunk):
             (label,) = st.carries
-            label, changed, hvec = propagate(label, st.extra)
+            label, changed, hvec = box["propagate"](label, st.extra)
             # state deferred: the watchdogged hvec read (the chunk force
             # point) precedes the `changed` convergence fetch
             return _fitloop.ChunkOutcome(
@@ -247,7 +282,7 @@ class DBSCAN(BaseEstimator):
         st = loop.run(init=init, step=step, restore=restore,
                       snapshot=snapshot)
         self.fit_info_ = loop.info
-        return finalize(st.carries[0], st.extra), st.extra
+        return box["finalize"](st.carries[0], st.extra), st.extra
 
 
 @partial(jax.jit, static_argnames=("shape", "min_samples"))

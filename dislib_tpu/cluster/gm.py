@@ -25,7 +25,7 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array, fused_kernel
+from dislib_tpu.data.array import Array, ensure_canonical, fused_kernel
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
@@ -271,6 +271,9 @@ class GaussianMixture(BaseEstimator):
         """Component index per row — a fusion-graph node, so a scaler →
         predict pipeline is ONE cached dispatch (the serving hot path)."""
         self._check_fitted()
+        # serve on the CURRENT mesh: an input built before an elastic
+        # resize re-lands on device (never the host) — round 16
+        x = ensure_canonical(x)
         weights, means, covs = self._predict_leaves(
             self.weights_, self.means_, self.covariances_)
         return fused_kernel(

@@ -36,7 +36,8 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array, _repad, fused_kernel
+from dislib_tpu.data.array import Array, _repad, ensure_canonical, \
+    fused_kernel
 from dislib_tpu.data.sparse import SparseArray, _spmm
 from dislib_tpu.ops import distances_sq as _distances_sq
 from dislib_tpu.parallel import mesh as _mesh
@@ -257,6 +258,9 @@ class KMeans(BaseEstimator):
             labels = jnp.argmin(d, axis=1).astype(jnp.int32)[:, None]
             return Array._from_logical_padded(_repad(labels, (x.shape[0], 1)),
                                               (x.shape[0], 1))
+        # serve on the CURRENT mesh: an input built before an elastic
+        # resize re-lands on device (never the host) — round 16
+        x = ensure_canonical(x)
         (centers,) = self._predict_leaves(self.centers_)
         return fused_kernel(
             _kmeans_predict_kernel, (x.shape,), (x, centers),

@@ -32,7 +32,10 @@ Schedule selection (``DSLIB_RECHUNK_SCHEDULE`` overrides ``"auto"``):
 cross-layout collectives to the SPMD partitioner), ``"panels"`` = the
 explicit exchange, ``"deviceput"`` = the runtime copy.  ``"auto"`` picks
 the fused path for same-layout operands, panels for a layout change over
-the same device set, deviceput otherwise.  ``DSLIB_RECHUNK_PANELS``
+the same device set AND for a device-set expansion (the grow-back
+schedule: panels assemble every target block on the source devices, new
+devices each receive exactly one block — :func:`panel_grow_rechunk`),
+deviceput otherwise.  ``DSLIB_RECHUNK_PANELS``
 (default 4) sets k, the per-source-rank panel count.
 
 The pad-and-mask invariant is re-asserted by EVERY schedule: the region
@@ -60,9 +63,9 @@ from dislib_tpu.utils import profiling as _prof
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
 __all__ = [
-    "requantize_body", "repad_axis", "panel_rechunk", "deviceput_rechunk",
-    "reshard", "panel_memory_analysis", "panel_comm_probe",
-    "reshard_sparse", "pick_sparse_schedule",
+    "requantize_body", "repad_axis", "panel_rechunk", "panel_grow_rechunk",
+    "deviceput_rechunk", "reshard", "panel_memory_analysis",
+    "panel_comm_probe", "reshard_sparse", "pick_sparse_schedule",
 ]
 
 SCHEDULES = ("auto", "xla", "panels", "deviceput")
@@ -338,6 +341,195 @@ def panel_rechunk(data, logical_shape, dst_mesh, panels=None, overlap=None):
         out_pshape, NamedSharding(dst_mesh, P(*_mesh.AXIS_NAMES)), bufs)
 
 
+def _grow_coord_tables(src_mesh: Mesh, dst_mesh: Mesh):
+    """Per-(slot, source-linear-index) target (row, col) coordinates for
+    the GROW exchange: source device ``i`` assembles the target block of
+    destination flat index ``i + q * n_src`` in slot ``q`` (round-robin,
+    so the ``ceil(n_dst / n_src)`` extra blocks spread evenly over the
+    source devices).  An out-of-range slot duplicates block (0, 0) — the
+    rewrap drops it."""
+    n_src = int(src_mesh.devices.size)
+    n_dst = int(dst_mesh.devices.size)
+    cols_d = int(dst_mesh.devices.shape[1])
+    slots = -(-n_dst // n_src)
+    tr = np.zeros((slots, n_src), np.int32)
+    tc = np.zeros((slots, n_src), np.int32)
+    for q in range(slots):
+        for i in range(n_src):
+            t = i + q * n_src
+            if t < n_dst:
+                tr[q, i], tc[q, i] = divmod(t, cols_d)
+    return tr, tc
+
+
+@partial(_pjit, static_argnames=("logical_shape", "out_pshape", "src_mesh",
+                                 "dst_shape", "tr_key", "tc_key", "steps",
+                                 "overlap"),
+         name="rechunk_panels_grow")
+def _panel_exchange_grow(data, logical_shape, out_pshape, src_mesh,
+                         dst_shape, tr_key, tc_key, steps, overlap="db"):
+    """The grow-direction panel exchange: the SAME masked-psum panel
+    broadcasts as :func:`_panel_exchange` (one jitted shard_map over the
+    SOURCE mesh, ``ops/overlap.panel_pipeline`` schedule), but every
+    source device assembles ``slots = len(tr_key)`` TARGET blocks from
+    each passing panel instead of one — the target grid has more devices
+    than the source, so the blocks for the new devices must be built
+    somewhere before they can be placed.  A separate jit from the
+    shrink/relayout exchange: its output arity depends on the slot
+    count, and keeping it apart leaves the existing compiled paths (and
+    their cache keys) untouched."""
+    m, n = logical_shape
+    rows_s, cols_s = src_mesh.shape[_mesh.ROWS], src_mesh.shape[_mesh.COLS]
+    rows_d, cols_d = dst_shape
+    m_loc1, n_loc1 = data.shape[0] // rows_s, data.shape[1] // cols_s
+    m_loc2, n_loc2 = out_pshape[0] // rows_d, out_pshape[1] // cols_d
+    j = steps // rows_s                     # panels per source row-rank
+    h = m_loc1 // j                         # panel height (global rows)
+    slots = len(tr_key)
+    tr_tab = jnp.asarray(np.asarray(tr_key, np.int32))
+    tc_tab = jnp.asarray(np.asarray(tc_key, np.int32))
+
+    def local(x_loc):
+        my_r = lax.axis_index(_mesh.ROWS)
+        my_c = lax.axis_index(_mesh.COLS)
+        my_lin = my_r * cols_s + my_c
+        coords = []                         # global coords per target slot
+        for q in range(slots):
+            row0 = tr_tab[q, my_lin] * m_loc2
+            col0 = tc_tab[q, my_lin] * n_loc2
+            coords.append((row0 + lax.iota(jnp.int32, m_loc2),
+                           col0 + lax.iota(jnp.int32, n_loc2)))
+
+        def fetch(t, prev):
+            del prev                        # panels slice by step
+            owner_r = t // j
+            pan = lax.dynamic_slice(x_loc, ((t % j) * h, 0), (h, n_loc1))
+            pan = jnp.where(my_r == owner_r, pan, jnp.zeros((), pan.dtype))
+            return lax.psum(pan, _mesh.ROWS)
+
+        def consume(t, acc, pan):
+            owner_r = t // j
+            gr0 = owner_r * m_loc1 + (t % j) * h  # panel's global rows
+            acc = list(acc)
+            for s in range(cols_s):         # ONE cols-broadcast per panel,
+                if cols_s > 1:              # shared by every slot's gather
+                    blk = jnp.where(my_c == s, pan,
+                                    jnp.zeros((), pan.dtype))
+                    blk = lax.psum(blk, _mesh.COLS)
+                else:
+                    blk = pan
+                gc0 = s * n_loc1
+                for q, (ri, ci) in enumerate(coords):
+                    r_in = (ri >= gr0) & (ri < gr0 + h)
+                    r_idx = jnp.clip(ri - gr0, 0, h - 1)
+                    c_in = (ci >= gc0) & (ci < gc0 + n_loc1)
+                    c_idx = jnp.clip(ci - gc0, 0, n_loc1 - 1)
+                    gathered = blk[r_idx][:, c_idx]
+                    acc[q] = jnp.where(r_in[:, None] & c_in[None, :],
+                                       gathered, acc[q])
+            return tuple(acc)
+
+        acc0 = tuple(
+            lax.pcast(jnp.zeros((m_loc2, n_loc2), x_loc.dtype),
+                      (_mesh.ROWS, _mesh.COLS), to="varying")
+            for _ in range(slots))
+        accs = _ov.panel_pipeline(steps, fetch(0, None), fetch, consume,
+                                  acc0, _ov.overlapped(overlap))
+        # re-assert the pad-and-mask invariant on every NEW canvas
+        out = []
+        for q, (ri, ci) in enumerate(coords):
+            keep = (ri < m)[:, None] & (ci < n)[None, :]
+            out.append(jnp.where(keep, accs[q],
+                                 jnp.zeros((), accs[q].dtype)))
+        return tuple(out)
+
+    return jax.shard_map(
+        local, mesh=src_mesh,
+        in_specs=P(_mesh.ROWS, _mesh.COLS),
+        out_specs=(P(_mesh.ROWS, _mesh.COLS),) * slots,
+        check_vma=True,
+    )(data)
+
+
+def panel_grow_supported(data, dst_mesh) -> bool:
+    """True when the grow-direction panel exchange can run: the source
+    backing passes the same NamedSharding/addressability/divisibility
+    gates as :func:`panel_supported`, the target device set strictly
+    CONTAINS the source's (elastic grow-back), and every target device
+    is addressable from this process (the rewrap places one block per
+    new device)."""
+    sharding = getattr(data, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return False
+    src_mesh = sharding.mesh
+    if not isinstance(src_mesh, Mesh) or \
+            tuple(src_mesh.axis_names) != _mesh.AXIS_NAMES:
+        return False
+    if not getattr(data, "is_fully_addressable", False):
+        return False
+    rows_s = src_mesh.shape[_mesh.ROWS]
+    cols_s = src_mesh.shape[_mesh.COLS]
+    if data.shape[0] % rows_s or data.shape[1] % cols_s:
+        return False
+    src_devs = set(src_mesh.devices.flat)
+    dst_devs = set(dst_mesh.devices.flat)
+    return src_devs < dst_devs and \
+        dst_devs <= set(jax.local_devices())
+
+
+def panel_grow_rechunk(data, logical_shape, dst_mesh, panels=None,
+                       overlap=None):
+    """The grow-direction panel reshard (device-set EXPANSION — the
+    elastic grow-back): ONE jitted panel-exchange program over the
+    SOURCE mesh assembling every target block (see
+    :func:`_panel_exchange_grow`), then the placement pass — a block
+    whose target device already holds a source shard rewraps ZERO-COPY,
+    and each NEW device receives exactly its one block via a single
+    direct device-to-device put.  Per-device moved bytes are one target
+    block, not the deviceput fallback's partitioner-chosen schedule; the
+    host never sees the data either way."""
+    kw = _panel_args_grow(data, logical_shape, dst_mesh, panels, overlap)
+    _prof.count_schedule("rechunk_panels_grow", kw["overlap"])
+    outs = _panel_exchange_grow(data, **kw)
+    out_pshape = kw["out_pshape"]
+    src_flat = list(kw["src_mesh"].devices.flat)
+    dst_flat = list(dst_mesh.devices.flat)
+    n_src = len(src_flat)
+    by_dev = {}
+    for q, arr in enumerate(outs):
+        per_src = {s.device: s.data for s in arr.addressable_shards}
+        for i, d_src in enumerate(src_flat):
+            t = i + q * n_src
+            if t >= len(dst_flat):
+                continue                # the duplicate (0, 0) filler slot
+            d_dst = dst_flat[t]
+            blk = per_src[d_src]
+            by_dev[d_dst] = blk if d_dst == d_src \
+                else jax.device_put(blk, d_dst)
+    bufs = [by_dev[d] for d in dst_flat]
+    return jax.make_array_from_single_device_arrays(
+        out_pshape, NamedSharding(dst_mesh, P(*_mesh.AXIS_NAMES)), bufs)
+
+
+def _panel_args_grow(data, logical_shape, dst_mesh, panels, overlap=None):
+    """Static argument pack for :func:`_panel_exchange_grow` — the
+    :func:`_panel_args` shape with the 2-D slot coordinate tables."""
+    src_mesh = data.sharding.mesh
+    out_pshape = _out_pshape(logical_shape, dst_mesh)
+    rows_s = src_mesh.shape[_mesh.ROWS]
+    m_loc1 = data.shape[0] // rows_s
+    j = _panels_per_rank(m_loc1, _requested_panels(panels))
+    tr, tc = _grow_coord_tables(src_mesh, dst_mesh)
+    return dict(logical_shape=tuple(int(s) for s in logical_shape),
+                out_pshape=out_pshape, src_mesh=src_mesh,
+                dst_shape=(dst_mesh.shape[_mesh.ROWS],
+                           dst_mesh.shape[_mesh.COLS]),
+                tr_key=tuple(tuple(int(v) for v in row) for row in tr),
+                tc_key=tuple(tuple(int(v) for v in row) for row in tc),
+                steps=rows_s * j,
+                overlap=_ov.resolve(overlap))
+
+
 def panel_comm_probe(data, logical_shape, dst_mesh, panels=None,
                      overlap="seq"):
     """Broadcast-only variant of the SAME panel-exchange program — the
@@ -414,8 +606,9 @@ def pick_schedule(data, dst_mesh, schedule="auto") -> str:
     an explicit ``schedule=`` wins; ``"auto"`` consults
     ``DSLIB_RECHUNK_SCHEDULE`` and then the layouts — same-layout
     operands take the jit requantize, a relayout over the same device
-    set takes the explicit panel exchange, a device-set change falls
-    back to the runtime copy."""
+    set (or a device-set EXPANSION, the elastic grow-back) takes the
+    explicit panel exchange, any other device-set change falls back to
+    the runtime copy."""
     if schedule not in SCHEDULES:
         raise ValueError(f"unknown rechunk schedule {schedule!r}: expected "
                          f"one of {SCHEDULES}")
@@ -430,7 +623,7 @@ def pick_schedule(data, dst_mesh, schedule="auto") -> str:
     if isinstance(sharding, NamedSharding) and \
             sharding == _mesh.data_sharding(dst_mesh):
         return "xla"
-    if panel_supported(data, dst_mesh):
+    if panel_supported(data, dst_mesh) or panel_grow_supported(data, dst_mesh):
         return "panels"
     return "deviceput"
 
@@ -443,14 +636,18 @@ def reshard(data, logical_shape, dst_mesh, schedule="auto", panels=None,
     exchange's loop schedule (None → the ``DSLIB_OVERLAP`` router)."""
     sched = pick_schedule(data, dst_mesh, schedule)
     if sched == "panels":
-        if not panel_supported(data, dst_mesh):
-            raise ValueError(
-                "schedule='panels' needs a fully-addressable source over "
-                "the named mesh whose device set covers the target mesh — "
-                "use schedule='deviceput' (or 'auto') for a device-set "
-                "change")
-        return panel_rechunk(data, logical_shape, dst_mesh, panels,
-                             overlap), sched
+        if panel_supported(data, dst_mesh):
+            return panel_rechunk(data, logical_shape, dst_mesh, panels,
+                                 overlap), sched
+        if panel_grow_supported(data, dst_mesh):
+            return panel_grow_rechunk(data, logical_shape, dst_mesh,
+                                      panels, overlap), sched
+        raise ValueError(
+            "schedule='panels' needs a fully-addressable source over "
+            "the named mesh whose device set covers — or is strictly "
+            "contained in (grow-back) — the target mesh's; use "
+            "schedule='deviceput' (or 'auto') for any other device-set "
+            "change")
     if sched == "deviceput":
         return deviceput_rechunk(data, logical_shape, dst_mesh), sched
     # "xla": one jitted requantize; any residual layout change is the SPMD

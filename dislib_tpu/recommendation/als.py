@@ -296,7 +296,7 @@ class ALS(BaseEstimator):
             raise IndexError(f"user_id {user_id} out of range")
         return self.users_[user_id] @ self.items_.T
 
-    def fold_in(self, ratings) -> np.ndarray:
+    def fold_in(self, ratings, top_n=None):
         """Score BRAND-NEW users against the trained item factors with no
         refit — the core recommendation-at-scale operation (ROADMAP item
         1's online fold-in): solve each new user's regularized normal
@@ -311,11 +311,21 @@ class ALS(BaseEstimator):
         pre-padded device pair ``(cols, vals)`` of shape (k, s) with
         (column 0, value 0) pads — the zero-host-transfer serving form.
 
-        Returns the (k, n_items) predicted-ratings ndarray."""
-        preds = self._fold_in_device(ratings)
-        return np.asarray(_fetch(preds))
+        ``top_n`` — when set, rank inside the SAME dispatch
+        (``lax.top_k`` fuses after the predict GEMM) and return the
+        ``(item_ids, scores)`` pair of (k, top_n) ndarrays instead of the
+        full score matrix: the host fetch shrinks from n_items to top_n
+        per user and no host-side argsort follows.
 
-    def _fold_in_device(self, ratings, precision=None):
+        Returns the (k, n_items) predicted-ratings ndarray, or the
+        ``(item_ids, scores)`` pair with ``top_n``."""
+        out = self._fold_in_device(ratings, top_n=top_n)
+        if top_n is not None:
+            ids, scores = out
+            return np.asarray(_fetch(ids)), np.asarray(_fetch(scores))
+        return np.asarray(_fetch(out))
+
+    def _fold_in_device(self, ratings, precision=None, top_n=None):
         """The device half of :meth:`fold_in`: returns the predictions
         as a device array, unfetched — what the sparse serving pipeline
         consumes (its response fetch is the one blessed sync)."""
@@ -333,7 +343,8 @@ class ALS(BaseEstimator):
             cols, vals = cols[None, :], vals[None, :]
         (items,) = self._predict_leaves(self.items_)
         _, preds = _als_fold_in(vals, cols, items, float(self.lambda_),
-                                int(self.n_f), _px.resolve(precision))
+                                int(self.n_f), _px.resolve(precision),
+                                top_n=int(top_n or 0))
         return preds
 
     def _check_fitted(self):
@@ -620,12 +631,16 @@ _SPARSE_CHUNK = 1 << 18
 _SPARSE_BUDGET = 1 << 22
 
 
-def _fold_in_body(vals, cols, items, lambda_, n_f, policy):
+def _fold_in_body(vals, cols, items, lambda_, n_f, policy, top_n=0):
     """The fold-in math: per-user regularized normal equations against
     the frozen item factors, then one predict GEMM — entirely traced, so
     the serving pipeline's packed variant fuses it into the same single
     dispatch.  (value != 0) doubles as the observation mask AND the pad
-    mask (pads are value-0 at the sentinel column)."""
+    mask (pads are value-0 at the sentinel column).
+
+    ``top_n`` > 0 ranks in the SAME program: ``lax.top_k`` fuses after
+    the predict GEMM, so a recommend-top-N serve stays one dispatch and
+    fetches (k, top_n) instead of the full (k, n_items) score matrix."""
     from dislib_tpu.ops import precision as px
     # weight = observed AND in-range: an out-of-range id (corrupt
     # request past the pack-time validation) becomes a no-op instead of
@@ -642,6 +657,9 @@ def _fold_in_body(vals, cols, items, lambda_, n_f, policy):
     chol = jax.scipy.linalg.cho_factor(a)
     factors = jax.scipy.linalg.cho_solve(chol, b[..., None])[..., 0]
     preds = px.pdot(factors, items.T, policy)              # (k, n_items)
+    if top_n:
+        scores, ids = lax.top_k(preds, int(top_n))
+        return factors, (ids.astype(jnp.int32), scores)
     return factors, preds
 
 
@@ -649,17 +667,18 @@ def _fold_in_body(vals, cols, items, lambda_, n_f, policy):
 # fitted model), and a dynamic scalar operand would cost one
 # host->device scalar transfer per served batch — the zero-transfer
 # serving boundary is counter-asserted in tests/test_spmm.py
-@partial(_pjit, static_argnames=("lambda_", "n_f", "policy"),
+@partial(_pjit, static_argnames=("lambda_", "n_f", "policy", "top_n"),
          name="als_fold_in")
 @precise
-def _als_fold_in(vals, cols, items, lambda_, n_f, policy):
-    return _fold_in_body(vals, cols, items, lambda_, n_f, policy)
+def _als_fold_in(vals, cols, items, lambda_, n_f, policy, top_n=0):
+    return _fold_in_body(vals, cols, items, lambda_, n_f, policy,
+                         top_n=top_n)
 
 
-@partial(_pjit, static_argnames=("lambda_", "n_f", "policy"),
+@partial(_pjit, static_argnames=("lambda_", "n_f", "policy", "top_n"),
          name="als_fold_in_packed")
 @precise
-def _als_fold_in_packed(buf, items, lambda_, n_f, policy):
+def _als_fold_in_packed(buf, items, lambda_, n_f, policy, top_n=0):
     """Serving entry: one PACKED sparse batch — each request row is
     ``[cols | vals]`` (2·s floats, pads (0, 0)) — split and cast ON
     DEVICE so a served batch stays ONE fused dispatch.  Column ids ride
@@ -667,4 +686,5 @@ def _als_fold_in_packed(buf, items, lambda_, n_f, policy):
     s = buf.shape[1] // 2
     cols = buf[:, :s].astype(jnp.int32)
     vals = buf[:, s:]
-    return _fold_in_body(vals, cols, items, lambda_, n_f, policy)
+    return _fold_in_body(vals, cols, items, lambda_, n_f, policy,
+                         top_n=top_n)

@@ -17,7 +17,7 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.base import BaseEstimator
-from dislib_tpu.data.array import Array, fused_kernel
+from dislib_tpu.data.array import Array, ensure_canonical, fused_kernel
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.ops.base import precise
 
@@ -43,6 +43,9 @@ class LinearRegression(BaseEstimator):
         """ŷ = x @ coef + intercept as a fusion-graph node — one cached
         dispatch for a whole scaler → predict chain (serving hot path)."""
         self._check_fitted()
+        # serve on the CURRENT mesh: an input built before an elastic
+        # resize re-lands on device (never the host) — round 16
+        x = ensure_canonical(x)
         coef, intercept = self._predict_leaves(self.coef_, self.intercept_)
         return fused_kernel(
             _linreg_predict_kernel, (x.shape,), (x, coef, intercept),

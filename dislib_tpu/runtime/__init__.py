@@ -36,8 +36,9 @@ from dislib_tpu.runtime.elastic import AsyncFetch, fetch, repad_rows
 from dislib_tpu.runtime.health import (ChunkGuard, HealthPolicy,
                                        NumericalDivergence, WatchdogTimeout)
 from dislib_tpu.runtime.preemption import (
-    Preempted, PreemptionWatcher, clear_preemption, last_signal,
-    preemption_requested, raise_if_preempted, request_preemption,
+    Preempted, PreemptionWatcher, capacity_target, clear_capacity,
+    clear_preemption, last_signal, preemption_requested,
+    raise_if_preempted, request_capacity, request_preemption,
 )
 from dislib_tpu.runtime.retry import Retry, is_transient_error, retry_call
 from dislib_tpu.runtime.fitloop import (ChunkedFitLoop, ChunkOutcome,
@@ -48,6 +49,7 @@ __all__ = [
     "Preempted", "PreemptionWatcher", "preemption_requested",
     "request_preemption", "clear_preemption", "last_signal",
     "raise_if_preempted",
+    "capacity_target", "request_capacity", "clear_capacity",
     "Retry", "retry_call", "is_transient_error",
     "repad_rows", "fetch", "AsyncFetch",
     "HealthPolicy", "ChunkGuard", "NumericalDivergence", "WatchdogTimeout",

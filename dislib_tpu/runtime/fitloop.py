@@ -56,12 +56,29 @@ decide WHAT each rollback does.  Streaming estimators call
 call, protocol identical, budget and cadence stream-wide) — the recipe
 that makes a new estimator resilient by construction
 (``cluster.kmeans.MiniBatchKMeans`` is the acceptance test).
+
+Elasticity is BIDIRECTIONAL (round-16): alongside the fault-driven
+shrink tier, the driver polls the **capacity watcher**
+(``runtime.preemption.capacity_target`` — the ``DSLIB_CAPACITY_FILE`` /
+``request_capacity`` level) at the same chunk boundaries as the
+preemption flag.  When the published device target drops, the fit
+snapshots and shrinks to the largest halving-reachable mesh that fits;
+when capacity RETURNS, it grows back toward the mesh it started on —
+state re-pads from the snapshot via ``repad_rows``, data re-lays out on
+device through the estimator's ``elastic`` hook (the ``ds.rechunk``
+deviceput/panels router — never the host).  Capacity resizes spend no
+rollback budget (nothing failed; the chunk just committed), but grows
+are bounded by ``HealthPolicy.grow_attempts`` against a flapping
+source.  Both directions report in ``info`` (``mesh_shrinks`` /
+``mesh_grows``) and the process-wide resilience counters.
 """
 
 from __future__ import annotations
 
 from dislib_tpu.runtime import health as _health
-from dislib_tpu.runtime.preemption import (preemption_requested,
+from dislib_tpu.runtime.health import NO_REMEDIATION
+from dislib_tpu.runtime.preemption import (capacity_target,
+                                           preemption_requested,
                                            raise_if_preempted)
 from dislib_tpu.utils.profiling import count_resilience
 
@@ -70,23 +87,6 @@ __all__ = ["ChunkedFitLoop", "LoopState", "ChunkOutcome", "Escalation",
            "stream_state"]
 
 TIERS = ("retry", "remediate", "elastic")
-
-
-class _NoRemediation:
-    """Neutral remediation for the non-rollback path (initial init /
-    restore): identity perturb, damping 1 — estimator closures apply it
-    unconditionally instead of branching on None."""
-
-    attempt = 0
-    action = "none"
-    damping = 1.0
-
-    @staticmethod
-    def perturb(arr, scale=1e-3):
-        return arr
-
-
-NO_REMEDIATION = _NoRemediation()
 
 
 def stream_state(checkpoint, key="n_batches"):
@@ -252,13 +252,14 @@ class ChunkedFitLoop:
         (the forest's growth loop snapshots only resumable mid-points).
     carry_names / carry_shapes / increasing — forwarded to
         ``guard.check`` for diagnostics and the monotone direction.
-    elastic : callable(mesh) | None — rebind hook for the elastic tier:
-        called after the driver shrinks the mesh; re-lay out the fit's
-        data for the new topology (``ds.ensure_canonical``).  None
-        disables the tier for this fit.
+    elastic : callable(mesh) | None — rebind hook for the elastic tier
+        AND the capacity-driven resizes: called after the driver changes
+        the mesh; re-lay out the fit's data for the new topology
+        (``ds.ensure_canonical`` / the sparse and estimator-specific
+        re-staging).  None disables both for this fit.
 
     ``info`` carries the fit's resilience summary (chunks, rollbacks,
-    escalations per tier, mesh shrinks) — estimators expose it as
+    escalations per tier, mesh shrinks/grows) — estimators expose it as
     ``fit_info_``; the same events also feed the process-wide
     ``utils.profiling`` resilience counters at zero extra dispatches.
     """
@@ -283,17 +284,30 @@ class ChunkedFitLoop:
                                        elastic_ok=elastic is not None)
         self.history: list = []
         self.info = {"chunks": 0, "rollbacks": 0, "mesh_shrinks": 0,
+                     "mesh_grows": 0,
                      "escalations": dict.fromkeys(TIERS, 0)}
         self._state = None
         self._esc = None
         self._it0 = None
         self._cadence = 0
+        self._preempt = False
+        self._cap_plan = None
+        self._grows_left = max(0, int(getattr(self.guard.policy,
+                                              "grow_attempts", 0)))
+        # the mesh this fit STARTED on is "home": capacity shrinks keep a
+        # device prefix of it, and grow-back re-forms prefixes of it (a
+        # fit never grows past its entry mesh — returned devices beyond
+        # that belong to the next fit / a fresh process)
+        from dislib_tpu.parallel import mesh as _mesh
+        m = _mesh.get_mesh()
+        self._home_shape = _mesh.mesh_shape(m)
+        self._home_devices = list(m.devices.reshape(-1))
 
     # -- protocol pieces -------------------------------------------------
 
     def _load_state(self, init, restore, rem=NO_REMEDIATION) -> LoopState:
-        snap = self.checkpoint.load() if self.checkpoint is not None else None
-        st = restore(snap, rem) if snap is not None else init(rem)
+        st = self.guard.rollback(restore, init, rem,
+                                 checkpoint=self.checkpoint)
         if self._it0 is None:
             self._it0 = st.it           # this-run history starts here
         del self.history[max(0, st.it - self._it0):]
@@ -319,12 +333,15 @@ class ChunkedFitLoop:
         carries = self.guard.admit(*st.carries)
         out = step(LoopState(carries, st.it, st.done, st.extra), chunk)
         self._preempt = preemption_requested()
+        self._cap_plan = self._capacity_plan()
         if self.check_on == "chunk":
             do_check = True
         else:                           # 'save': judge at save boundaries
+            # a pending capacity resize forces the boundary: the resize
+            # snapshots this chunk's state, so it must be judged first
             boundary = out.state.done \
                 or (self._cadence + 1) % self.save_every == 0 \
-                or self._preempt
+                or self._preempt or self._cap_plan is not None
             do_check = self.checkpoint is not None and boundary
         if do_check:
             if out.host_values is not None:
@@ -356,42 +373,105 @@ class ChunkedFitLoop:
         if self.checkpoint is None:
             return
         boundary = st.done or self._cadence % self.save_every == 0
-        if (boundary or self._preempt) and (not st.done or self.save_final):
+        if (boundary or self._preempt or self._cap_plan is not None) \
+                and (not st.done or self.save_final):
             self.guard.save_async(self.checkpoint, snapshot(st))
         if self._preempt and not st.done \
                 and (self.max_iter is None or st.it < self.max_iter):
             raise_if_preempted(self.checkpoint)
 
-    def _shrink_mesh(self):
-        """Elastic tier: halve the mesh's row axis (first half of the
-        device grid survives — the 'a device went bad' drill) and hand
-        the new mesh to the estimator's rebind hook.  The hook is called
-        TWICE: once with ``None`` BEFORE the switch — force any pending
-        op chains under the mesh they were built for (the fusion layer's
-        force-first contract for device-set changes) — and once with the
-        new mesh to re-lay the data out (``ds.ensure_canonical``).  An
-        unshrinkable mesh (single row) keeps the current one: the
-        attempt degrades to a plain retry, deterministically."""
+    def _capacity_plan(self):
+        """Compare the published capacity level against the current mesh
+        and return ``("shrink"|"grow", new_rows)`` — or None when nothing
+        to do.  The plan keeps the mesh a halving-reachable prefix of the
+        HOME mesh (column count fixed; rows move by powers of two), so a
+        shrink-then-grow sequence walks back through the exact shapes it
+        came down by.  Grows additionally need budget (``grow_attempts``)
+        so a flapping capacity source cannot thrash resizes forever;
+        shrinks always honour the target (running over capacity risks
+        eviction).  Stable at the fixpoint: once rows match the target,
+        every poll returns None."""
+        if self.elastic is None or self.checkpoint is None:
+            return None
+        cap = capacity_target()
+        if cap is None:
+            return None
         from dislib_tpu.parallel import mesh as _mesh
-        m = _mesh.get_mesh()
-        r, c = _mesh.mesh_shape(m)
+        r, c = _mesh.mesh_shape(_mesh.get_mesh())
+        home_r, home_c = self._home_shape
+        cap = max(c, min(int(cap), home_r * home_c))
+        want = cap // c                 # usable full rows at this level
+        if want < r:
+            new_r = r
+            while new_r > 1 and new_r > want:
+                new_r //= 2
+            return ("shrink", new_r) if new_r < r else None
+        if want > r and r < home_r and self._grows_left > 0:
+            new_r = r
+            while new_r * 2 <= min(want, home_r):
+                new_r *= 2
+            if new_r > r:
+                return ("grow", new_r)
+        return None
+
+    def _resize_mesh(self, new_r, kind):
+        """Re-form the mesh at ``new_r`` rows over the home-device prefix
+        and rebind the fit's data.  The hook is called TWICE: once with
+        ``None`` BEFORE the switch — force any pending op chains under
+        the mesh they were built for (the fusion layer's force-first
+        contract for device-set changes) — and once with the new mesh to
+        re-lay the data out (``ds.ensure_canonical`` / the rechunk
+        schedules)."""
+        from dislib_tpu.parallel import mesh as _mesh
+        r, c = _mesh.mesh_shape(_mesh.get_mesh())
+        if new_r == r:
+            return
         if self.elastic is not None:
             self.elastic(None)          # pre-switch: force pending chains
-        if r >= 2:
-            devs = list(m.devices.reshape(-1))[: (r // 2) * c]
-            _mesh.init((r // 2, c), devices=devs)
-            # drop the jit caches: a kernel whose PADDED shape is
-            # unchanged across the switch would otherwise hit the trace
-            # cache and replay a sharding constraint baked for the dead
-            # mesh (the PR-6 stale-constraint failure mode; a real
-            # elastic resume is a fresh process with cold caches, so the
-            # recompile is the honest cost of this tier)
-            import jax
-            jax.clear_caches()
-            self.info["mesh_shrinks"] += 1
-            count_resilience("mesh_shrinks")
+        _mesh.init((new_r, c), devices=self._home_devices[: new_r * c])
+        # drop the jit caches: a kernel whose PADDED shape is unchanged
+        # across the switch would otherwise hit the trace cache and
+        # replay a sharding constraint baked for the dead mesh (the PR-6
+        # stale-constraint failure mode; a real elastic resume is a
+        # fresh process with cold caches, so the recompile is the honest
+        # cost of a resize)
+        import jax
+        jax.clear_caches()
+        key = "mesh_shrinks" if kind == "shrink" else "mesh_grows"
+        self.info[key] += 1
+        count_resilience(key)
         if self.elastic is not None:
             self.elastic(_mesh.get_mesh())
+
+    def _shrink_mesh(self):
+        """Elastic tier: halve the mesh's row axis (first half of the
+        device grid survives — the 'a device went bad' drill).  An
+        unshrinkable mesh (single row) keeps the current one: the
+        attempt degrades to a plain retry, deterministically — the hook
+        still runs both phases so pending chains are forced."""
+        from dislib_tpu.parallel import mesh as _mesh
+        r, c = _mesh.mesh_shape(_mesh.get_mesh())
+        if r >= 2:
+            self._resize_mesh(r // 2, "shrink")
+        elif self.elastic is not None:
+            self.elastic(None)
+            self.elastic(_mesh.get_mesh())
+
+    def _apply_capacity(self, st, init, restore) -> LoopState:
+        """Execute the pending capacity plan AFTER the chunk committed:
+        flush the just-written snapshot (the resize's resume point),
+        re-form the mesh, and reload state through the one rollback
+        funnel — ``restore`` re-pads for the new mesh exactly as an
+        elastic-tier resume would, but with the neutral remediation
+        (nothing failed) and no budget spent."""
+        kind, new_r = self._cap_plan
+        self._cap_plan = None
+        if self.checkpoint is not None:
+            self.checkpoint.flush()     # resume point must be on disk
+        if kind == "grow":
+            self._grows_left -= 1
+        self._resize_mesh(new_r, kind)
+        return self._load_state(init, restore)
 
     # -- entry points ----------------------------------------------------
 
@@ -410,6 +490,8 @@ class ChunkedFitLoop:
                 continue
             st, hist = got
             self._commit(st, hist, snapshot)
+            if self._cap_plan is not None and not st.done:
+                st = self._apply_capacity(st, init, restore)
         if self.checkpoint is not None:
             self.checkpoint.flush()     # last snapshot lands before return
         self._state = st
@@ -432,6 +514,8 @@ class ChunkedFitLoop:
                 continue
             st, hist = got
             self._commit(st, hist, snapshot)
+            if self._cap_plan is not None and not st.done:
+                st = self._apply_capacity(st, init, restore)
             self._state = st
             return st
 

@@ -54,8 +54,8 @@ import threading
 import numpy as np
 
 __all__ = ["NumericalDivergence", "WatchdogTimeout", "HealthPolicy",
-           "ChunkGuard", "Verdict", "Remediation", "guard", "health_vec",
-           "HEALTH_BASE_LEN"]
+           "ChunkGuard", "Verdict", "Remediation", "NO_REMEDIATION",
+           "guard", "health_vec", "HEALTH_BASE_LEN"]
 
 # fixed slots of a health vector; per-carry (count, first_flat_index)
 # pairs follow, one pair per guarded carry
@@ -142,11 +142,19 @@ class HealthPolicy:
         ``max_restarts`` budget; see ``runtime.fitloop``).  Only fits
         whose estimator supports the on-device data rebind offer the
         tier.
+    grow_attempts : int (``DSLIB_HEALTH_GROW_ATTEMPTS``, default 2) —
+        mesh GROW-back resizes one fit may perform when the capacity
+        watcher (``runtime.preemption.capacity_target``) reports
+        returned devices.  Growing is free of rollback budget (the state
+        re-pads from the last snapshot, no work is lost) but each resize
+        retraces the fit kernels — the budget bounds thrash under a
+        flapping capacity source.
     """
 
     def __init__(self, action=None, max_restarts=None, deadline_s=None,
                  monotone_rtol=None, grow_limit=None, enabled=None, seed=0,
-                 first_deadline_s=None, elastic_attempts=None):
+                 first_deadline_s=None, elastic_attempts=None,
+                 grow_attempts=None):
         env = os.environ
         if action is None:
             action = env.get("DSLIB_HEALTH_ACTION", "retry")
@@ -175,6 +183,9 @@ class HealthPolicy:
         self.elastic_attempts = \
             int(env.get("DSLIB_HEALTH_ELASTIC_ATTEMPTS", 0)) \
             if elastic_attempts is None else int(elastic_attempts)
+        self.grow_attempts = \
+            int(env.get("DSLIB_HEALTH_GROW_ATTEMPTS", 2)) \
+            if grow_attempts is None else int(grow_attempts)
 
     def make_guard(self, name, checkpoint=None):
         """Build the per-fit guard.  Fault-injection policies
@@ -226,6 +237,21 @@ class Remediation:
         span = np.maximum(np.abs(arr), 1.0)
         return (arr + scale * span * rng.standard_normal(arr.shape)) \
             .astype(arr.dtype, copy=False)
+
+
+class _NoRemediation(Remediation):
+    """The identity remediation: attempt 0, no damping, no perturbation —
+    what a clean (non-rollback) state load applies."""
+
+    def __init__(self):
+        super().__init__(0, "none", 0)
+
+    @staticmethod
+    def perturb(arr, scale=1e-3):
+        return arr
+
+
+NO_REMEDIATION = _NoRemediation()
 
 
 def guard(name, health=None, checkpoint=None):
@@ -471,6 +497,21 @@ class ChunkGuard:
         self._prev_loss_last = None
         return Remediation(self.restarts, self.policy.action,
                            self.policy.seed + self.restarts)
+
+    def rollback(self, restore, scratch, remediation=None, checkpoint=None):
+        """Load the newest good snapshot and hand it to
+        ``restore(snap, remediation)``; fall back to
+        ``scratch(remediation)`` when no snapshot exists (or there is no
+        checkpoint at all).  The ONE state-(re)load path every rollback,
+        elastic resize, and initial warm start of the fit loop funnels
+        through — so the snapshot-vs-scratch dispatch and the remediation
+        threading cannot drift between call sites.  ``checkpoint``
+        overrides the guard's own (the fit-loop driver passes its sink:
+        an injected guard may carry none)."""
+        rem = NO_REMEDIATION if remediation is None else remediation
+        ck = self.checkpoint if checkpoint is None else checkpoint
+        snap = ck.load() if ck is not None else None
+        return restore(snap, rem) if snap is not None else scratch(rem)
 
 
 def health_vec(carries=(), inputs=(), hist=None, n_done=None,

@@ -20,6 +20,16 @@ Two trigger paths feed the same flag:
   to request a graceful drain.  The poll is one ``os.path.exists`` per
   chunk boundary — chunk boundaries are seconds apart, so no throttling
   is needed.
+
+Preemption is a one-way drain; **capacity** is a level.  On a fleet
+whose device availability OSCILLATES (spot reclaims that later return),
+the scheduler publishes the currently usable device count through
+``DSLIB_CAPACITY_FILE`` (the file's content is the integer target) or a
+process-level :func:`request_capacity` override.  ``capacity_target()``
+is NON-sticky — it reports the current level each poll, so the elastic
+fit loop can shrink when capacity drops AND grow back when it returns
+(``fitloop.ChunkedFitLoop`` polls it at the same chunk boundaries as the
+preemption flag; see the mesh grow-back tier there).
 """
 
 from __future__ import annotations
@@ -29,7 +39,8 @@ import signal
 import threading
 
 __all__ = ["Preempted", "PreemptionWatcher", "preemption_requested",
-           "request_preemption", "clear_preemption", "raise_if_preempted"]
+           "request_preemption", "clear_preemption", "raise_if_preempted",
+           "capacity_target", "request_capacity", "clear_capacity"]
 
 
 class Preempted(Exception):
@@ -76,6 +87,45 @@ def clear_preemption() -> None:
 def last_signal() -> int | None:
     """The signal number that set the flag, if a watcher did."""
     return _SIGNUM
+
+
+# Device-availability LEVEL (not a sticky event): the scheduler keeps the
+# published target current, and every poll re-reads it — shrink when it
+# drops, grow back when it returns.
+_CAP: dict = {"target": None}
+
+
+def capacity_target() -> int | None:
+    """The scheduler's currently usable device count, or None when no
+    capacity source is configured (fixed-capacity deployments never pay
+    more than this dict lookup + one env read per chunk boundary).
+
+    Sources, in precedence order: a :func:`request_capacity` process
+    override (tests, embedded schedulers), then the integer contents of
+    the file named by ``DSLIB_CAPACITY_FILE``.  An absent, empty, or
+    unparseable file means "no statement" — None, never a shrink."""
+    if _CAP["target"] is not None:
+        return int(_CAP["target"])
+    path = os.environ.get("DSLIB_CAPACITY_FILE")
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def request_capacity(n_devices: int) -> None:
+    """Set the process-level capacity target directly (tests, manual
+    drills, embedded schedulers).  Overrides the capacity file."""
+    _CAP["target"] = int(n_devices)
+
+
+def clear_capacity() -> None:
+    """Drop the process-level capacity override — the file (if any)
+    becomes the source again, else capacity is unmanaged."""
+    _CAP["target"] = None
 
 
 def raise_if_preempted(checkpoint=None) -> None:

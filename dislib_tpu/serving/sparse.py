@@ -92,9 +92,14 @@ class SparseFoldInPipeline:
         request width is ``2·nse_cap`` (the sparse ``n_features``).
     precision : mixed-precision policy for the fold-in contractions
         (None → the ``DSLIB_MATMUL_PRECISION`` default).
+    top_n : int or None — when set, rank inside the fold-in dispatch
+        (``lax.top_k`` fuses after the predict GEMM) and serve
+        ``[item_ids | scores]`` rows of width ``2·top_n`` instead of the
+        full score matrix — the response fetch shrinks from n_items to
+        2·top_n floats per user.
     """
 
-    def __init__(self, model, nse_cap=64, precision=None):
+    def __init__(self, model, nse_cap=64, precision=None, top_n=None):
         from dislib_tpu.ops import precision as px
         if not hasattr(model, "items_"):
             raise ValueError("SparseFoldInPipeline needs a FITTED ALS "
@@ -106,6 +111,7 @@ class SparseFoldInPipeline:
         self.nse_cap = int(nse_cap)
         self.n_features = 2 * self.nse_cap      # the packed request width
         self.policy = px.resolve(precision)
+        self.top_n = None if top_n is None else int(top_n)
         self._templates: dict[int, BucketTemplate] = {}
         self.out_cols: int | None = None
 
@@ -146,7 +152,16 @@ class SparseFoldInPipeline:
         (items,) = self.model._predict_leaves(self.model.items_)
         _, preds = _als_fold_in_packed(dev, items,
                                        float(self.model.lambda_),
-                                       int(self.model.n_f), self.policy)
-        host = _fetch(preds)                # force: ONE fused dispatch
+                                       int(self.model.n_f), self.policy,
+                                       top_n=int(self.top_n or 0))
+        if self.top_n:
+            # ranked serve: the SAME dispatch (top_k fused after the
+            # predict GEMM) yields [item_ids | scores] response rows
+            ids, scores = preds
+            host = np.concatenate(
+                [np.asarray(_fetch(ids), np.float32), _fetch(scores)],
+                axis=1)
+        else:
+            host = _fetch(preds)            # force: ONE fused dispatch
         self.out_cols = int(host.shape[1])
         return host[: rows.shape[0]]

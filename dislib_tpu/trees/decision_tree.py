@@ -297,13 +297,37 @@ class _BaseTreeEnsemble(BaseEstimator):
             digest = data_digest(x._data, stats=stats_host)
 
         n_bins = self._n_bins()
-        edges = _quantile_bins(x._data, x.shape, n_bins)
-        bx = _bin_data(x._data, x.shape, edges)
-        mp = x._data.shape[0]
-        valid = (np.arange(mp) < m).astype(np.float32)
-        stats = jnp.asarray(stats_host)               # (mp, S)
         try_features = self._try_features_count(n)
-        box = {"feats": [], "tbins": []}
+        box = {"feats": [], "tbins": [], "x": x}
+
+        def _stage():
+            # everything derived from the data layout: binned data, pad
+            # width, validity mask, per-sample stats.  Re-run by the
+            # elastic rebind after a mesh change — the bins re-derive
+            # from the re-laid-out x (the quantile edges depend only on
+            # the VALID rows, so they are mesh-independent values on a
+            # mesh-dependent canvas)
+            xd = box["x"]._data
+            mp = xd.shape[0]
+            box["edges"] = _quantile_bins(xd, (m, n), n_bins)
+            box["bx"] = _bin_data(xd, (m, n), box["edges"])
+            box["mp"] = mp
+            box["valid"] = (np.arange(mp) < m).astype(np.float32)
+            sh = np.asarray(stats_host)
+            if sh.shape[0] != mp:       # host re-pad: pad rows carry w=0
+                out = np.zeros((mp, sh.shape[1]), sh.dtype)
+                out[: min(mp, sh.shape[0])] = sh[:mp]
+                sh = out
+            box["stats"] = jnp.asarray(sh)            # (mp, S)
+
+        _stage()
+        _data_hook = _fitloop.data_rebind(box)
+
+        def rebind(mesh):
+            _data_hook(mesh)            # force chains / re-canonicalize x
+            if mesh is not None:
+                _stage()
+
         loop = _fitloop.ChunkedFitLoop(
             "forest", checkpoint=checkpoint, health=health,
             max_iter=depth, chunk_iters=1,
@@ -316,7 +340,7 @@ class _BaseTreeEnsemble(BaseEstimator):
             # growth snapshots only resumable mid-points, never the final
             # level (leaves are derived after the loop)
             save_final=False,
-            carry_names=("node_totals", "w"))
+            carry_names=("node_totals", "w"), elastic=rebind)
 
         def _keys_for(seed, lvl):
             # replay the PRNG key chain to `lvl` — a resumed or
@@ -334,12 +358,13 @@ class _BaseTreeEnsemble(BaseEstimator):
                     else np.random.randint(0, 2**31 - 1)
             k_boot, box["key"] = _keys_for(box["seed"], 0)
             box["feats"], box["tbins"] = [], []
+            mp = box["mp"]
             if bootstrap:
                 w = jax.random.poisson(k_boot, 1.0,
                                        (n_trees, mp)).astype(jnp.float32)
             else:
                 w = jnp.ones((n_trees, mp), jnp.float32)
-            w = w * jnp.asarray(valid)[None, :]
+            w = w * jnp.asarray(box["valid"])[None, :]
             if rem.attempt:             # from-scratch rollback perturbs w
                 w = jnp.asarray(rem.perturb(_fetch(w)))
             return _fitloop.LoopState(
@@ -361,9 +386,10 @@ class _BaseTreeEnsemble(BaseEstimator):
             # re-pad them for THIS mesh's quantum so an 8-device snapshot
             # resumes on a 4-device (or 2-D) mesh — pad columns carry w=0,
             # so zero-filling them is exact (elastic resume)
-            node = jnp.asarray(_repad_rows(snap["node"], m, mp, axis=1))
-            w = jnp.asarray(rem.perturb(_repad_rows(snap["w"], m, mp,
-                                                    axis=1)))
+            node = jnp.asarray(_repad_rows(snap["node"], m, box["mp"],
+                                           axis=1))
+            w = jnp.asarray(rem.perturb(_repad_rows(snap["w"], m,
+                                                    box["mp"], axis=1)))
             box["feats"] = [jnp.asarray(snap[f"feats_{i}"])
                             for i in range(lvl)]
             box["tbins"] = [jnp.asarray(snap[f"tbins_{i}"])
@@ -375,8 +401,8 @@ class _BaseTreeEnsemble(BaseEstimator):
             keys = jax.random.split(k_lvl, n_trees)
             (w,) = st.carries
             feat, tbin, is_split, node, _, hvec = _forest_level(
-                st.extra, bx, w, stats, keys, 2 ** st.it, try_features,
-                0.0, self._criterion, n_bins)
+                st.extra, box["bx"], w, box["stats"], keys, 2 ** st.it,
+                try_features, 0.0, self._criterion, n_bins)
             box["feats"].append(feat)
             box["tbins"].append(tbin)
             nxt = st.it + 1
@@ -400,8 +426,8 @@ class _BaseTreeEnsemble(BaseEstimator):
                       snapshot=snapshot)
         self.fit_info_ = loop.info
         feats, tbins = box["feats"], box["tbins"]
-        leaves, leaf_hvec = _leaf_stats(st.extra, st.carries[0], stats,
-                                        2 ** depth)
+        leaves, leaf_hvec = _leaf_stats(st.extra, st.carries[0],
+                                        box["stats"], 2 ** depth)
         # feats/tbins stay as the ragged per-level device arrays: packing
         # here would dispatch eager multi-device pad/stack programs while
         # the level producers are still in flight — on a thread-starved
@@ -411,7 +437,8 @@ class _BaseTreeEnsemble(BaseEstimator):
         # `hvec` rides along so the adoption step (the first host
         # materialisation) can refuse a non-finite forest — the async
         # dispatch-only contract of this function is preserved.
-        return {"edges": edges, "feats": tuple(feats), "tbins": tuple(tbins),
+        return {"edges": box["edges"], "feats": tuple(feats),
+                "tbins": tuple(tbins),
                 "depth": depth, "leaves": leaves, "n_features": n,
                 "hvec": leaf_hvec, "guard": loop.guard}
 

@@ -14,7 +14,7 @@ import numpy as np
 from jax import lax
 
 from dislib_tpu.data.array import (Array, _padded_dim, _place_region,
-                                   fused_kernel)
+                                   ensure_canonical, fused_kernel)
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.trees.decision_tree import (_BaseTreeEnsemble,
                                             _forest_apply, _forest_apply_core,
@@ -63,6 +63,9 @@ class _ClassifierMixin:
 
     def predict_proba(self, x: Array) -> Array:
         self._check_fitted()
+        # serve on the CURRENT mesh: an input built before an elastic
+        # resize re-lands on device (never the host) — round 16
+        x = ensure_canonical(x)
         k = len(self.classes_)
         out_pshape = (x._pshape[0], _padded_dim(k, _mesh.pad_quantum()))
         edges, feats, tbins, leaves = self._predict_leaves(
@@ -79,6 +82,7 @@ class _ClassifierMixin:
         per-predict sync; integer classes stay int32, exact to 2^31 where
         float32 corrupts past 2^24 — VERDICT r1 weak #8)."""
         self._check_fitted()
+        x = ensure_canonical(x)     # serve on the CURRENT mesh (round 16)
         classes = self._classes_leaf()
         edges, feats, tbins, leaves, classes_dev = self._predict_leaves(
             self._edges, self._feats, self._tbins, self._leaves, classes)
@@ -125,6 +129,7 @@ class _RegressorMixin:
 
     def predict(self, x: Array) -> Array:
         self._check_fitted()
+        x = ensure_canonical(x)     # serve on the CURRENT mesh (round 16)
         edges, feats, tbins, leaves = self._predict_leaves(
             self._edges, self._feats, self._tbins, self._leaves)
         return fused_kernel(

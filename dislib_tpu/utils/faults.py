@@ -40,7 +40,7 @@ from dislib_tpu.utils.checkpoint import FitCheckpoint
 __all__ = ["CallbackCheckpoint", "SigtermAtNthSave", "sigterm_self",
            "corrupt_snapshot", "FlakyCall", "FlakyOpen",
            "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk",
-           "FaultAtTier"]
+           "FaultAtTier", "CapacityAtSave", "oscillation_schedule"]
 
 
 class CallbackCheckpoint(FitCheckpoint):
@@ -319,6 +319,61 @@ class _TripAtChunkGuard(ChunkGuard):
 
     def check_host(self, values, it=None):
         return self._maybe_trip(it) or super().check_host(values, it)
+
+
+class CapacityAtSave(HealthPolicy):
+    """Oscillating-capacity injector (round-16 bidirectional elasticity):
+    walk a ``{save_index: n_devices}`` schedule, publishing each capacity
+    level via :func:`~dislib_tpu.runtime.preemption.request_capacity` at
+    the moment the ``save_index``-th gated snapshot write STARTS — i.e.
+    synchronously at the chunk boundary, so the NEXT chunk's capacity
+    poll sees the level deterministically (a callback on the async write
+    worker races the poll).  A value of ``None`` clears the override.
+    Remember to :func:`~dislib_tpu.runtime.preemption.clear_capacity` at
+    teardown (the level is process-wide)."""
+
+    def __init__(self, schedule, **kw):
+        super().__init__(**kw)
+        self.schedule = {int(k): v for k, v in dict(schedule).items()}
+        self.saves = 0
+
+    def make_guard(self, name, checkpoint=None):
+        return _CapacityAtSaveGuard(name, self, checkpoint)
+
+
+class _CapacityAtSaveGuard(ChunkGuard):
+    def save_async(self, checkpoint, state):
+        out = super().save_async(checkpoint, state)
+        if out is None:                 # gated off: unhealthy chunk
+            return out
+        pol = self.policy
+        pol.saves += 1
+        if pol.saves in pol.schedule:
+            from dislib_tpu.runtime.preemption import (clear_capacity,
+                                                       request_capacity)
+            cap = pol.schedule[pol.saves]
+            if cap is None:
+                clear_capacity()
+            else:
+                request_capacity(cap)
+        return out
+
+
+def oscillation_schedule(home_devices, seed, period=2, swings=2):
+    """A seeded shrink → heal → grow capacity walk for the chaos tiers:
+    ``swings`` dips to a (seeded) fraction of ``home_devices``, each
+    held for ``period`` saves before the grow-back to full capacity,
+    ending with a final ``None`` to clear the override.  Deterministic
+    per seed — the whole chaos matrix stays bit-reproducible."""
+    rng = np.random.RandomState(int(seed))
+    sched, at = {}, 1
+    for _ in range(int(swings)):
+        dip = max(1, int(home_devices) >> int(rng.randint(1, 3)))
+        sched[at] = dip
+        sched[at + int(period)] = int(home_devices)
+        at += 2 * int(period)
+    sched[at] = None
+    return sched
 
 
 class FaultAtTier(HealthPolicy):

@@ -151,8 +151,9 @@ def transfer_count() -> int:
 def count_resilience(key: str, n: int = 1) -> None:
     """Record ``n`` resilience events under ``key`` — the blessed keys are
     ``rollbacks``, ``chunk_retries``, ``escalations_<tier>``,
-    ``mesh_shrinks`` (the fit-loop driver), ``watchdog_trips`` (the chunk
-    guard), and ``quarantined_rows`` (ingest)."""
+    ``mesh_shrinks`` / ``mesh_grows`` (the fit-loop driver's elastic
+    resizes, escalation- or capacity-driven), ``watchdog_trips`` (the
+    chunk guard), and ``quarantined_rows`` (ingest)."""
     with _COUNTERS_LOCK:
         _COUNTERS.resilience[key] = _COUNTERS.resilience.get(key, 0) + n
 
@@ -160,7 +161,8 @@ def count_resilience(key: str, n: int = 1) -> None:
 def resilience_counters() -> dict:
     """Resilience tallies since the last ``reset_counters()`` — rollbacks,
     chunk retries, watchdog trips, escalations per ladder tier, mesh
-    shrinks, quarantined rows (keys absent until their first event)."""
+    shrinks/grows, quarantined rows (keys absent until their first
+    event)."""
     with _COUNTERS_LOCK:
         return dict(_COUNTERS.resilience)
 

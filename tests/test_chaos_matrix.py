@@ -150,7 +150,8 @@ INJECTORS = {
     # defeats retry; healed by policy remediation
     "tier1": lambda: faults.FaultAtTier(tiers=1, at_chunk=2),
     # defeats retry AND remediation; healed only by the elastic
-    # mesh-shrink tier (estimators without the rebind hook type instead)
+    # mesh-shrink tier — round 16: EVERY chunked estimator carries the
+    # rebind hook now, so no tier2 cell is allowed to type
     "tier2": lambda: faults.FaultAtTier(tiers=2, at_chunk=2,
                                         max_restarts=3, elastic_attempts=1),
     # defeats the whole ladder; must type, never hang
@@ -183,6 +184,7 @@ def _run_cell(est_name, inj_name, tmp_path, seed):
         if info:
             cell["rollbacks"] = info["rollbacks"]
             cell["mesh_shrinks"] = info["mesh_shrinks"]
+            cell["mesh_grows"] = info.get("mesh_grows", 0)
     finally:
         clear_preemption()
         ds.init()
@@ -214,6 +216,11 @@ def test_chaos_matrix_full(tmp_path, monkeypatch):
     assert healed + typed == len(_estimators()) * len(INJECTORS)
     assert any(c.get("mesh_shrinks") for c in cells.values()), \
         "no cell escalated to the elastic mesh-shrink tier"
+    # round 16: every chunked estimator carries a rebind hook, so the
+    # elastic rung HEALS everywhere — a typed tier2 cell is a regression
+    bad = [k for k, c in cells.items()
+           if k.endswith("xtier2") and c["outcome"] != "healed"]
+    assert not bad, f"elastic rung failed to heal: {bad}"
 
 
 def test_chaos_matrix_smoke(tmp_path, monkeypatch):

@@ -137,3 +137,66 @@ def test_chaos_soak(tmp_path, monkeypatch):
                "resilience": prof.resilience_counters()}
     print("CHAOS_SOAK_SUMMARY " + json.dumps(summary))
     assert sum(tally.values()) == runs
+
+
+@pytest.mark.slow
+def test_chaos_oscillation_soak(tmp_path, monkeypatch):
+    """Round-16 oscillating-capacity tier (``tools/chaos_soak.sh
+    --oscillate``): a seeded shrink → heal → grow capacity walk
+    (``faults.oscillation_schedule``) across EVERY chunked estimator
+    family.  Capacity swings are re-layouts, not failures, so the
+    invariant is stronger than heal-or-typed: every run must COMPLETE,
+    spend zero rollback budget on the resizes, and land on its unfaulted
+    oracle (bit-for-bit on integral models; policy-precision otherwise).
+    """
+    from test_chaos_matrix import _estimators
+    from dislib_tpu.runtime.preemption import clear_capacity
+    from dislib_tpu.utils import profiling as prof
+
+    monkeypatch.setenv("DSLIB_RETRY_BACKOFF", "0")
+    base = int(os.environ.get("DSLIB_SOAK_SEED", "0"))
+    names = ("kmeans", "gmm", "als", "forest", "csvm", "dbscan", "daura")
+    tally = Counter()
+    shrinks = grows = 0
+    prof.reset_counters()
+    for i, name in enumerate(names):
+        seed = base + i
+        ds.init()
+        home = int(np.prod(list(ds.get_mesh().shape.values())))
+        fit, model_of = _estimators()[name](np.random.RandomState(seed))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            oracle = fit(
+                FitCheckpoint(str(tmp_path / f"o{i}.npz"), every=2), None)
+        ref = np.asarray(model_of(oracle), np.float64)
+
+        ds.init()
+        pol = faults.CapacityAtSave(
+            faults.oscillation_schedule(home, seed))
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                est = fit(
+                    FitCheckpoint(str(tmp_path / f"c{i}.npz"), every=2),
+                    pol)
+        finally:
+            clear_capacity()
+        info = est.fit_info_
+        shrinks += info["mesh_shrinks"]
+        grows += info["mesh_grows"]
+        assert info["rollbacks"] == 0, \
+            f"{name} seed {seed}: a capacity resize consumed rollback budget"
+        got = np.asarray(model_of(est), np.float64)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6,
+                                   err_msg=f"{name} seed {seed}")
+        exact = bool(np.array_equal(got, ref))
+        tally[f"{name}:{'bitexact' if exact else 'close'}"] += 1
+        ds.init()
+    summary = {"metric": "chaos_oscillation", "seed": base,
+               "outcomes": dict(sorted(tally.items())),
+               "mesh_shrinks": shrinks, "mesh_grows": grows,
+               "resilience": prof.resilience_counters()}
+    print("CHAOS_OSC_SUMMARY " + json.dumps(summary))
+    assert sum(tally.values()) == len(names)
+    assert shrinks >= len(names), "every run must shrink at least once"
+    assert grows >= 1, "the sweep never exercised grow-back"

@@ -418,17 +418,23 @@ class TestTiledPassCheckpoint:
             xx, checkpoint=FitCheckpoint(path, every=1))
         np.testing.assert_array_equal(res.labels_, plain.labels_)
 
-    def test_tier_mismatch_refuses(self, rng, tmp_path, monkeypatch):
-        """A snapshot written on one tier must refuse to resume on the
-        other (pad widths differ — pinned via the fingerprint)."""
+    def test_tier_mismatch_resumes(self, rng, tmp_path, monkeypatch):
+        """Round 16: a snapshot written on one tier RESUMES on the other —
+        the greedy/propagation state stores frame ids with a sentinel the
+        restore re-bases, so pad widths are no longer fingerprinted (a
+        mesh resize changes the pad width mid-fit; a refusal here would
+        make every elastic resume a typed failure)."""
         from dislib_tpu.cluster import DBSCAN
         from dislib_tpu.cluster import dbscan as dbscan_mod
         x = ds.array(self._blobs3(rng))
+        plain = DBSCAN(eps=1.0, min_samples=4).fit(x)
         path = str(tmp_path / "dbt.npz")
         with pytest.raises(KeyboardInterrupt):
             DBSCAN(eps=1.0, min_samples=4).fit(     # tiled-tier snapshot
                 x, checkpoint=_KillAfter(path, every=1, kill_after=1))
         monkeypatch.setattr(dbscan_mod, "_RING", True)
-        with pytest.raises(ValueError, match="stale or foreign"):
-            DBSCAN(eps=1.0, min_samples=4).fit(     # ring-tier resume
-                x, checkpoint=FitCheckpoint(path, every=1))
+        res = DBSCAN(eps=1.0, min_samples=4).fit(   # ring-tier resume
+            x, checkpoint=FitCheckpoint(path, every=1))
+        np.testing.assert_array_equal(res.labels_, plain.labels_)
+        np.testing.assert_array_equal(res.core_sample_indices_,
+                                      plain.core_sample_indices_)

@@ -222,6 +222,70 @@ class TestElasticTier:
 
 
 # ---------------------------------------------------------------------------
+# bidirectional elasticity: capacity-driven shrink AND grow-back
+# ---------------------------------------------------------------------------
+
+class TestGrowBack:
+    def test_capacity_oscillation_heals_and_matches_oracle(self, rng,
+                                                           tmp_path):
+        """Capacity dips to half the mesh after the first snapshot and
+        returns after the second: the fit shrinks, then GROWS BACK to the
+        home mesh, and lands bit-for-bit on the unfaulted oracle.
+        Capacity resizes are re-layouts from a committed snapshot — NOT
+        failures — so they must not consume rollbacks or escalations."""
+        from conftest import skip_unless_devices
+        from dislib_tpu.runtime.preemption import clear_capacity
+        skip_unless_devices(8)
+        ds.init((8, 1), devices=jax.devices()[:8])
+        x, kw = _kmeans_setup(rng)
+        full = KMeans(**kw).fit(
+            x, checkpoint=FitCheckpoint(str(tmp_path / "o.npz"), every=2))
+
+        ds.init((8, 1), devices=jax.devices()[:8])
+        pol = faults.CapacityAtSave({1: 4, 2: 8})
+        prof.reset_counters()
+        try:
+            res = KMeans(**kw).fit(
+                x, checkpoint=FitCheckpoint(str(tmp_path / "c.npz"),
+                                            every=2),
+                health=pol)
+        finally:
+            clear_capacity()
+        assert res.fit_info_["mesh_shrinks"] == 1
+        assert res.fit_info_["mesh_grows"] == 1
+        assert ds.get_mesh().shape["rows"] == 8, \
+            "grow-back must restore the home mesh"
+        r = prof.resilience_counters()
+        assert r["mesh_shrinks"] == 1 and r["mesh_grows"] == 1
+        assert "rollbacks" not in r and "escalations_elastic" not in r, \
+            "a capacity resize is not a failure and spends no budget"
+        # the oscillated fit equals the unfaulted oracle bit-for-bit
+        assert res.n_iter_ == full.n_iter_
+        np.testing.assert_array_equal(res.centers_, full.centers_)
+
+    def test_grow_attempts_budget_caps_grow_backs(self, rng, tmp_path):
+        """grow_attempts=0 pins the fit to the shrunk mesh: the shrink
+        still happens (capacity drops are always honored) but the
+        grow-back is declined."""
+        from conftest import skip_unless_devices
+        from dislib_tpu.runtime.preemption import clear_capacity
+        skip_unless_devices(8)
+        ds.init((8, 1), devices=jax.devices()[:8])
+        x, kw = _kmeans_setup(rng)
+        pol = faults.CapacityAtSave({1: 4, 2: 8}, grow_attempts=0)
+        try:
+            res = KMeans(**kw).fit(
+                x, checkpoint=FitCheckpoint(str(tmp_path / "g.npz"),
+                                            every=2),
+                health=pol)
+        finally:
+            clear_capacity()
+        assert res.fit_info_["mesh_shrinks"] == 1
+        assert res.fit_info_["mesh_grows"] == 0
+        assert ds.get_mesh().shape["rows"] == 4
+
+
+# ---------------------------------------------------------------------------
 # counters: populated by a healed fit, at zero extra dispatches
 # ---------------------------------------------------------------------------
 

@@ -343,6 +343,23 @@ class TestFoldIn:
         np.testing.assert_allclose(a, b, atol=1e-6)
         np.testing.assert_allclose(a, c, atol=1e-6)
 
+    def test_top_n_fused_matches_full_scores(self, rng):
+        """fold_in(top_n=) ranks in the SAME dispatch (lax.top_k fused
+        after the predict GEMM) and agrees with ranking the full score
+        matrix on host."""
+        als, v, _ = _als_fixture(rng)
+        new = np.where(rng.rand(3, 20) < 0.5, 1.0, 0.0).astype(np.float32)
+        full = als.fold_in(new)
+        prof.reset_counters()
+        ids, scores = als.fold_in(new, top_n=5)
+        assert prof.counters()["dispatch_by"].get("als_fold_in") == 1
+        assert ids.shape == scores.shape == (3, 5)
+        for k in range(3):
+            want = np.argsort(-full[k])[:5]
+            np.testing.assert_array_equal(np.sort(ids[k]), np.sort(want))
+            np.testing.assert_allclose(scores[k], full[k][ids[k]],
+                                       atol=1e-6)
+
     def test_wrong_width_raises(self, rng):
         als, _, _ = _als_fixture(rng)
         with pytest.raises(ValueError, match="items"):
@@ -397,6 +414,25 @@ class TestSparseServing:
         assert stats["dispatches_per_batch_max"] == 1
         np.testing.assert_allclose(out, als.fold_in(new), rtol=1e-5,
                                    atol=1e-5)
+
+    def test_pipeline_top_n_serves_ranked_rows(self, rng):
+        """A top_n pipeline serves [item_ids | scores] rows of width
+        2·top_n from the same fused dispatch, agreeing with the full
+        score matrix's ranking."""
+        from dislib_tpu.serving import SparseFoldInPipeline
+        als, v, _ = _als_fixture(rng)
+        new = np.where(rng.rand(2, 20) < 0.4, 1.0, 0.0).astype(np.float32)
+        full = SparseFoldInPipeline(als, nse_cap=16)
+        ranked = SparseFoldInPipeline(als, nse_cap=16, top_n=4)
+        out_full = full.predict_bucket(full.pack(new), 4)
+        out = ranked.predict_bucket(ranked.pack(new), 4)
+        assert out.shape == (2, 8) and ranked.out_cols == 8
+        ids, scores = out[:, :4].astype(np.int64), out[:, 4:]
+        for k in range(2):
+            want = np.argsort(-out_full[k])[:4]
+            np.testing.assert_array_equal(np.sort(ids[k]), np.sort(want))
+            np.testing.assert_allclose(scores[k], out_full[k][ids[k]],
+                                       atol=1e-5)
 
     def test_pack_guards(self, rng):
         from dislib_tpu.serving import SparseFoldInPipeline

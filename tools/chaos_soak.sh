@@ -6,6 +6,7 @@
 #
 # Usage:  tools/chaos_soak.sh [RUNS] [SEED]
 #         tools/chaos_soak.sh --matrix [SEED] [OUT_JSONL]
+#         tools/chaos_soak.sh --oscillate [SEED]
 #
 # Default mode runs the `slow`-marked tests/test_chaos_soak.py (excluded
 # from tier-1) and echoes the machine-readable summary line; append it to
@@ -16,8 +17,26 @@
 # FaultAtTier (tests/test_chaos_matrix.py) — and APPENDS its
 # machine-readable summary (per-cell verdicts + resilience counters) to
 # OUT_JSONL (default BENCH_local_matrix.jsonl) as one JSON line.
+#
+# --oscillate (round-16) runs the oscillating-CAPACITY tier: a seeded
+# shrink → heal → grow device-availability walk across every chunked
+# estimator family, asserting zero consumed rollback budget and an
+# oracle-matching model after every swing (bidirectional elasticity).
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
+if [ "$1" = "--oscillate" ]; then
+    SEED="${2:-0}"
+    LOG="$(mktemp)"
+    env JAX_PLATFORMS=cpu DSLIB_SOAK_SEED="$SEED" \
+        python -m pytest \
+        tests/test_chaos_soak.py::test_chaos_oscillation_soak \
+        -q -m slow -s -p no:cacheprovider 2>&1 | tee "$LOG"
+    rc=${PIPESTATUS[0]}
+    echo "-- oscillation summary --"
+    grep -a "^CHAOS_OSC_SUMMARY" "$LOG" | sed 's/^CHAOS_OSC_SUMMARY //'
+    rm -f "$LOG"
+    exit $rc
+fi
 if [ "$1" = "--matrix" ]; then
     SEED="${2:-0}"
     OUT="${3:-BENCH_local_matrix.jsonl}"

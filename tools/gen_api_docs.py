@@ -65,6 +65,7 @@ SECTIONS = [
     ("Resilience runtime", "dislib_tpu.runtime",
      ["Preempted", "PreemptionWatcher", "preemption_requested",
       "request_preemption", "clear_preemption", "raise_if_preempted",
+      "capacity_target", "request_capacity", "clear_capacity",
       "Retry", "retry_call", "is_transient_error", "repad_rows", "fetch",
       "AsyncFetch"]),
     ("Health runtime (self-healing fits)", "dislib_tpu.runtime.health",
