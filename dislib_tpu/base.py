@@ -104,16 +104,30 @@ class BaseEstimator:
         drops the whole cache.  The cache invalidates when an attribute
         is REASSIGNED (a new fit, a hot-swap adoption) — in-place
         mutation of a fitted ndarray is not supported, as everywhere in
-        the library."""
+        the library.  The key also carries the current mesh: after an
+        elastic ``ds.init`` resize, a leaf that is a COMMITTED device
+        array from the old mesh (a fit's own output) would poison the
+        predict program with mismatched device sets — such a leaf takes
+        one host hop back onto the current mesh, once, here."""
+        import jax
         import jax.numpy as jnp
+        import numpy as np
+        from dislib_tpu.parallel import mesh as _mesh
+        mesh = _mesh.get_mesh()
         cache = getattr(self, "_predict_leaf_cache", None)
         if cache is None:
             cache = self._predict_leaf_cache = {}
-        key = tuple(id(h) for h in host_arrays)
+        key = (id(mesh),) + tuple(id(h) for h in host_arrays)
         hit = cache.get(key)
         if hit is not None:
             return hit[1]
-        dev = tuple(jnp.asarray(h) for h in host_arrays)
+        mesh_devs = set(np.asarray(mesh.devices).ravel())
+        dev = tuple(
+            jnp.asarray(np.asarray(h)
+                        if isinstance(h, jax.Array)
+                        and not set(h.devices()) <= mesh_devs
+                        else h)
+            for h in host_arrays)
         if len(cache) >= 16:                # refit churn bound — a model
             cache.clear()                   # has a handful of live tuples
         cache[key] = (tuple(host_arrays), dev)  # [0] is the id pin

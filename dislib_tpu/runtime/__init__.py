@@ -21,7 +21,12 @@ the survival story is built from four pieces that compose (SURVEY §6
 - **adoption** — the round-9 read-side hot-swap gate: serve checkpoint
   generation N while N+1 trains; a reader adopts a new generation only
   after the checksum-verified load AND a health-gated warmup predict
-  (``adoption.py``; the serving layer is lint-bound to it).
+  (``adoption.py``; the serving layer is lint-bound to it);
+- **bundle_io** — the round-15 deployment-bundle byte seam: atomic
+  checksum-embedding writes and verified reads of the AOT serving
+  artifact, plus the typed :class:`BundleIncompatible`
+  (``bundle_io.py``; ``serving.bundle`` assembles the artifact, this
+  module owns its bytes — serving code never touches them raw).
 
 Crash-consistent rotating snapshots live with the checkpoint format in
 ``dislib_tpu.utils.checkpoint``; the deterministic fault-injection harness
@@ -32,6 +37,8 @@ from dislib_tpu.runtime import xla_flags  # noqa: F401
 from dislib_tpu.runtime import health  # noqa: F401
 from dislib_tpu.runtime.adoption import (Adoption, AdoptionRejected,
                                          adopt_latest, generation_token)
+from dislib_tpu.runtime.bundle_io import (BundleIncompatible, read_bundle,
+                                          write_bundle)
 from dislib_tpu.runtime.elastic import AsyncFetch, fetch, repad_rows
 from dislib_tpu.runtime.health import (ChunkGuard, HealthPolicy,
                                        NumericalDivergence, WatchdogTimeout)
@@ -54,6 +61,7 @@ __all__ = [
     "repad_rows", "fetch", "AsyncFetch",
     "HealthPolicy", "ChunkGuard", "NumericalDivergence", "WatchdogTimeout",
     "Adoption", "AdoptionRejected", "adopt_latest", "generation_token",
+    "BundleIncompatible", "read_bundle", "write_bundle",
     "ChunkedFitLoop", "ChunkOutcome", "LoopState", "Escalation",
     "EscalationLadder",
     "health", "xla_flags",

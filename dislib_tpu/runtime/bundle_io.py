@@ -1,0 +1,93 @@
+"""Verified byte-level IO for AOT deployment bundles (round-15).
+
+A deployment bundle is ONE versioned artifact holding everything a fresh
+serving process needs to serve its first batch with zero retraces: the
+serialized compiled predict executables for the whole bucket ladder, the
+operand leaves each executable closes over (model parameters, already
+padded and canonicalized), the bucket ladder + statics, and the
+checksum-verified model state.  The *assembly* of that artifact lives in
+``dislib_tpu.serving.bundle``; THIS module is the runtime-side seam that
+owns the bytes — the same split as checkpoints (``utils.checkpoint``
+owns the format, ``runtime.adoption`` gates the read).
+
+Why a separate seam: the serving package is lint-bound to never touch
+snapshot/model bytes directly (no raw ``open()``/``np.load``/``np.savez``
+— ``tests/test_serving.py::TestAdoptionGateLint``), so bundle reads and
+writes MUST flow through here, where they inherit the checkpoint
+format's integrity discipline verbatim:
+
+- writes are atomic (unique tmp file + fsync + rename, directory fsync)
+  and embed a CRC-32 over every entry's name/dtype/shape/bytes;
+- reads verify that checksum and raise a typed
+  :class:`~dislib_tpu.utils.checkpoint.SnapshotCorrupt` on truncation,
+  bit rot, or a foreign file — a serving process can never build a
+  pipeline from damaged bytes.
+
+Compatibility (wrong jaxlib/topology for the serialized executables) is
+the layer ABOVE: :class:`BundleIncompatible` is defined here so the
+runtime package exports the typed error, but the fingerprint check runs
+in ``serving.bundle`` where the fingerprint is computed.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+import numpy as np
+
+from dislib_tpu.utils.checkpoint import (_CRC_KEY, _fsync_dir, _load_verified,
+                                         _state_crc)
+
+__all__ = ["BundleIncompatible", "read_bundle", "write_bundle"]
+
+
+class BundleIncompatible(RuntimeError):
+    """A deployment bundle whose serialized executables cannot run in
+    this process: jax/jaxlib version, device platform/kind, device
+    count, mesh shape, or pad quantum differ from the exporting process
+    (or the executable bytes fail to deserialize).  Carries the
+    ``expected`` (bundle) and ``found`` (this process) fingerprint dicts
+    for the postmortem.  The model STATE inside the bundle is still
+    checksum-verified and usable — ``load_bundle(..., build=)`` falls
+    back to a fresh trace+compile from it, loudly."""
+
+    def __init__(self, message, expected=None, found=None):
+        super().__init__(message)
+        self.expected = expected or {}
+        self.found = found or {}
+
+
+def write_bundle(path: str, arrays: dict) -> None:
+    """Atomically persist a bundle entry dict (ndarrays only; executable
+    payloads travel as uint8 arrays, metadata as str arrays) with the
+    checkpoint format's embedded CRC-32.  Same crash discipline as
+    ``FitCheckpoint.save``: unique tmp in the target directory, fsync
+    before the rename, directory fsync after — a torn write can never
+    leave a file that :func:`read_bundle` would trust."""
+    arrs = {k: np.asarray(v) for k, v in arrays.items()}
+    if _CRC_KEY in arrs:
+        raise ValueError(f"{_CRC_KEY!r} is a reserved bundle key")
+    arrs[_CRC_KEY] = np.asarray([_state_crc(arrs)], np.uint32)
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(suffix=".npz", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **arrs)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+def read_bundle(path: str) -> dict:
+    """Checksum-verified read of a bundle artifact.  Raises the typed
+    :class:`~dislib_tpu.utils.checkpoint.SnapshotCorrupt` when the file
+    is truncated, bit-corrupt, or foreign (no integrity record) — the
+    read-side twin of :func:`write_bundle`, sharing the checkpoint
+    verifier so the two formats cannot drift."""
+    return _load_verified(path)

@@ -34,21 +34,46 @@ end-to-end**, from four pieces that compose:
   ladder/server/pool machinery as one fused ALS fold-in dispatch per
   batch: score a brand-new user against the trained factors with no
   refit and no densified request vector.
+- **AOT deployment bundles** (``bundle.py``, round 15) — the compiled
+  predict executables for the WHOLE bucket ladder serialize into one
+  checksum-verified artifact (``export_bundle``); a fresh process
+  rehydrates it into a ``PredictServer``-ready pipeline with ZERO
+  retraces (``load_bundle``), refusing typed-and-loud
+  (``BundleIncompatible``) when jax/topology fingerprints mismatch.
+- **multi-tenant routing** (``router.py``, round 15) — ``ModelRouter``
+  maps tenants onto shared servers (shared shape ladder → shared
+  compiled executables, ~zero extra compiles), adds per-tenant
+  admission quotas (typed ``TenantQuotaExceeded`` sheds only the
+  offender), and hash-splits canary/A-B traffic with a health-gated
+  ``promote``.
 
-See the user guide's "Serving & hot-swap" section for the end-to-end
-story and `bench.py::bench_serving` for the regression-gated numbers.
+See the user guide's "Serving & hot-swap" and "Deployment bundles &
+multi-tenant serving" sections for the end-to-end story and
+`bench.py::bench_serving` / ``bench_serving_fleet`` for the
+regression-gated numbers.
 """
 
-from dislib_tpu.serving.buckets import (DEFAULT_BUCKETS, bucket_for,
-                                        bucket_ladder, split_rows)
+from dislib_tpu.serving.buckets import (DEFAULT_BUCKETS, BucketLadderError,
+                                        bucket_for, bucket_ladder,
+                                        split_rows)
+from dislib_tpu.serving.bundle import (BundlePipeline, LoadedBundle,
+                                       export_bundle, load_bundle,
+                                       runtime_fingerprint)
 from dislib_tpu.serving.cache import ProgramCache
 from dislib_tpu.serving.hotswap import ModelPool
 from dislib_tpu.serving.pipeline import ServePipeline
-from dislib_tpu.serving.server import PredictServer, ServeResponse
+from dislib_tpu.serving.router import ModelRouter, TenantQuotaExceeded
+from dislib_tpu.serving.server import (PredictServer, QueueFull,
+                                       ServeResponse)
 from dislib_tpu.serving.sparse import SparseFoldInPipeline, pack_sparse_rows
 
 __all__ = [
-    "DEFAULT_BUCKETS", "bucket_ladder", "bucket_for", "split_rows",
+    "DEFAULT_BUCKETS", "BucketLadderError", "bucket_ladder", "bucket_for",
+    "split_rows",
     "ProgramCache", "ServePipeline", "PredictServer", "ServeResponse",
-    "ModelPool", "SparseFoldInPipeline", "pack_sparse_rows",
+    "QueueFull", "ModelPool",
+    "SparseFoldInPipeline", "pack_sparse_rows",
+    "export_bundle", "load_bundle", "BundlePipeline", "LoadedBundle",
+    "runtime_fingerprint",
+    "ModelRouter", "TenantQuotaExceeded",
 ]

@@ -23,14 +23,58 @@ import numpy as np
 DEFAULT_BUCKETS = (1, 8, 64, 512)
 
 
+class BucketLadderError(ValueError):
+    """``DSLIB_SERVE_BUCKETS`` failed validation at parse time: a token
+    is not an integer, a bucket is non-positive, or the ladder is not
+    strictly increasing (duplicates included).  Typed so a deployment
+    with a fat-fingered env var fails AT STARTUP with the offending
+    value in the message — not downstream as a silently reordered
+    ladder, a bare ``int()`` traceback, or a mis-bucketed request."""
+
+
+def _ladder_from_env(env: str):
+    """Strictly validated parse of the ``DSLIB_SERVE_BUCKETS`` value: a
+    comma-separated, strictly increasing list of positive row counts.
+    Unlike a programmatic ``buckets=`` argument (normalised below — the
+    caller wrote a Python literal and can see its order), an env var is
+    deployment configuration: silently sorting/deduping ``512,64`` or
+    ``8,8,64`` would mask a typo'd rollout, so any deviation raises
+    :class:`BucketLadderError` naming the value."""
+    ladder = []
+    for tok in env.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        try:
+            b = int(tok)
+        except ValueError:
+            raise BucketLadderError(
+                f"DSLIB_SERVE_BUCKETS={env!r}: {tok!r} is not an integer "
+                "row count") from None
+        if b < 1:
+            raise BucketLadderError(
+                f"DSLIB_SERVE_BUCKETS={env!r}: bucket {b} is not positive")
+        if ladder and b <= ladder[-1]:
+            raise BucketLadderError(
+                f"DSLIB_SERVE_BUCKETS={env!r}: ladder must be strictly "
+                f"increasing ({b} after {ladder[-1]} — duplicates count)")
+        ladder.append(b)
+    if not ladder:
+        raise BucketLadderError(
+            f"DSLIB_SERVE_BUCKETS={env!r}: no buckets parsed")
+    return tuple(ladder)
+
+
 def bucket_ladder(buckets=None):
     """Normalised, ascending bucket ladder.  ``None`` reads
-    ``DSLIB_SERVE_BUCKETS`` (comma-separated row counts) and falls back
-    to :data:`DEFAULT_BUCKETS`."""
+    ``DSLIB_SERVE_BUCKETS`` (comma-separated row counts, validated
+    strictly — see :class:`BucketLadderError`) and falls back to
+    :data:`DEFAULT_BUCKETS`."""
     if buckets is None:
         env = os.environ.get("DSLIB_SERVE_BUCKETS", "")
-        buckets = [int(b) for b in env.split(",") if b.strip()] \
-            if env.strip() else DEFAULT_BUCKETS
+        if env.strip():
+            return _ladder_from_env(env)
+        buckets = DEFAULT_BUCKETS
     ladder = tuple(sorted({int(b) for b in buckets}))
     if not ladder or ladder[0] < 1:
         raise ValueError(f"bucket ladder must be positive row counts, got "

@@ -1,0 +1,373 @@
+"""AOT deployment bundles — kill serving cold-start (round-15 tentpole).
+
+PR 4 made the warm path one cached dispatch, but a FRESH process still
+pays the full trace+compile for every bucket shape before its first
+response (~300 ms/bucket on this rig, tens of seconds per bucket at chip
+scale).  The full-program-compilation discipline of arXiv:1810.09868
+says the whole predict program is an ahead-of-time artifact — so make
+it one: :func:`export_bundle` serializes the COMPILED predict
+executables for the whole bucket ladder (``jax.jit`` AOT
+``lower().compile()`` + ``jax.experimental.serialize_executable``),
+their operand leaves (model parameters, padded exactly as the programs
+expect), the bucket ladder, and the checksum-verified model state into
+ONE versioned artifact; :func:`load_bundle` rehydrates a
+``PredictServer``-ready pipeline in a fresh process with ZERO retraces
+(trace-counter-pinned by ``tests/test_serving_fleet.py``).
+
+Failure discipline, typed and loud:
+
+- damaged bytes (truncation, bit rot, foreign file) raise
+  ``SnapshotCorrupt`` from the verified reader — serving never builds a
+  pipeline from bytes that fail their checksum;
+- a fingerprint mismatch (different jax/jaxlib, platform, device kind or
+  count, mesh shape, pad quantum — anything that invalidates a compiled
+  executable) raises :class:`~dislib_tpu.runtime.BundleIncompatible`;
+  pass ``build=`` to fall back LOUDLY to a fresh trace+compile from the
+  bundle's embedded (still checksum-verified) model state instead.
+
+All artifact bytes flow through ``runtime.bundle_io`` (the write/read
+seam) and checkpoint state flows through the ``runtime.adoption`` gate —
+both enforced by the serving lints in ``tests/test_serving.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+import numpy as np
+
+from dislib_tpu.runtime import adopt_latest, fetch as _fetch
+from dislib_tpu.runtime.bundle_io import (BundleIncompatible, read_bundle,
+                                          write_bundle)
+from dislib_tpu.serving.buckets import BucketTemplate, bucket_ladder
+from dislib_tpu.utils import profiling as _prof
+
+BUNDLE_FORMAT = 1
+
+# meta entry key inside the artifact (everything else is per-bucket
+# payload/leaf arrays and ``state__``-prefixed model state)
+_META_KEY = "bundle_meta"
+_STATE_PREFIX = "state__"
+
+# fingerprint keys that MUST match for a serialized executable to run;
+# anything else in the fingerprint is informational (statics provenance)
+_HARD_KEYS = ("format", "jax", "jaxlib", "platform", "device_kind",
+              "n_devices", "mesh_shape", "pad_quantum")
+
+
+def runtime_fingerprint() -> dict:
+    """The compatibility identity of THIS process for serialized
+    executables: library format version, jax/jaxlib versions, device
+    platform/kind/count, mesh shape, and pad quantum (it shapes every
+    padded operand), plus informational statics (the overlap router
+    mode and fusion cap the programs were traced under).  Hard keys
+    (everything except ``statics``) must match between the exporting
+    and loading process; ``load_bundle`` refuses typed-and-loud on any
+    difference."""
+    import jax
+    import jaxlib
+
+    from dislib_tpu.parallel import mesh as _mesh
+    devs = jax.devices()
+    return {
+        "format": BUNDLE_FORMAT,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "platform": devs[0].platform,
+        "device_kind": devs[0].device_kind,
+        "n_devices": len(devs),
+        "mesh_shape": list(_mesh.mesh_shape(None)),
+        "pad_quantum": int(_mesh.pad_quantum()),
+        "statics": {
+            "overlap": os.environ.get("DSLIB_OVERLAP", "db"),
+            "fusion_cap": os.environ.get("DSLIB_FUSION_CAP", "96"),
+        },
+    }
+
+
+def _capture_bucket(pipeline, bucket: int):
+    """AOT-capture one bucket's predict program WITHOUT executing it:
+    build the deferred chain on a placeholder input, linearize it, and
+    ``lower().compile()`` the fused program exactly as the first warm
+    dispatch would have.  Returns everything a fresh process needs to
+    re-invoke the compiled executable: the serialized payload, the
+    canonicalized operand leaves, the input leaf's slot, and the output
+    metadata."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental.serialize_executable import serialize
+
+    from dislib_tpu.data.array import (Array, _exec_program, _linearize,
+                                       _padded_shape)
+    from dislib_tpu.parallel import mesh as _mesh
+
+    pshape = _padded_shape((bucket, pipeline.n_features),
+                           _mesh.pad_quantum())
+    placeholder = jax.device_put(np.zeros(pshape, np.float32),
+                                 _mesh.data_sharding())
+    out = pipeline(Array(placeholder, (bucket, pipeline.n_features)))
+    if not out.is_lazy:
+        raise RuntimeError(
+            "the predict chain forced during capture — the pipeline is "
+            "not exportable as one fused program (DSLIB_EAGER=1, or the "
+            "chain exceeds DSLIB_FUSION_CAP); disable eager mode or "
+            "raise the cap to export a bundle")
+    program, leaves, _shared = _linearize(out._lazy)
+    slots = [i for i, leaf in enumerate(leaves) if leaf is placeholder]
+    if len(slots) != 1:
+        raise RuntimeError(
+            f"bucket {bucket}: expected the request buffer to be exactly "
+            f"one program leaf, found {len(slots)} — the pipeline does "
+            "not consume its input as a single operand")
+    # canonicalize every leaf to a committed device array so the lowered
+    # avals (dtype, weak_type) match what a host→device round trip of
+    # the stored leaf reproduces at load time
+    canon = [jnp.asarray(leaf) for leaf in leaves]
+    compiled = _exec_program.lower(program, *canon).compile()
+    payload, _in_tree, out_tree = serialize(compiled)
+    return {
+        "payload": np.frombuffer(payload, np.uint8),
+        "leaves": canon,
+        "input_slot": slots[0],
+        "n_outs": out_tree.num_leaves,
+        "out_cols": int(out.shape[1]),
+        "pshape": list(pshape),
+    }
+
+
+def export_bundle(pipeline, path: str, buckets=None, checkpoint=None,
+                  state=None) -> dict:
+    """Serialize ``pipeline``'s compiled predict executables for every
+    ladder bucket into ONE versioned artifact at ``path``.
+
+    Parameters
+    ----------
+    pipeline : ServePipeline — the fitted chain to export.  Its fused
+        program per bucket is lowered and compiled ahead of time (the
+        export pays the traces so the loading process never does).
+    path : str — artifact file (atomic write, embedded checksum).
+    buckets : bucket ladder; default per
+        :func:`~dislib_tpu.serving.buckets.bucket_ladder`.
+    checkpoint : FitCheckpoint, optional — embed the newest generation's
+        model state, read THROUGH the ``runtime.adoption`` gate
+        (checksum verify + non-finite state gate), so the artifact's
+        state carries the same trust as a hot-swap adoption.
+    state : dict, optional — embed an explicit state dict instead (the
+        caller already holds verified state).  Mutually exclusive with
+        ``checkpoint``.
+
+    Returns the manifest dict (also embedded in the artifact).
+    """
+    if checkpoint is not None and state is not None:
+        raise ValueError("pass at most one of checkpoint= or state=")
+    buckets = bucket_ladder(buckets)
+    if checkpoint is not None:
+        adoption = adopt_latest(checkpoint, build=lambda s: s,
+                                name="bundle-export")
+        if adoption is None:
+            raise ValueError(
+                "checkpoint has no generation to embed — save one before "
+                "exporting a bundle")
+        state = adoption.state
+    entries: dict = {}
+    manifest: dict = {"format": BUNDLE_FORMAT,
+                      "fingerprint": runtime_fingerprint(),
+                      "buckets": list(buckets),
+                      "n_features": int(pipeline.n_features),
+                      "per_bucket": {}}
+    for b in buckets:
+        cap = _capture_bucket(pipeline, b)
+        entries[f"exec_{b}"] = cap["payload"]
+        for i, leaf in enumerate(cap["leaves"]):
+            # one device→host sync per leaf at EXPORT time (offline by
+            # definition); the serving hot path never comes through here
+            entries[f"leaf_{b}_{i}"] = np.asarray(leaf)
+        manifest["per_bucket"][str(b)] = {
+            "input_slot": cap["input_slot"],
+            "n_leaves": len(cap["leaves"]),
+            "n_outs": cap["n_outs"],
+            "out_cols": cap["out_cols"],
+            "pshape": cap["pshape"],
+        }
+    if state is not None:
+        for k, v in state.items():
+            entries[_STATE_PREFIX + k] = np.asarray(v)
+    entries[_META_KEY] = np.asarray(json.dumps(manifest))
+    write_bundle(path, entries)
+    return manifest
+
+
+class _BucketExec:
+    """One bucket's rehydrated executable: the loaded compiled program,
+    its device-placed static leaves (model parameters — transferred once
+    at load, never per request), the input slot, and output metadata."""
+
+    __slots__ = ("call", "args", "input_slot", "in_sharding", "out_cols",
+                 "template")
+
+    def __init__(self, call, args, input_slot, in_sharding, out_cols,
+                 pshape):
+        self.call = call
+        self.args = args
+        self.input_slot = input_slot
+        self.in_sharding = in_sharding
+        self.out_cols = out_cols
+        self.template = BucketTemplate(pshape)
+
+
+class BundlePipeline:
+    """A ``PredictServer``-ready pipeline rehydrated from a deployment
+    bundle: ``predict_bucket`` is host staging → one input transfer →
+    ONE deserialized-executable invocation → fetch → slice, with ZERO
+    tracing anywhere (there is no traceable Python body left — the
+    program is bytes).  Dispatches are counted under ``bundle_exec`` so
+    the server's one-dispatch-per-batch invariant stays a counter
+    assertion on this path too.
+
+    Not thread-safe (same contract as ``ServePipeline``): the serving
+    worker or one caller drives it.
+    """
+
+    def __init__(self, buckets, n_features, execs):
+        self.buckets = tuple(buckets)
+        self.n_features = int(n_features)
+        self._execs = dict(execs)
+        self.out_cols = next(iter(self._execs.values())).out_cols \
+            if self._execs else None
+
+    def predict_bucket(self, rows: np.ndarray, bucket: int) -> np.ndarray:
+        import jax
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim == 1:
+            rows = rows.reshape(1, -1)
+        if rows.shape[1] != self.n_features:
+            raise ValueError(f"request has {rows.shape[1]} features, "
+                             f"bundle serves {self.n_features}")
+        ex = self._execs.get(int(bucket))
+        if ex is None:
+            raise ValueError(
+                f"bucket {bucket} is not in the bundle's compiled ladder "
+                f"{self.buckets} — a bundle serves exactly the shapes it "
+                "was exported for")
+        if rows.shape[0] > bucket:
+            raise ValueError(f"{rows.shape[0]} rows exceed bucket {bucket}")
+        buf = ex.template.fill(rows)
+        dev = jax.device_put(buf, ex.in_sharding) \
+            if ex.in_sharding is not None else jax.device_put(buf)
+        args = list(ex.args)
+        args[ex.input_slot] = dev
+        _prof.count_dispatch("bundle_exec")
+        outs = ex.call(*args)
+        host = _fetch(outs[0])
+        return host[: rows.shape[0], : ex.out_cols]
+
+
+class LoadedBundle:
+    """:func:`load_bundle`'s result: the servable ``pipeline`` (a
+    :class:`BundlePipeline`, or a fresh ``build(state)`` pipeline when
+    ``fallback`` is True), the embedded checksum-verified ``state``, the
+    ``buckets`` ladder, the exporting process's ``fingerprint``, and
+    ``fallback`` — True when the executables were unusable here and the
+    pipeline will pay a fresh trace+compile per bucket instead."""
+
+    __slots__ = ("pipeline", "state", "buckets", "fingerprint", "fallback")
+
+    def __init__(self, pipeline, state, buckets, fingerprint, fallback):
+        self.pipeline = pipeline
+        self.state = state
+        self.buckets = tuple(buckets)
+        self.fingerprint = fingerprint
+        self.fallback = fallback
+
+    def __repr__(self):
+        return (f"LoadedBundle(buckets={self.buckets}, "
+                f"fallback={self.fallback})")
+
+
+def _fallback(build, state, meta, err):
+    """The loud typed fallback: the bundle's executables cannot run here
+    but its model state is checksum-verified — rebuild fresh (paying
+    trace+compile) when the caller gave us a builder, else raise."""
+    if build is None:
+        raise err
+    if not state:
+        raise BundleIncompatible(
+            f"{err} — and the bundle embeds no model state to rebuild "
+            "from (export with checkpoint= or state=)",
+            expected=err.expected, found=err.found) from err
+    warnings.warn(
+        f"deployment bundle unusable here ({err}); falling back to a "
+        "fresh trace+compile from the bundle's embedded model state — "
+        "cold-start protection is LOST for this process",
+        RuntimeWarning, stacklevel=3)
+    return LoadedBundle(build(state), state, meta["buckets"],
+                        meta["fingerprint"], fallback=True)
+
+
+def load_bundle(path: str, build=None) -> LoadedBundle:
+    """Rehydrate a deployment bundle into a ``PredictServer``-ready
+    pipeline with zero retraces.
+
+    The read verifies the artifact checksum (``SnapshotCorrupt`` on any
+    damage — typed, never a half-read pipeline), then compares the
+    embedded fingerprint against this process (:func:`runtime_fingerprint`
+    hard keys).  On mismatch — or when executable deserialization itself
+    fails — raises :class:`~dislib_tpu.runtime.BundleIncompatible`;
+    pass ``build`` (``state_dict -> ServePipeline``) to instead fall
+    back loudly to a fresh compile from the embedded state.
+    """
+    import jax.tree_util as jtu
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    raw = read_bundle(path)
+    if _META_KEY not in raw:
+        raise BundleIncompatible(
+            f"{path} verifies but carries no bundle manifest — not a "
+            "deployment bundle")
+    meta = json.loads(str(raw[_META_KEY][()]))
+    state = {k[len(_STATE_PREFIX):]: v for k, v in raw.items()
+             if k.startswith(_STATE_PREFIX)}
+    here = runtime_fingerprint()
+    theirs = meta.get("fingerprint", {})
+    mismatched = [k for k in _HARD_KEYS if theirs.get(k) != here.get(k)]
+    if mismatched:
+        diff = {k: {"bundle": theirs.get(k), "here": here.get(k)}
+                for k in mismatched}
+        return _fallback(build, state, meta, BundleIncompatible(
+            f"bundle {path} was exported under a different runtime "
+            f"({diff}) — its compiled executables cannot run here",
+            expected=theirs, found=here))
+    execs = {}
+    try:
+        for b in meta["buckets"]:
+            pb = meta["per_bucket"][str(b)]
+            payload = raw[f"exec_{b}"].tobytes()
+            in_tree = jtu.tree_structure(
+                (tuple(range(pb["n_leaves"])), {}))
+            out_tree = jtu.tree_structure(tuple(range(pb["n_outs"])))
+            loaded = deserialize_and_load(payload, in_tree, out_tree)
+            shardings = getattr(loaded, "input_shardings", None)
+            shardings = shardings[0] if shardings else None
+            args = []
+            import jax
+            for i in range(pb["n_leaves"]):
+                leaf = raw[f"leaf_{b}_{i}"]
+                args.append(jax.device_put(leaf, shardings[i])
+                            if shardings is not None else leaf)
+            execs[int(b)] = _BucketExec(
+                loaded, args, pb["input_slot"],
+                shardings[pb["input_slot"]] if shardings is not None
+                else None,
+                pb["out_cols"], pb["pshape"])
+    except BundleIncompatible:
+        raise
+    except Exception as e:  # noqa: BLE001 — deserialize failure is typed
+        return _fallback(build, state, meta, BundleIncompatible(
+            f"bundle {path} fingerprint matches but executable "
+            f"deserialization failed ({type(e).__name__}: {e})",
+            expected=theirs, found=here))
+    pipe = BundlePipeline(meta["buckets"], meta["n_features"], execs)
+    return LoadedBundle(pipe, state, meta["buckets"], theirs,
+                        fallback=False)

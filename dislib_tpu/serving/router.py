@@ -1,0 +1,315 @@
+"""ModelRouter — one serving process, N tenants (round-15 tentpole,
+leg 2).
+
+One process per model wastes the machinery the previous rounds built:
+the program cache keys on (generation, bucket shape), so N tenants whose
+pipelines share a shape ladder can share ONE compiled-executable set —
+N-tenant serving costs ~zero extra compiles (counter-asserted in
+``tests/test_serving_fleet.py``).  The router is the thin front that
+makes that sharing safe:
+
+- **tenant → server mapping**: each tenant names a
+  :class:`~dislib_tpu.serving.server.PredictServer` (several tenants may
+  point at the SAME server — that is the executable-sharing case; a
+  tenant with its own model points at its own server over a shared or
+  private ladder).
+- **admission control**: a per-tenant in-flight row quota.  A tenant
+  outrunning its quota gets a typed :class:`TenantQuotaExceeded` on ITS
+  submissions only — the noisy neighbour is shed, everyone else's
+  futures are untouched (the server's own :class:`QueueFull`
+  backpressure stays underneath as the global limit, tenant-attributed).
+- **canary / A-B routing**: :meth:`set_canary` splits a tenant's traffic
+  between its primary server (N) and a canary server (N+1) by REQUEST
+  HASH — the same request key always lands on the same arm, so an A/B
+  comparison is deterministic and a client's retries don't flap between
+  generations.  :meth:`promote` makes the canary primary only while the
+  canary's model is live through the ``runtime.adoption`` gate (a
+  pool-backed canary whose adoption was rejected cannot be promoted);
+  :meth:`abort_canary` routes 100% back to N.
+
+Observability rides the server's own per-tenant accounting
+(``PredictServer.stats()["tenants"]``): the router labels every
+submission with its tenant (canary arms as ``tenant:canary``), so
+per-tenant p50/p95/p99 and shed counts come from the serving layer
+itself, not from timing wrapped around it.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+import numpy as np
+
+from dislib_tpu.serving.server import PredictServer
+
+_HASH_BUCKETS = 10_000      # canary fraction resolution: 0.01%
+
+
+class TenantQuotaExceeded(RuntimeError):
+    """Admission control, typed: THIS tenant's in-flight rows would
+    exceed its quota, so this submission is shed — other tenants'
+    requests are untouched (noisy-neighbour isolation).  Carries the
+    offending ``tenant`` and its ``quota_rows``."""
+
+    def __init__(self, message, tenant=None, quota_rows=None):
+        super().__init__(message)
+        self.tenant = tenant
+        self.quota_rows = quota_rows
+
+
+class _Tenant:
+    __slots__ = ("name", "server", "quota_rows", "inflight_rows",
+                 "canary", "canary_fraction", "quota_shed", "promotions")
+
+    def __init__(self, name, server, quota_rows):
+        self.name = name
+        self.server = server
+        self.quota_rows = quota_rows
+        self.inflight_rows = 0
+        self.canary: PredictServer | None = None
+        self.canary_fraction = 0.0
+        self.quota_shed = 0
+        self.promotions = 0
+
+
+def _request_hash(rows: np.ndarray, key) -> int:
+    """Deterministic per-request hash for the canary split: the caller's
+    routing ``key`` when given (a user/session id — keeps one client on
+    one arm), else the request bytes themselves."""
+    if key is not None:
+        data = key if isinstance(key, bytes) else str(key).encode()
+    else:
+        data = np.ascontiguousarray(rows).tobytes()
+    return zlib.crc32(data) % _HASH_BUCKETS
+
+
+class ModelRouter:
+    """Multi-tenant front over shared :class:`PredictServer` instances.
+
+    Use as a context manager: ``with ModelRouter() as r`` starts every
+    distinct server exactly once on entry and drains/stops them on exit
+    (servers already running are left to their owner).  All routing
+    state is lock-protected; the heavy lifting stays in the servers.
+    """
+
+    def __init__(self, name="router"):
+        self.name = name
+        self._tenants: dict[str, _Tenant] = {}
+        self._lock = threading.Lock()
+        self._started: list[PredictServer] = []
+
+    # -- tenancy -------------------------------------------------------------
+
+    def add_tenant(self, tenant: str, server: PredictServer,
+                   quota_rows: int | None = None) -> None:
+        """Register ``tenant`` on ``server``.  Any number of tenants may
+        share one server — that is the executable-sharing fast path (one
+        compiled ladder serves them all).  ``quota_rows`` caps the
+        tenant's in-flight rows (admission control); None = no per-tenant
+        cap (the server's global backpressure still applies)."""
+        if not isinstance(server, PredictServer):
+            raise TypeError(f"tenant {tenant!r}: server must be a "
+                            f"PredictServer, got {type(server).__name__}")
+        with self._lock:
+            if tenant in self._tenants:
+                raise ValueError(f"tenant {tenant!r} already registered")
+            self._tenants[tenant] = _Tenant(
+                tenant, server,
+                None if quota_rows is None else int(quota_rows))
+
+    def tenants(self):
+        with self._lock:
+            return sorted(self._tenants)
+
+    def _get(self, tenant) -> _Tenant:
+        t = self._tenants.get(tenant)
+        if t is None:
+            raise KeyError(f"unknown tenant {tenant!r} — add_tenant first")
+        return t
+
+    # -- canary / A-B --------------------------------------------------------
+
+    def set_canary(self, tenant: str, server: PredictServer,
+                   fraction: float = 0.1) -> None:
+        """Route ``fraction`` of ``tenant``'s requests (by request hash)
+        to ``server`` — generation N+1 next to the primary's N.  The
+        split is deterministic per request key: A/B comparisons are
+        reproducible and one client sticks to one arm."""
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"canary fraction must be in (0, 1], got "
+                             f"{fraction}")
+        with self._lock:
+            self._get(tenant)           # typed before any side effect
+            active = bool(self._started)
+        # a canary attached mid-flight starts under the router's
+        # lifecycle like any other registered server — and BEFORE it is
+        # published as a route target: a concurrent submit must never
+        # meet a not-yet-running canary (start() is outside the lock; it
+        # warms the whole bucket ladder)
+        if active and not server._running:
+            server.start()
+            self._started.append(server)
+        with self._lock:
+            t = self._get(tenant)
+            t.canary = server
+            t.canary_fraction = float(fraction)
+
+    def abort_canary(self, tenant: str) -> None:
+        """Route 100% of ``tenant`` back to its primary (the canary
+        server keeps running — its owner decides its fate)."""
+        with self._lock:
+            t = self._get(tenant)
+            t.canary = None
+            t.canary_fraction = 0.0
+
+    def promote(self, tenant: str) -> None:
+        """Make ``tenant``'s canary its primary — but only while the
+        canary's model is LIVE through the adoption gate: a pool-backed
+        canary must have actually adopted a generation (checksum +
+        health-gated warmup), otherwise the promotion is refused with a
+        ``RuntimeError`` and traffic stays on the old primary.  The
+        demoted primary server keeps running (it may serve other
+        tenants); in-flight futures on either arm resolve normally —
+        promotion only changes where NEW requests route."""
+        with self._lock:
+            t = self._get(tenant)
+            if t.canary is None:
+                raise RuntimeError(f"tenant {tenant!r} has no canary to "
+                                   "promote")
+            pool = t.canary._pool
+            if pool is not None and pool.current()[1] is None:
+                raise RuntimeError(
+                    f"tenant {tenant!r}: canary has not adopted a live "
+                    "generation through the adoption gate (last "
+                    f"rejection: {pool.last_rejection!r}) — refusing to "
+                    "promote an unvalidated model")
+            t.server = t.canary
+            t.canary = None
+            t.canary_fraction = 0.0
+            t.promotions += 1
+
+    def route(self, tenant: str, rows, key=None):
+        """(server, label) this request would take — the canary split
+        made inspectable (tests and dry-runs)."""
+        rows = np.asarray(rows, np.float32)
+        with self._lock:
+            t = self._get(tenant)
+            if t.canary is not None and \
+                    _request_hash(rows, key) < \
+                    t.canary_fraction * _HASH_BUCKETS:
+                return t.canary, f"{tenant}:canary"
+            return t.server, tenant
+
+    # -- request side --------------------------------------------------------
+
+    def submit(self, rows, tenant: str, key=None):
+        """Admit, route, and queue one request for ``tenant``; returns
+        the server's Future.  Sheds with :class:`TenantQuotaExceeded`
+        when the tenant's in-flight rows would exceed its quota — only
+        the offender's submission fails; the server's own
+        :class:`~dislib_tpu.serving.server.QueueFull` backpressure can
+        still fire underneath as the global limit."""
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim == 1:
+            rows = rows.reshape(1, -1)
+        k = rows.shape[0]
+        with self._lock:
+            t = self._get(tenant)
+            if t.quota_rows is not None and \
+                    t.inflight_rows + k > t.quota_rows:
+                t.quota_shed += 1
+                raise TenantQuotaExceeded(
+                    f"{self.name}: tenant {tenant!r} has "
+                    f"{t.inflight_rows} rows in flight; {k} more would "
+                    f"exceed its quota ({t.quota_rows}) — request shed, "
+                    "other tenants unaffected",
+                    tenant=tenant, quota_rows=t.quota_rows)
+            if t.canary is not None and \
+                    _request_hash(rows, key) < \
+                    t.canary_fraction * _HASH_BUCKETS:
+                server, label = t.canary, f"{tenant}:canary"
+            else:
+                server, label = t.server, tenant
+            t.inflight_rows += k
+        try:
+            fut = server.submit(rows, tenant=label)
+        except BaseException:
+            with self._lock:
+                t.inflight_rows -= k
+            raise
+        def _release(_f, _t=t, _k=k):
+            with self._lock:
+                _t.inflight_rows -= _k
+        fut.add_done_callback(_release)
+        return fut
+
+    def predict(self, rows, tenant: str, key=None) -> np.ndarray:
+        return self.submit(rows, tenant, key=key).result().values
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _servers(self):
+        seen, out = set(), []
+        for t in self._tenants.values():
+            for s in (t.server, t.canary):
+                if s is not None and id(s) not in seen:
+                    seen.add(id(s))
+                    out.append(s)
+        return out
+
+    def start(self) -> "ModelRouter":
+        """Start every distinct registered server exactly once (shared
+        servers start once no matter how many tenants point at them);
+        servers already running stay their owner's responsibility."""
+        with self._lock:
+            servers = self._servers()
+        for s in servers:
+            if not s._running:
+                s.start()
+                self._started.append(s)
+        return self
+
+    def stop(self) -> None:
+        """Drain and stop only the servers :meth:`start` started."""
+        started, self._started = self._started, []
+        for s in started:
+            s.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- observability -------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Per-tenant routing + serving view: quota shed counts and
+        in-flight rows from the router, latency percentiles and
+        backpressure shed from the underlying server's OWN per-tenant
+        accounting (primary and canary arms reported separately)."""
+        with self._lock:
+            tenants = {name: (t.server, t.canary, t.canary_fraction,
+                              t.inflight_rows, t.quota_rows, t.quota_shed,
+                              t.promotions)
+                       for name, t in self._tenants.items()}
+        out = {}
+        for name, (server, canary, frac, inflight, quota, shed,
+                   promotions) in tenants.items():
+            sstats = server.stats()
+            entry = {"server": server.name,
+                     "inflight_rows": inflight,
+                     "quota_rows": quota,
+                     "quota_shed": shed,
+                     "promotions": promotions,
+                     "serving": sstats["tenants"].get(
+                         name, {"requests": 0, "shed": 0})}
+            if canary is not None:
+                entry["canary"] = {
+                    "server": canary.name,
+                    "fraction": frac,
+                    "serving": canary.stats()["tenants"].get(
+                        f"{name}:canary", {"requests": 0, "shed": 0})}
+            out[name] = entry
+        return out

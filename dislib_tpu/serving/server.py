@@ -33,11 +33,24 @@ from dislib_tpu.serving.buckets import bucket_for, bucket_ladder, split_rows
 from dislib_tpu.serving.cache import ProgramCache
 from dislib_tpu.utils import profiling as _prof
 
-_LATENCY_WINDOW = 8192      # completions kept for the p50/p99 estimate
+_LATENCY_WINDOW = 8192      # completions kept for the p50/p95/p99 estimate
 
 
 def _default_deadline_s() -> float:
     return float(os.environ.get("DSLIB_SERVE_DEADLINE_MS", "5")) / 1e3
+
+
+class QueueFull(RuntimeError):
+    """Backpressure, typed (round 15): the server's queue already holds
+    ``max_queue_rows`` rows — the request rate is outrunning the device
+    and THIS submission was shed (the queue never grows until the
+    process OOMs).  Subclasses ``RuntimeError`` so pre-round-15 callers
+    matching that still catch it; carries the ``tenant`` whose request
+    was shed so a router's admission layer can attribute the rejection."""
+
+    def __init__(self, message, tenant=None):
+        super().__init__(message)
+        self.tenant = tenant
 
 
 class ServeResponse:
@@ -59,12 +72,13 @@ class ServeResponse:
 
 
 class _Pending:
-    __slots__ = ("rows", "future", "t_submit")
+    __slots__ = ("rows", "future", "t_submit", "tenant")
 
-    def __init__(self, rows):
+    def __init__(self, rows, tenant=None):
         self.rows = rows
         self.future = Future()
         self.t_submit = time.perf_counter()
+        self.tenant = tenant
 
 
 class PredictServer:
@@ -117,6 +131,14 @@ class PredictServer:
         self._dispatch_hist: deque[int] = deque(maxlen=_LATENCY_WINDOW)
         self._t_first = None
         self._t_last = None
+        # per-tenant observability (round 15): latency windows, request
+        # tallies, and shed counts keyed by the submit() tenant label —
+        # the fleet bench and the router read THESE numbers rather than
+        # timing around the server
+        self._shed = 0
+        self._tenant_lat: dict[str, deque] = {}
+        self._tenant_requests: dict[str, int] = {}
+        self._tenant_shed: dict[str, int] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -155,36 +177,43 @@ class PredictServer:
 
     # -- request side --------------------------------------------------------
 
-    def submit(self, rows) -> Future:
+    def submit(self, rows, tenant=None) -> Future:
         """Queue one request (a (k, n_features) block or a single (n,)
         row); the Future resolves to a :class:`ServeResponse`.  Raises
-        ``RuntimeError`` when the queue already holds ``max_queue_rows``
-        rows — backpressure: a client outrunning the device must hear
-        about it instead of growing the queue until the process OOMs."""
+        :class:`QueueFull` when the queue already holds
+        ``max_queue_rows`` rows — backpressure: a client outrunning the
+        device must hear about it instead of growing the queue until the
+        process OOMs.  ``tenant`` labels the request for the per-tenant
+        latency/shed accounting in :meth:`stats` (a
+        :class:`~dislib_tpu.serving.router.ModelRouter` sets it)."""
         rows = np.asarray(rows, np.float32)
         if rows.ndim == 1:
             rows = rows.reshape(1, -1)
         if rows.ndim != 2 or rows.shape[0] < 1:
             raise ValueError(f"a request is a (k, n_features) row block, "
                              f"got shape {rows.shape}")
-        p = _Pending(rows)
+        p = _Pending(rows, tenant)
         with self._cv:
             if not self._running:
                 raise RuntimeError("PredictServer is not running — use "
                                    "start() or a with-block")
             if self._queued_rows + rows.shape[0] > self.max_queue_rows:
-                raise RuntimeError(
+                self._shed += 1
+                if tenant is not None:
+                    self._tenant_shed[tenant] = \
+                        self._tenant_shed.get(tenant, 0) + 1
+                raise QueueFull(
                     f"{self.name}: queue full ({self._queued_rows} rows "
                     f"queued, max_queue_rows={self.max_queue_rows}) — "
                     "the request rate is outrunning the device; back off "
-                    "and retry")
+                    "and retry", tenant=tenant)
             self._queued_rows += rows.shape[0]
             self._queue.append(p)
             self._cv.notify_all()
         return p.future
 
-    def predict(self, rows) -> np.ndarray:
-        return self.submit(rows).result().values
+    def predict(self, rows, tenant=None) -> np.ndarray:
+        return self.submit(rows, tenant=tenant).result().values
 
     # -- worker side ---------------------------------------------------------
 
@@ -301,6 +330,12 @@ class PredictServer:
                 self._lat.append(lat)
                 self._requests += 1
                 self._rows += p.rows.shape[0]
+                if p.tenant is not None:
+                    self._tenant_lat.setdefault(
+                        p.tenant,
+                        deque(maxlen=_LATENCY_WINDOW)).append(lat)
+                    self._tenant_requests[p.tenant] = \
+                        self._tenant_requests.get(p.tenant, 0) + 1
         off = 0
         for p, lat in zip(batch, lats):
             k = p.rows.shape[0]
@@ -311,14 +346,26 @@ class PredictServer:
 
     # -- accounting ----------------------------------------------------------
 
+    @staticmethod
+    def _percentiles(lat: np.ndarray) -> dict:
+        """p50/p95/p99 (ms) over one latency window, None when empty."""
+        if not lat.size:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        return {f"p{q}_ms": round(1e3 * float(np.percentile(lat, q)), 4)
+                for q in (50, 95, 99)}
+
     def stats(self) -> dict:
-        """Serving counters: request latency percentiles (ms), QPS over
-        the completion window, rows/batches served, and the per-batch
-        dispatch distribution (the 1-dispatch-per-batch invariant as a
-        number; oversize split requests legitimately cost one dispatch
-        per piece).  Dispatch deltas read the process-wide profiling
-        counters — concurrent non-serving device work in the same
-        process would inflate them."""
+        """Serving counters: request latency percentiles (p50/p95/p99
+        ms, overall AND per tenant under ``tenants``), QPS over the
+        completion window, rows/batches served, ``shed`` (submissions
+        rejected by backpressure — total, and per tenant), and the
+        per-batch dispatch distribution (the 1-dispatch-per-batch
+        invariant as a number; oversize split requests legitimately cost
+        one dispatch per piece).  The fleet bench reads ITS headline
+        numbers from here — the server is its own observability source.
+        Dispatch deltas read the process-wide profiling counters —
+        concurrent non-serving device work in the same process would
+        inflate them."""
         with self._cv:                      # consistent snapshot vs the
             lat = np.asarray(self._lat)     # worker's accounting block
             disp = np.asarray(self._dispatch_hist, np.int64)
@@ -326,17 +373,25 @@ class PredictServer:
             requests, rows = self._requests, self._rows
             batches, depth = self._batches, len(self._queue)
             queued_rows = self._queued_rows
+            shed = self._shed
+            tenant_lat = {t: np.asarray(d, np.float64)
+                          for t, d in self._tenant_lat.items()}
+            tenant_requests = dict(self._tenant_requests)
+            tenant_shed = dict(self._tenant_shed)
         lat = lat.astype(np.float64)
         window = (t_last - t_first) \
             if t_first is not None and t_last > t_first else None
+        tenants = {}
+        for t in sorted(set(tenant_lat) | set(tenant_shed)):
+            tenants[t] = {"requests": tenant_requests.get(t, 0),
+                          "shed": tenant_shed.get(t, 0),
+                          **self._percentiles(
+                              tenant_lat.get(t, np.empty(0)))}
         return {
             "requests": requests,
             "rows": rows,
             "batches": batches,
-            "p50_ms": round(1e3 * float(np.percentile(lat, 50)), 4)
-            if lat.size else None,
-            "p99_ms": round(1e3 * float(np.percentile(lat, 99)), 4)
-            if lat.size else None,
+            **self._percentiles(lat),
             "qps": round(requests / window, 2) if window else None,
             "rows_per_s": round(rows / window, 2) if window else None,
             "dispatches_per_batch_max": int(disp.max()) if disp.size
@@ -345,6 +400,8 @@ class PredictServer:
             if disp.size else None,
             "queue_depth": depth,
             "queued_rows": queued_rows,
+            "shed": shed,
+            "tenants": tenants,
             "swaps": self._pool.adoptions if self._pool is not None
             else None,
             "rejected_swaps": self._pool.rejections

@@ -132,6 +132,18 @@ def profiled_jit(fn=None, *, name: str | None = None, **jit_kwargs):
     return dispatch
 
 
+def count_dispatch(name: str, n: int = 1) -> None:
+    """Record ``n`` device dispatches that bypass :func:`profiled_jit` —
+    the round-15 deployment-bundle path invokes DESERIALIZED compiled
+    executables directly (no jit wrapper exists to count for it), and
+    the serving layer's one-dispatch-per-batch invariant must stay a
+    counter assertion there too.  Never counts a trace: a deserialized
+    executable cannot retrace by construction."""
+    with _COUNTERS_LOCK:
+        _COUNTERS.dispatches += n
+        _COUNTERS.dispatch_by[name] = _COUNTERS.dispatch_by.get(name, 0) + n
+
+
 def count_transfer(n: int = 1) -> None:
     """Record ``n`` host↔device transfers.  Called by the library's
     blessed sync boundaries — ``runtime.fetch``, ``Array.collect``,

@@ -76,6 +76,11 @@ ALLOWLIST = {
     # server start), never on the request path — the hot path's only
     # sync is the blessed runtime.fetch inside predict_bucket
     ("dislib_tpu/serving/cache.py", "warm"),
+    # round-15 bundle EXPORT: one sync per operand leaf while serializing
+    # the compiled ladder to disk — offline deployment packaging by
+    # definition; the bundle's serve path (BundlePipeline.predict_bucket)
+    # syncs only through the blessed runtime.fetch
+    ("dislib_tpu/serving/bundle.py", "export_bundle"),
 }
 
 _RAW_SYNC_ATTRS = ("device_get", "collect", "block_until_ready")
@@ -162,6 +167,17 @@ RESHARD_ALLOWLIST = {
     # adoption packs ragged per-level host copies into the model's host
     # attrs (post-device_get serialization, not a layout move)
     ("dislib_tpu/trees/decision_tree.py", "_pack"),
+    # elastic snapshot restore: re-pads the VERIFIED HOST snapshot state
+    # to this mesh's pad width before its first device_put — the blessed
+    # resize boundary itself (ingest of host bytes, not a device-array
+    # gather); the density/greedy carries are integer label vectors, so
+    # repad_rows' float row machinery does not apply
+    ("dislib_tpu/cluster/daura.py", "restore"),
+    ("dislib_tpu/cluster/dbscan.py", "restore"),
+    # elastic rebind (round 14): re-pads the HOST ±1 label vector kept
+    # from fit ingest to the resized mesh's pad width before device_put —
+    # ingest-side twin of the restore() entries above
+    ("dislib_tpu/classification/csvm.py", "rebind"),
 }
 
 

@@ -578,6 +578,25 @@ class TestAdoptionGateLint:
         assert "adopt_latest" in src, \
             "hotswap no longer routes through runtime.adoption"
 
+    def test_lint_covers_round15_serving_files(self):
+        """The round-15 files carry the highest-stakes byte handling in
+        the package (serialized executables, embedded model state) — the
+        directory scan must keep seeing them, or the no-raw-IO lint above
+        silently stops protecting exactly where it matters most."""
+        scanned = {os.path.basename(rel) for rel, _ in _serving_files()}
+        assert {"bundle.py", "router.py"} <= scanned
+
+    def test_bundle_routes_bytes_and_state_through_blessed_seams(self):
+        """bundle.py may only touch artifact bytes through the
+        runtime.bundle_io seam (write_bundle/read_bundle — atomic,
+        checksum-verified) and checkpoint state through adopt_latest —
+        the round-15 extension of the adoption-gate discipline."""
+        src = open(os.path.join(REPO, SERVING_DIR, "bundle.py"),
+                   encoding="utf-8").read()
+        for seam in ("write_bundle", "read_bundle", "adopt_latest"):
+            assert seam in src, \
+                f"serving/bundle.py no longer routes through {seam}"
+
     def test_adoption_module_uses_verified_load_and_probe_gate(self):
         """The gate itself must (1) read via checkpoint.load() — the
         checksum-verified, fallback-capable reader — and (2) judge the

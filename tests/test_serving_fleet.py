@@ -1,0 +1,466 @@
+"""Round-15 tentpole: AOT deployment bundles + the multi-tenant router.
+
+- bundle lifecycle: export → load in a warm process is bit-equal across
+  the WHOLE ladder with zero retraces (trace-counter-pinned), and a
+  truly fresh process proves the cold-start claim end-to-end in a
+  subprocess; damage and incompatibility fail typed-and-loud.
+- ladder validation: ``DSLIB_SERVE_BUCKETS`` rejects out-of-order /
+  duplicate / non-integer / non-positive ladders at parse time.
+- tenancy: per-tenant latency/shed observability on the server, quota
+  admission on the router shedding only the offender, hash-deterministic
+  canary splits, and health-gated promotion.
+
+Compile-budget note (tier-1 discipline): ONE feature width (8), ONE
+ladder (1, 8, 64), module-cached fitted models and ONE module-cached
+exported bundle — export pays the ladder's compiles once for the file.
+"""
+
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.runtime import BundleIncompatible
+from dislib_tpu.serving import (BucketLadderError, BundlePipeline,
+                                ModelPool, ModelRouter, PredictServer,
+                                QueueFull, ServePipeline,
+                                TenantQuotaExceeded, bucket_ladder,
+                                export_bundle, load_bundle)
+from dislib_tpu.serving import bundle as bundle_mod
+from dislib_tpu.utils import profiling as prof
+from dislib_tpu.utils.checkpoint import FitCheckpoint, SnapshotCorrupt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BUCKETS = (1, 8, 64)
+NF = 8
+
+_ctx = {}
+
+
+def _linreg(intercept: float) -> ServePipeline:
+    lr = ds.LinearRegression()
+    lr.coef_ = np.ones((NF, 1), np.float32)
+    lr.intercept_ = np.full(1, float(intercept), np.float32)
+    return ServePipeline(lr, n_features=NF)
+
+
+def ctx(tmp_factory=None):
+    """Module-cached pipeline + ONE exported bundle (the export pays the
+    per-bucket lower+compile once for the whole file)."""
+    if not _ctx:
+        _ctx["pipe"] = _linreg(5.0)
+        _ctx["state"] = {"coef": _ctx["pipe"].model.coef_,
+                         "intercept": _ctx["pipe"].model.intercept_}
+        path = str(tmp_factory.mktemp("bundle") / "model.dsb.npz")
+        _ctx["manifest"] = export_bundle(_ctx["pipe"], path,
+                                         buckets=BUCKETS,
+                                         state=_ctx["state"])
+        _ctx["path"] = path
+        _ctx["rng"] = np.random.RandomState(3)
+    return _ctx
+
+
+@pytest.fixture(scope="module")
+def bundle_ctx(tmp_path_factory):
+    return ctx(tmp_path_factory)
+
+
+# ---------------------------------------------------------------------------
+# satellite: strict DSLIB_SERVE_BUCKETS validation
+# ---------------------------------------------------------------------------
+
+class TestLadderValidation:
+    @pytest.mark.parametrize("env,fragment", [
+        ("512,64", "strictly increasing"),
+        ("8,8,64", "strictly increasing"),
+        ("4,banana", "not an integer"),
+        ("0,8", "not positive"),
+        ("-1", "not positive"),
+        (",,", "no buckets"),
+    ])
+    def test_env_ladder_rejected_at_parse_time(self, monkeypatch, env,
+                                               fragment):
+        monkeypatch.setenv("DSLIB_SERVE_BUCKETS", env)
+        with pytest.raises(BucketLadderError) as ei:
+            bucket_ladder()
+        # the deployment postmortem needs the offending value verbatim
+        assert env in str(ei.value) and fragment in str(ei.value)
+
+    def test_env_ladder_accepts_valid(self, monkeypatch):
+        monkeypatch.setenv("DSLIB_SERVE_BUCKETS", " 4 , 32 ,512 ")
+        assert bucket_ladder() == (4, 32, 512)
+
+    def test_typed_error_is_a_valueerror(self):
+        # pre-round-15 callers catching ValueError keep working
+        assert issubclass(BucketLadderError, ValueError)
+
+    def test_programmatic_ladders_still_normalise(self):
+        # a Python-literal ladder is the caller's own code — legacy
+        # sort/dedupe normalisation stays
+        assert bucket_ladder((64, 1, 8, 8)) == (1, 8, 64)
+
+
+# ---------------------------------------------------------------------------
+# bundle lifecycle
+# ---------------------------------------------------------------------------
+
+class TestBundleLifecycle:
+    def test_roundtrip_bit_equal_across_whole_ladder(self, bundle_ctx):
+        c = bundle_ctx
+        lb = load_bundle(c["path"])
+        assert not lb.fallback
+        assert isinstance(lb.pipeline, BundlePipeline)
+        assert lb.buckets == BUCKETS
+        for b in BUCKETS:
+            rows = c["rng"].rand(min(b, 7), NF).astype(np.float32)
+            np.testing.assert_array_equal(
+                lb.pipeline.predict_bucket(rows, b),
+                c["pipe"].predict_bucket(rows, b))
+
+    def test_load_and_serve_add_zero_traces(self, bundle_ctx):
+        c = bundle_ctx
+        t0 = prof.trace_count()
+        lb = load_bundle(c["path"])
+        for b in BUCKETS:
+            lb.pipeline.predict_bucket(
+                c["rng"].rand(1, NF).astype(np.float32), b)
+        assert prof.trace_count() == t0, \
+            "bundle load or serve retraced — the cold-start win is gone"
+
+    def test_bundle_dispatches_are_counted(self, bundle_ctx):
+        c = bundle_ctx
+        lb = load_bundle(c["path"])
+        prof.reset_counters()
+        lb.pipeline.predict_bucket(np.ones((3, NF), np.float32), 8)
+        assert prof.counters()["dispatch_by"].get("bundle_exec") == 1
+
+    def test_embedded_state_roundtrips(self, bundle_ctx):
+        c = bundle_ctx
+        lb = load_bundle(c["path"])
+        assert sorted(lb.state) == ["coef", "intercept"]
+        np.testing.assert_array_equal(lb.state["coef"], c["state"]["coef"])
+
+    def test_truncation_is_typed_and_loud(self, bundle_ctx, tmp_path):
+        data = open(bundle_ctx["path"], "rb").read()
+        bad = tmp_path / "trunc.npz"
+        bad.write_bytes(data[: len(data) // 2])
+        with pytest.raises(SnapshotCorrupt):
+            load_bundle(str(bad))
+
+    def test_bit_corruption_is_typed_and_loud(self, bundle_ctx, tmp_path):
+        data = bytearray(open(bundle_ctx["path"], "rb").read())
+        data[len(data) // 2] ^= 0xFF
+        bad = tmp_path / "flip.npz"
+        bad.write_bytes(bytes(data))
+        with pytest.raises(SnapshotCorrupt):
+            load_bundle(str(bad))
+
+    def test_foreign_file_is_typed(self, tmp_path):
+        alien = tmp_path / "alien.npz"
+        np.savez(alien, x=np.ones(3))
+        with pytest.raises(SnapshotCorrupt):
+            load_bundle(str(alien))
+
+    def test_fingerprint_mismatch_refuses_cleanly(self, bundle_ctx,
+                                                  monkeypatch):
+        real = bundle_mod.runtime_fingerprint()
+
+        def other():
+            fp = dict(real)
+            fp["jaxlib"] = "99.0.0"
+            fp["n_devices"] = 1024
+            return fp
+
+        monkeypatch.setattr(bundle_mod, "runtime_fingerprint", other)
+        with pytest.raises(BundleIncompatible) as ei:
+            load_bundle(bundle_ctx["path"])
+        # both fingerprints ride the error for the postmortem
+        assert ei.value.expected["jaxlib"] == real["jaxlib"]
+        assert ei.value.found["jaxlib"] == "99.0.0"
+        assert "jaxlib" in str(ei.value)
+
+    def test_fingerprint_mismatch_falls_back_loudly_with_build(
+            self, bundle_ctx, monkeypatch):
+        monkeypatch.setattr(
+            bundle_mod, "runtime_fingerprint",
+            lambda: {**bundle_ctx["manifest"]["fingerprint"],
+                     "platform": "definitely-not-this"})
+
+        def build(state):
+            lr = ds.LinearRegression()
+            lr.coef_ = np.asarray(state["coef"], np.float32)
+            lr.intercept_ = np.asarray(state["intercept"], np.float32)
+            return ServePipeline(lr, n_features=NF)
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            lb = load_bundle(bundle_ctx["path"], build=build)
+        assert lb.fallback
+        assert any("cold-start protection is LOST" in str(x.message)
+                   for x in w)
+        rows = np.ones((2, NF), np.float32)
+        np.testing.assert_array_equal(
+            lb.pipeline.predict_bucket(rows, 8),
+            bundle_ctx["pipe"].predict_bucket(rows, 8))
+
+    def test_export_via_checkpoint_routes_through_the_gate(
+            self, bundle_ctx, tmp_path):
+        ckpt = FitCheckpoint(str(tmp_path / "ck"), keep=2)
+        ckpt.save(bundle_ctx["state"])
+        path = str(tmp_path / "ck.dsb.npz")
+        export_bundle(bundle_ctx["pipe"], path, buckets=(1,),
+                      checkpoint=ckpt)
+        lb = load_bundle(path)
+        np.testing.assert_array_equal(lb.state["coef"],
+                                      bundle_ctx["state"]["coef"])
+
+    def test_export_empty_checkpoint_refuses(self, bundle_ctx, tmp_path):
+        ckpt = FitCheckpoint(str(tmp_path / "empty"), keep=2)
+        with pytest.raises(ValueError, match="no generation"):
+            export_bundle(bundle_ctx["pipe"], str(tmp_path / "x.npz"),
+                          buckets=(1,), checkpoint=ckpt)
+
+    def test_bundle_pipeline_rejects_bad_requests(self, bundle_ctx):
+        lb = load_bundle(bundle_ctx["path"])
+        with pytest.raises(ValueError, match="not in the bundle"):
+            lb.pipeline.predict_bucket(np.ones((2, NF), np.float32), 16)
+        with pytest.raises(ValueError, match="features"):
+            lb.pipeline.predict_bucket(np.ones((2, NF + 1), np.float32), 8)
+        with pytest.raises(ValueError, match="exceed bucket"):
+            lb.pipeline.predict_bucket(np.ones((9, NF), np.float32), 8)
+
+    def test_serves_through_predict_server(self, bundle_ctx):
+        lb = load_bundle(bundle_ctx["path"])
+        with PredictServer(pipeline=lb.pipeline, buckets=BUCKETS,
+                           name="bundle-srv") as srv:
+            rows = np.ones((3, NF), np.float32)
+            np.testing.assert_array_equal(
+                srv.predict(rows),
+                bundle_ctx["pipe"].predict_bucket(rows, 8))
+
+
+_FRESH_PROCESS_SCRIPT = """
+import os, sys, json
+import numpy as np
+import dislib_tpu as ds
+ds.init()
+from dislib_tpu.serving import load_bundle
+from dislib_tpu.utils import profiling as prof
+lb = load_bundle(sys.argv[1])
+t0 = prof.trace_count()
+outs = {b: lb.pipeline.predict_bucket(
+            np.ones((min(b, 4), lb.pipeline.n_features), np.float32), b
+        ).tolist() for b in lb.buckets}
+print(json.dumps({"traces": prof.trace_count() - t0,
+                  "fallback": lb.fallback, "outs": outs}))
+"""
+
+
+class TestBundleFreshProcess:
+    def test_fresh_process_serves_with_zero_traces(self, bundle_ctx):
+        """The actual cold-start claim: a process that has never seen
+        the model serves the whole ladder off the bundle without a
+        single trace."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (flags +
+                                " --xla_force_host_platform_device_count"
+                                "=8").strip()
+        out = subprocess.run(
+            [sys.executable, "-c", _FRESH_PROCESS_SCRIPT,
+             bundle_ctx["path"]],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO)
+        assert out.returncode == 0, out.stderr[-2000:]
+        import json
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+        assert res["traces"] == 0 and not res["fallback"]
+        for b in BUCKETS:
+            rows = np.ones((min(b, 4), NF), np.float32)
+            np.testing.assert_array_equal(
+                np.asarray(res["outs"][str(b)], np.float32),
+                bundle_ctx["pipe"].predict_bucket(rows, b))
+
+
+# ---------------------------------------------------------------------------
+# satellite: per-tenant server observability + typed backpressure
+# ---------------------------------------------------------------------------
+
+class TestTenantStats:
+    def test_per_tenant_percentiles_and_shed(self, bundle_ctx):
+        with PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS,
+                           name="tsrv") as srv:
+            for t in ("acme", "globex"):
+                for _ in range(4):
+                    srv.predict(np.ones((2, NF), np.float32), tenant=t)
+            st = srv.stats()
+        assert st["shed"] == 0
+        for t in ("acme", "globex"):
+            ten = st["tenants"][t]
+            assert ten["requests"] == 4 and ten["shed"] == 0
+            assert ten["p50_ms"] is not None
+            assert ten["p50_ms"] <= ten["p95_ms"] <= ten["p99_ms"]
+        assert st["p95_ms"] is not None    # overall window grew p95 too
+
+    def test_queue_full_is_typed_and_tenant_attributed(self, bundle_ctx):
+        srv = PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS,
+                            max_queue_rows=4, name="tiny")
+        srv.start()
+        try:
+            # stall the worker by never letting it win the deadline race:
+            # fill the queue within one deadline window
+            srv.deadline_s = 5.0
+            srv.submit(np.ones((4, NF), np.float32), tenant="acme")
+            with pytest.raises(QueueFull) as ei:
+                srv.submit(np.ones((1, NF), np.float32), tenant="acme")
+            assert ei.value.tenant == "acme"
+            assert isinstance(ei.value, RuntimeError)   # legacy catch
+            st = srv.stats()
+            assert st["shed"] == 1
+            assert st["tenants"]["acme"]["shed"] == 1
+        finally:
+            srv.deadline_s = 0.001
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-tenant router
+# ---------------------------------------------------------------------------
+
+class TestModelRouter:
+    def test_n_tenants_one_ladder_zero_extra_traces(self, bundle_ctx):
+        """The executable-sharing claim: tenants 2..N on an
+        already-warmed shared server cost ZERO additional compiles."""
+        srv = PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS,
+                            name="shared")
+        r = ModelRouter()
+        for t in ("a", "b", "c"):
+            r.add_tenant(t, srv)
+        with r:
+            t0 = prof.trace_count()
+            for t in ("a", "b", "c"):
+                for k in (1, 3, 8):
+                    r.predict(np.ones((k, NF), np.float32), t)
+            assert prof.trace_count() == t0
+            st = r.stats()
+        assert all(st[t]["serving"]["requests"] == 3 for t in "abc")
+
+    def test_quota_sheds_only_the_offender(self, bundle_ctx):
+        srv = PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS,
+                            name="quota")
+        r = ModelRouter()
+        r.add_tenant("noisy", srv, quota_rows=4)
+        r.add_tenant("quiet", srv)
+        with r:
+            # the worker computes its flush window once per batch: 1 s is
+            # long enough to keep noisy's rows in flight for the quota
+            # check, short enough not to stall the suite
+            srv.deadline_s = 1.0
+            f1 = r.submit(np.ones((4, NF), np.float32), "noisy")
+            with pytest.raises(TenantQuotaExceeded) as ei:
+                r.submit(np.ones((1, NF), np.float32), "noisy")
+            assert ei.value.tenant == "noisy"
+            assert ei.value.quota_rows == 4
+            # the neighbour is untouched — same instant, same server
+            f2 = r.submit(np.ones((2, NF), np.float32), "quiet")
+            srv.deadline_s = 0.001
+            assert f1.result(timeout=30).values.shape == (4, 1)
+            assert f2.result(timeout=30).values.shape == (2, 1)
+            assert r.stats()["noisy"]["quota_shed"] == 1
+            assert r.stats()["quiet"]["quota_shed"] == 0
+
+    def test_quota_releases_on_completion(self, bundle_ctx):
+        srv = PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS,
+                            name="rel")
+        r = ModelRouter()
+        r.add_tenant("t", srv, quota_rows=4)
+        with r:
+            for _ in range(5):      # serially: quota frees every time
+                r.predict(np.ones((4, NF), np.float32), "t")
+            assert r.stats()["t"]["inflight_rows"] == 0
+
+    def test_canary_split_is_deterministic_and_reaches_both_arms(
+            self, bundle_ctx):
+        s1 = PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS,
+                           name="primary")
+        s2 = PredictServer(pipeline=_linreg(6.0), buckets=BUCKETS,
+                           name="canary")
+        r = ModelRouter()
+        r.add_tenant("t", s1)
+        r.set_canary("t", s2, fraction=0.5)
+        rows = np.ones((1, NF), np.float32)
+        labels = {}
+        for i in range(32):
+            _, label = r.route("t", rows, key=f"user{i}")
+            labels[f"user{i}"] = label
+        assert set(labels.values()) == {"t", "t:canary"}
+        for i in range(32):     # same key → same arm, always
+            _, label = r.route("t", rows, key=f"user{i}")
+            assert label == labels[f"user{i}"]
+
+    def test_canary_promote_and_generation_oracle(self, bundle_ctx):
+        s1 = PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS,
+                           name="gen5")
+        s2 = PredictServer(pipeline=_linreg(6.0), buckets=BUCKETS,
+                           name="gen6")
+        r = ModelRouter()
+        r.add_tenant("t", s1)
+        rows = np.ones((1, NF), np.float32)
+        with r:
+            r.set_canary("t", s2, fraction=0.5)     # starts s2 too
+            seen = set()
+            for i in range(32):
+                v = r.predict(rows, "t", key=f"user{i}")
+                seen.add(float(v.ravel()[0]) - NF)  # intercept = gen
+            assert seen == {5.0, 6.0}   # both generations really served
+            r.promote("t")
+            for i in range(16):
+                v = r.predict(rows, "t", key=f"user{i}")
+                assert float(v.ravel()[0]) - NF == 6.0
+            assert r.stats()["t"]["promotions"] == 1
+
+    def test_promote_refuses_unadopted_pool_canary(self, bundle_ctx,
+                                                   tmp_path):
+        s1 = PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS,
+                           name="ok")
+        pool = ModelPool(FitCheckpoint(str(tmp_path / "never"), keep=2),
+                         build=lambda s: _linreg(0.0), buckets=BUCKETS)
+        s2 = PredictServer(pool=pool, name="hollow")
+        r = ModelRouter()
+        r.add_tenant("t", s1)
+        r._tenants["t"].canary = s2     # bypass set_canary's start
+        r._tenants["t"].canary_fraction = 0.5
+        with pytest.raises(RuntimeError, match="adoption gate"):
+            r.promote("t")
+        assert r._tenants["t"].server is s1     # traffic stayed put
+
+    def test_abort_canary_restores_primary(self, bundle_ctx):
+        s1 = PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS,
+                           name="p")
+        s2 = PredictServer(pipeline=_linreg(6.0), buckets=BUCKETS,
+                           name="c")
+        r = ModelRouter()
+        r.add_tenant("t", s1)
+        r.set_canary("t", s2, fraction=1.0)
+        rows = np.ones((1, NF), np.float32)
+        assert r.route("t", rows, key="k")[1] == "t:canary"
+        r.abort_canary("t")
+        assert r.route("t", rows, key="k")[1] == "t"
+
+    def test_unknown_tenant_and_duplicates_are_typed(self, bundle_ctx):
+        srv = PredictServer(pipeline=bundle_ctx["pipe"], buckets=BUCKETS)
+        r = ModelRouter()
+        r.add_tenant("t", srv)
+        with pytest.raises(ValueError, match="already registered"):
+            r.add_tenant("t", srv)
+        with pytest.raises(KeyError, match="unknown tenant"):
+            r.submit(np.ones((1, NF), np.float32), "ghost")
+        with pytest.raises(TypeError, match="PredictServer"):
+            r.add_tenant("u", object())

@@ -12,6 +12,13 @@ Invariants asserted over the whole run (the round-9 acceptance bar):
   and a mid-stream corruption of the newest generation file neither
   fails a request nor serves garbage.
 
+Round 15 adds the FLEET soak (``tools/serving_soak.sh --fleet``): three
+tenants with distinct models on one ModelRouter under mixed-shape load,
+one tenant taking a mid-stream canary that is promoted under fire — the
+oracle encodes (tenant, generation) into every prediction, so a single
+cross-tenant routing mistake or a torn promotion is a decoded wrong
+number, not a vibe.
+
 Knobs: DSLIB_SOAK_GENS (default 6), DSLIB_SOAK_CLIENTS (3),
 DSLIB_SOAK_SECONDS (6).
 """
@@ -24,7 +31,8 @@ import numpy as np
 import pytest
 
 import dislib_tpu as ds
-from dislib_tpu.serving import ModelPool, PredictServer, ServePipeline
+from dislib_tpu.serving import (ModelPool, ModelRouter, PredictServer,
+                                ServePipeline)
 from dislib_tpu.utils.checkpoint import FitCheckpoint
 from dislib_tpu.utils.faults import corrupt_snapshot
 
@@ -126,3 +134,126 @@ def test_serving_soak_across_hot_swaps(tmp_path):
     assert len(seen) >= 3, f"request stream only saw generations {seen}"
     assert stats["dispatches_per_batch_max"] == 1, stats
     assert stats["requests"] > 50, stats
+
+
+# ---------------------------------------------------------------------------
+# round-15 fleet soak: multi-tenant router under mixed-shape fire with a
+# mid-stream canary promotion
+# ---------------------------------------------------------------------------
+
+def _tenant_pipe(tenant_idx: int, gen: int) -> ServePipeline:
+    """ŷ = Σx + 1000·(tenant_idx+1) + gen: the decoded intercept names
+    BOTH who should have answered and which generation did — one routing
+    mistake anywhere in the fleet is a wrong thousands digit."""
+    lr = ds.LinearRegression()
+    lr.coef_ = np.ones((NF, 1), np.float32)
+    lr.intercept_ = np.full(1, 1000.0 * (tenant_idx + 1) + gen,
+                            np.float32)
+    return ServePipeline(lr, n_features=NF)
+
+
+@pytest.mark.slow
+def test_fleet_soak_three_tenants_canary_promotion():
+    seconds = float(os.environ.get("DSLIB_SOAK_SECONDS", "6"))
+    tenants = ("alpha", "beta", "gamma")
+    servers = {t: PredictServer(pipeline=_tenant_pipe(i, 1),
+                                buckets=BUCKETS, name=f"{t}-gen1")
+               for i, t in enumerate(tenants)}
+    canary = PredictServer(pipeline=_tenant_pipe(1, 2), buckets=BUCKETS,
+                           name="beta-gen2")
+    router = ModelRouter(name="fleet")
+    for t in tenants:
+        router.add_tenant(t, servers[t], quota_rows=4096)
+    stop = threading.Event()
+    promoted = threading.Event()
+    errors = []
+    shapes = (1, 3, 8, 20, 64)          # mixed, all within the ladder
+    gens_seen = {t: set() for t in tenants}
+
+    def client(cid, tenant, tenant_idx):
+        crng = np.random.RandomState(cid)
+        base = 1000.0 * (tenant_idx + 1)
+        i = 0
+        while not stop.is_set():
+            i += 1
+            k = int(shapes[crng.randint(0, len(shapes))])
+            rows = crng.rand(k, NF).astype(np.float32)
+            sent_after_promote = promoted.is_set()
+            try:
+                r = router.submit(rows, tenant,
+                                  key=f"{tenant}:{cid}:{i}").result(
+                                      timeout=60)
+            except Exception as e:  # noqa: BLE001 — any failure fails soak
+                errors.append(f"{tenant}/{cid}: {type(e).__name__}: {e}")
+                return
+            vals = np.round(r.values.ravel() - rows.sum(axis=1), 3)
+            decoded = np.unique(vals)
+            if len(decoded) != 1:
+                errors.append(f"{tenant}/{cid}: TORN response {decoded}")
+                return
+            g = float(decoded[0]) - base
+            if g not in (1.0, 2.0):     # wrong tenant's model answered
+                errors.append(f"{tenant}/{cid}: cross-tenant leak — "
+                              f"decoded {decoded[0]} (base {base})")
+                return
+            if g == 2.0 and tenant != "beta":
+                errors.append(f"{tenant}/{cid}: canary generation leaked "
+                              "outside beta")
+                return
+            if sent_after_promote and tenant == "beta" and g != 2.0:
+                errors.append(f"beta/{cid}: generation 1 served after "
+                              "promotion")
+                return
+            gens_seen[tenant].add(g)
+
+    with router:
+        # fleet-wide dispatch accounting: the per-batch deltas inside
+        # each server cross-inflate when four servers dispatch
+        # concurrently in one process (documented in stats()), so the
+        # one-dispatch-per-batch invariant is asserted GLOBALLY below —
+        # total fused dispatches == total batches (+ canary warmup)
+        from dislib_tpu.utils import profiling as prof
+        prof.reset_counters()
+        threads = [threading.Thread(target=client, args=(17 * i + j, t, i))
+                   for i, t in enumerate(tenants) for j in range(2)]
+        for th in threads:
+            th.start()
+        time.sleep(seconds / 3)
+        router.set_canary("beta", canary, fraction=0.5)
+        time.sleep(seconds / 3)
+        router.promote("beta")
+        promoted.set()
+        time.sleep(seconds / 3)
+        stop.set()
+        for th in threads:
+            th.join()
+        rstats = router.stats()
+        sstats = {t: servers[t].stats() for t in tenants}
+        cstats = canary.stats()
+        fused_dispatches = prof.counters()["dispatch_by"].get(
+            "fused_chain", 0)
+
+    assert not errors, "fleet soak failures:\n  " + "\n  ".join(errors)
+    # every tenant served, from its own model only
+    for t in tenants:
+        assert gens_seen[t], f"tenant {t} never served"
+    # the canary really took traffic before AND kept it after promotion
+    assert gens_seen["beta"] == {1.0, 2.0}, gens_seen["beta"]
+    assert rstats["beta"]["promotions"] == 1
+    assert cstats["tenants"]["beta:canary"]["requests"] > 0
+    assert cstats["tenants"]["beta"]["requests"] > 0    # post-promote
+    # one fused dispatch per batch ACROSS THE FLEET: every served batch
+    # on all four servers costs exactly one fused dispatch, plus the
+    # canary's mid-stream warmup (one dispatch per ladder bucket)
+    total_batches = sum(s["batches"] for s in sstats.values()) \
+        + cstats["batches"]
+    assert fused_dispatches == total_batches + len(BUCKETS), (
+        fused_dispatches, total_batches, sstats, cstats)
+    # the server-side tenant labels never bled across servers
+    for t in tenants:
+        foreign = set(sstats[t]["tenants"]) - {t}
+        assert not foreign, f"{t}'s server saw foreign tenants {foreign}"
+    assert set(cstats["tenants"]) <= {"beta", "beta:canary"}
+    # nobody was shed (quotas generous, queues never filled)
+    assert all(rstats[t]["quota_shed"] == 0 for t in tenants), rstats
+    assert all(s["shed"] == 0 for s in sstats.values())

@@ -80,7 +80,15 @@ SECTIONS = [
     ("Serving", "dislib_tpu.serving",
      ["ServePipeline", "PredictServer", "ServeResponse", "ModelPool",
       "ProgramCache", "bucket_ladder", "bucket_for", "split_rows",
-      "SparseFoldInPipeline", "pack_sparse_rows"]),
+      "SparseFoldInPipeline", "pack_sparse_rows",
+      "BucketLadderError", "QueueFull"]),
+    ("Deployment bundles (AOT serving artifacts)", "dislib_tpu.serving",
+     ["export_bundle", "load_bundle", "runtime_fingerprint",
+      "BundlePipeline", "LoadedBundle"]),
+    ("Bundle I/O (checksummed artifact seam)", "dislib_tpu.runtime",
+     ["write_bundle", "read_bundle", "BundleIncompatible"]),
+    ("Multi-tenant routing", "dislib_tpu.serving",
+     ["ModelRouter", "TenantQuotaExceeded"]),
     ("Ingest quarantine", "dislib_tpu",
      ["QuarantineReport", "QuarantineLedger", "last_quarantine_report",
       "quarantine_ledger"]),

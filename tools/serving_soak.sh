@@ -3,19 +3,32 @@
 # checkpoint hot-swaps (slow tier — excluded from tier-1; the fast
 # handoff coverage lives in tests/test_serving.py).
 #
-#   tools/serving_soak.sh [GENS] [SECONDS] [CLIENTS]
+#   tools/serving_soak.sh [GENS] [SECONDS] [CLIENTS]    # hot-swap soak
+#   tools/serving_soak.sh --fleet [SECONDS]             # round-15 fleet
 #
-# Asserted invariants (see tests/test_serving_soak.py): zero failed
+# Hot-swap invariants (tests/test_serving_soak.py): zero failed
 # requests, zero torn responses, zero stale-after-adoption responses,
 # >= 2 swaps under load, one fused dispatch per warm batch, and a
 # mid-stream corrupted generation neither failing a request nor serving
 # garbage.
+#
+# Fleet invariants (--fleet): three tenants with distinct models on one
+# ModelRouter under mixed-shape load, one mid-stream canary promotion —
+# zero cross-tenant leakage (every prediction decodes to the right
+# (tenant, generation)), generation 1 never served to beta after its
+# promotion, one fused dispatch per batch fleet-wide, zero shed.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--fleet" ]]; then
+    export DSLIB_SOAK_SECONDS="${2:-6}"
+    exec env JAX_PLATFORMS=cpu python -m pytest tests/test_serving_soak.py \
+        -q -m slow -k fleet -p no:cacheprovider -rs
+fi
 
 export DSLIB_SOAK_GENS="${1:-6}"
 export DSLIB_SOAK_SECONDS="${2:-6}"
 export DSLIB_SOAK_CLIENTS="${3:-3}"
 
 exec env JAX_PLATFORMS=cpu python -m pytest tests/test_serving_soak.py \
-    -q -m slow -p no:cacheprovider -rs
+    -q -m slow -k "not fleet" -p no:cacheprovider -rs
