@@ -1426,6 +1426,113 @@ def bench_serving_fleet(m, n, k, n_requests, tag, buckets=(1, 8, 64),
                     "the server's own stats()"}
 
 
+def bench_trainer(rows, n, k, generations, tag, batches_per_generation=4,
+                  buckets=(1, 8, 64), deadline_ms=2):
+    """Round-17 tier: the continuous-learning loop end-to-end —
+    ``ContinuousTrainer`` drives train → bundle → canary → promote for
+    ``generations`` cadences of a streaming ``MiniBatchKMeans`` against
+    a live ``ModelRouter`` tenant, and the row reads the cadence the
+    loop sustains plus where the wall goes (train vs export vs promote,
+    per-phase from the promotion ledger's own timings).
+
+    Hard gates: every generation promotes (the canary health gate passes
+    a clean stream), the served generation lands on the last one, the
+    post-promotion burst through the router performs ZERO traces (the
+    canary serves deserialized AOT executables — promotion never
+    recompiles the predict path), every response finite, and the on-disk
+    ``ledger.jsonl`` replays the in-memory promotion ledger exactly."""
+    import tempfile
+    import dislib_tpu as ds
+    from dislib_tpu.runtime import ContinuousTrainer
+    from dislib_tpu.serving import ModelRouter, ServePipeline
+    from dislib_tpu.utils import FitCheckpoint
+    from dislib_tpu.utils import profiling as _prof
+
+    rng = np.random.RandomState(0)
+    centers = (rng.rand(k, n) * 10).astype(np.float32)
+
+    def stream():
+        while True:
+            lab = rng.randint(0, k, rows)
+            yield (centers[lab]
+                   + 0.3 * rng.randn(rows, n)).astype(np.float32)
+
+    probe = (centers[rng.randint(0, k, 16)]
+             + 0.3 * rng.randn(16, n)).astype(np.float32)
+    with tempfile.TemporaryDirectory() as td:
+        router = ModelRouter(name="trainer-bench")
+        tr = ContinuousTrainer(
+            ds.MiniBatchKMeans(n_clusters=k, random_state=0), stream(),
+            FitCheckpoint(os.path.join(td, "ck.npz"), every=2, keep=2),
+            lambda est, g: ServePipeline(est, n_features=n),
+            os.path.join(td, "bundles"), router=router, tenant="alpha",
+            buckets=buckets, batches_per_generation=batches_per_generation,
+            probe=probe, deadline_ms=deadline_ms, name="bench-trainer")
+        t_train = 0.0
+        burst_traces = 0
+        with router:
+            t_all = time.perf_counter()
+            for _ in range(generations):
+                t0 = time.perf_counter()
+                if not tr.train_generation():
+                    raise AssertionError("infinite stream exhausted?!")
+                t_train += time.perf_counter() - t0
+                rec = tr.publish_generation()
+                if rec["verdict"] != "promoted":
+                    raise AssertionError(
+                        f"clean generation {rec['generation']} not "
+                        f"promoted: {rec}")
+                # post-promotion burst: mixed shapes through the router,
+                # zero traces gated — promotion must never recompile the
+                # predict path
+                tr0 = _prof.trace_count()
+                futs = [router.submit(probe[: 1 + (i % len(probe))],
+                                      "alpha",
+                                      key=f"g{rec['generation']}:{i}")
+                        for i in range(16)]
+                outs = [f.result(timeout=120) for f in futs]
+                burst_traces += _prof.trace_count() - tr0
+                for o in outs:
+                    if not np.all(np.isfinite(o.values)):
+                        raise AssertionError("bad served response")
+            wall = time.perf_counter() - t_all
+            stats = tr.stats()
+            tr.close()
+        if burst_traces:
+            raise AssertionError(
+                f"promotion bursts traced {burst_traces}x — the "
+                "zero-retrace promotion claim is broken")
+        if stats["promotions"] != generations \
+                or stats["served_generation"] != generations:
+            raise AssertionError(f"promotion ledger off: {stats}")
+        with open(os.path.join(td, "bundles", "ledger.jsonl")) as f:
+            disk = [json.loads(line) for line in f]
+        if disk != tr.ledger:
+            raise AssertionError("ledger.jsonl does not replay the "
+                                 "in-memory promotion ledger")
+        exp = [r["export_s"] for r in tr.ledger if "export_s" in r]
+        pro = [r["promote_s"] for r in tr.ledger if "promote_s" in r]
+
+    return {"metric": f"trainer_{tag}_generations_per_min (train -> "
+                      "bundle -> canary -> promote cadence, all promoted)",
+            "value": round(generations / (wall / 60.0), 2),
+            "unit": "gen/min", "vs_baseline": None,
+            "generations": generations,
+            "batches_per_generation": batches_per_generation,
+            "train_s_per_gen": round(t_train / generations, 4),
+            "export_s_per_gen": round(float(np.mean(exp)), 4),
+            "export_s_max": round(float(np.max(exp)), 4),
+            "promote_s_per_gen": round(float(np.mean(pro)), 4),
+            "burst_traces": burst_traces,
+            "batches": stats["batches"],
+            "quarantined_rows": stats["quarantine"]["n_quarantined"],
+            "buckets": list(buckets), "fresh": True,
+            "note": "per-phase walls from the promotion ledger's own "
+                    "export_s/promote_s; gates: all generations promoted, "
+                    "zero traces on the post-promotion burst, finite "
+                    "responses, ledger.jsonl == in-memory ledger"}
+
+
 def bench_resilience(m, n, k, iters, tag, every=2):
     """Resilience-layer row (round-12): a NaN-poisoned chunked KMeans fit
     heals through the fit-loop driver's rollback ladder.  Three gates,
@@ -2475,6 +2582,13 @@ def _configs():
              lambda: bench_serving_fleet(2000, 8, 4, 300, "smoke",
                                          buckets=(1, 8, 64),
                                          deadline_ms=2)),
+            # round-17 continuous-learning tier: train -> bundle ->
+            # canary -> promote cadence, all promoted, zero-retrace
+            # post-promotion bursts gated
+            ("trainer_smoke",
+             lambda: bench_trainer(512, 8, 4, 4, "smoke",
+                                   batches_per_generation=3,
+                                   buckets=(1, 8, 64), deadline_ms=2)),
             ("als_smoke", lambda: bench_als_sparse(1000, 400, 10, "smoke",
                                                    n_f=8, iters=2)),
             # round-14 sparse fast path: SpMM >= 2x the densify A/B at
@@ -2579,6 +2693,13 @@ def _configs():
                                      "1000000x100_k10",
                                      buckets=(1, 8, 64, 512),
                                      deadline_ms=5)),
+        # round-17 continuous-learning loop at paper-ish scale: 8k-row
+        # batches through train -> bundle -> canary -> promote, same
+        # all-promoted / zero-retrace-burst / ledger-replay gates
+        ("trainer_8192x100_k10_generations_per_min",
+         lambda: bench_trainer(8192, 100, 10, 5, "8192x100_k10",
+                               batches_per_generation=6,
+                               buckets=(1, 8, 64, 512), deadline_ms=5)),
         ("shuffle_2097152x64_gb_per_sec",
          lambda: bench_shuffle(2_097_152, 64, "2097152x64")),
         ("matmul_16384_f32_gflops_per_chip",

@@ -57,7 +57,7 @@ from dislib_tpu.data.array import (
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
     QuarantineLedger, QuarantineReport, last_quarantine_report,
-    quarantine_ledger,
+    quarantine_ledger, quarantine_batch,
 )
 from dislib_tpu.data.sparse import SparseArray
 from dislib_tpu.math import matmul, kron, svd, qr, polar
@@ -101,6 +101,8 @@ __all__ = [
     "ensure_canonical", "SparseArray",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
     "save_txt",
+    "QuarantineReport", "QuarantineLedger", "last_quarantine_report",
+    "quarantine_ledger", "quarantine_batch",
     "matmul", "kron", "svd", "qr", "polar", "overlap_schedule",
     "tsqr", "random_svd", "lanczos_svd", "PCA",
     "shuffle", "train_test_split", "save_model", "load_model",

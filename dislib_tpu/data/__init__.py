@@ -5,7 +5,7 @@ from dislib_tpu.data.array import (
 from dislib_tpu.data.io import (
     load_txt_file, load_svmlight_file, load_npy_file, load_mdcrd_file, save_txt,
     QuarantineLedger, QuarantineReport, last_quarantine_report,
-    quarantine_ledger,
+    quarantine_ledger, quarantine_batch,
 )
 from dislib_tpu.data.sparse import SparseArray
 
@@ -15,5 +15,6 @@ __all__ = [
     "ensure_canonical",
     "load_txt_file", "load_svmlight_file", "load_npy_file", "load_mdcrd_file",
     "save_txt", "QuarantineReport", "QuarantineLedger",
-    "last_quarantine_report", "quarantine_ledger", "SparseArray",
+    "last_quarantine_report", "quarantine_ledger", "quarantine_batch",
+    "SparseArray",
 ]

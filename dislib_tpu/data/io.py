@@ -231,6 +231,25 @@ def _quarantine_rows(data, source, opt, labels=None):
     return clean, clean_labels, report
 
 
+def quarantine_batch(batch, source="stream", quarantine=None):
+    """Screen one host batch of a streaming fit through the ingest
+    quarantine — the per-batch face of the same machinery the file
+    loaders ride (round-17 trainer seam).  Non-finite rows are split
+    out, reported to the process-wide :class:`QuarantineLedger` (exact
+    totals accumulate across batches and generations; retained reports
+    stay bounded by ``DSLIB_QUARANTINE_LEDGER_CAP``), and counted in
+    the resilience counters.  Returns ``(clean_rows, report_or_None)``;
+    raises ``ValueError`` when EVERY row is dirty (nothing to learn
+    from — callers skip the batch and keep the stream alive).  1-D
+    input is treated as a single row; multi-process jobs skip the
+    screen (module docstring)."""
+    data = np.asarray(batch, np.float32)
+    if data.ndim == 1:
+        data = data.reshape(1, -1)
+    clean, _, report = _quarantine_rows(data, source, quarantine)
+    return clean, report
+
+
 def _retrying_loader(fn):
     """Retry a whole loader under the env-tunable transient-failure policy
     (``dislib_tpu.runtime.Retry``): a flaky shared filesystem (EIO,

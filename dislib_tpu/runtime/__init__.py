@@ -26,7 +26,13 @@ the survival story is built from four pieces that compose (SURVEY §6
   checksum-embedding writes and verified reads of the AOT serving
   artifact, plus the typed :class:`BundleIncompatible`
   (``bundle_io.py``; ``serving.bundle`` assembles the artifact, this
-  module owns its bytes — serving code never touches them raw).
+  module owns its bytes — serving code never touches them raw);
+- **trainer** — the round-17 continuous-learning daemon:
+  :class:`ContinuousTrainer` welds the quarantined stream, the chunked
+  fit loop, retried bundle exports, and the router's canary/promote
+  seam into one train → bundle → canary → promote loop with a promotion
+  ledger, automatic stay-on-last-good rollback, and the typed
+  :class:`PromotionFailed` (``trainer.py``).
 
 Crash-consistent rotating snapshots live with the checkpoint format in
 ``dislib_tpu.utils.checkpoint``; the deterministic fault-injection harness
@@ -51,6 +57,7 @@ from dislib_tpu.runtime.retry import Retry, is_transient_error, retry_call
 from dislib_tpu.runtime.fitloop import (ChunkedFitLoop, ChunkOutcome,
                                         Escalation, EscalationLadder,
                                         LoopState)
+from dislib_tpu.runtime.trainer import ContinuousTrainer, PromotionFailed
 
 __all__ = [
     "Preempted", "PreemptionWatcher", "preemption_requested",
@@ -64,5 +71,6 @@ __all__ = [
     "BundleIncompatible", "read_bundle", "write_bundle",
     "ChunkedFitLoop", "ChunkOutcome", "LoopState", "Escalation",
     "EscalationLadder",
+    "ContinuousTrainer", "PromotionFailed",
     "health", "xla_flags",
 ]

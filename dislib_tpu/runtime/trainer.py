@@ -1,0 +1,533 @@
+"""Continuous-learning trainer daemon (round-17 tentpole): train →
+bundle → canary → promote, forever, surviving every seam.
+
+Every piece of the continuous-learning loop existed before this module —
+the streaming :meth:`~dislib_tpu.runtime.fitloop.ChunkedFitLoop.run_one`
+driver (PR 10), rotating :class:`~dislib_tpu.utils.checkpoint.FitCheckpoint`
+generations (PR 1/6), AOT deployment bundles and the
+:class:`~dislib_tpu.serving.router.ModelRouter` canary/promote seam
+(PR 15) — but nothing connected them end-to-end.
+:class:`ContinuousTrainer` is that connection, and it is designed around
+failure at every seam, because a loop that must run *forever* meets every
+failure eventually:
+
+- **stream seam** — each raw host batch rides the ingest quarantine
+  (:func:`dislib_tpu.data.io.quarantine_batch`) before it reaches the
+  estimator: non-finite rows are isolated into the process-wide
+  :class:`~dislib_tpu.data.io.QuarantineLedger` (exact totals across
+  generations, bounded retained reports) instead of poisoning the fit.
+  A batch that quarantines to nothing is skipped and counted, never fed.
+- **training seam** — the estimator's ``partial_fit`` rides
+  ``ChunkedFitLoop.run_one``, so rollback-to-last-good, the chunk
+  watchdog, preemption polling, and bidirectional capacity elasticity
+  (mesh shrink/grow mid-stream) are all inherited, not reimplemented.  A
+  mid-stream :class:`~dislib_tpu.runtime.preemption.Preempted` flushes
+  the snapshot, is counted, and propagates typed — the restarted trainer
+  resumes the stream from the snapshot.
+- **export seam** — one deployment bundle per generation, written
+  through :class:`~dislib_tpu.runtime.retry.Retry` (exponential backoff,
+  transient-vs-fatal classification).  The artifact is read BACK through
+  the CRC-verified loader before anything serves it: a torn or
+  bit-corrupt bundle surfaces as
+  :class:`~dislib_tpu.utils.checkpoint.SnapshotCorrupt`, classifies
+  transient *at this seam* (the fix is rewriting the artifact), and the
+  export retries — a damaged bundle is never handed to the router.
+- **promotion seam** — each verified bundle serves first as a
+  :meth:`~dislib_tpu.serving.router.ModelRouter.set_canary` arm, and is
+  promoted only through the health gate.  An unhealthy canary is
+  aborted — traffic automatically rolls back to the last-good
+  generation — and after ``promote_budget`` consecutive rejections the
+  trainer raises the typed :class:`PromotionFailed` (the operator
+  signal) with the last-good generation still serving.
+
+A **promotion ledger** (in memory and appended to
+``<bundle_dir>/ledger.jsonl``) records every generation's (generation,
+checksum, verdict, counters, wall times).  The served generation is
+**monotone except by explicit** :meth:`ContinuousTrainer.rollback` —
+enforced at promote time, recorded per ledger entry, and soak-asserted
+with faults at every seam (``tests/test_chaos_soak.py`` /
+``tools/chaos_soak.sh --trainer``).
+
+DrJAX's per-shard-update + cross-shard-reduce decomposition
+(arXiv:2403.07128) is the reference shape for the streaming updates the
+loop consumes; the promotion path obeys the 2112.09017 scale discipline —
+zero hot-path retraces, ever (the canary serves deserialized AOT
+executables; the soak counter-asserts no trace after warmup).
+
+Env knobs (the ``DSLIB_TRAINER_*`` surface; constructor args override):
+
+- ``DSLIB_TRAINER_BATCHES`` — batches consumed per generation (8);
+- ``DSLIB_TRAINER_CANARY_FRACTION`` — canary traffic split (0.5);
+- ``DSLIB_TRAINER_PROMOTE_BUDGET`` — consecutive canary rejections
+  before the typed :class:`PromotionFailed` (3);
+- ``DSLIB_TRAINER_EXPORT_ATTEMPTS`` — bundle-export retry budget (4);
+  backoff/jitter ride the standard ``DSLIB_RETRY_*`` knobs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+
+import numpy as np
+
+from dislib_tpu.runtime.preemption import Preempted
+from dislib_tpu.runtime.retry import Retry
+from dislib_tpu.utils.checkpoint import SnapshotCorrupt
+from dislib_tpu.utils.profiling import count_resilience
+
+__all__ = ["ContinuousTrainer", "PromotionFailed"]
+
+
+def _env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
+def _env_float(name, default):
+    return float(os.environ.get(name, default))
+
+
+class PromotionFailed(RuntimeError):
+    """The canary health gate refused ``attempts`` consecutive
+    generations — the promote budget is exhausted and an operator must
+    look.  The LAST-GOOD generation is still serving (the trainer never
+    leaves a tenant dark); carries ``tenant``, ``generation`` (the last
+    refused one), ``attempts``, and ``last_good`` (the generation still
+    serving, or None when nothing ever promoted)."""
+
+    def __init__(self, message, tenant=None, generation=None, attempts=0,
+                 last_good=None):
+        super().__init__(message)
+        self.tenant = tenant
+        self.generation = generation
+        self.attempts = int(attempts)
+        self.last_good = last_good
+
+
+def _file_crc(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(1 << 20)
+            if not buf:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(buf, crc)
+
+
+class ContinuousTrainer:
+    """The train → bundle → canary → promote daemon (module docstring).
+
+    Parameters
+    ----------
+    estimator : streaming estimator — anything with the
+        ``partial_fit(batch, checkpoint=, health=)`` contract riding
+        ``ChunkedFitLoop.run_one`` (``MiniBatchKMeans`` is the in-tree
+        reference; its ``fit_info_`` feeds :meth:`stats`).
+    stream : iterable of host batches (ndarray rows).  May be infinite —
+        the trainer consumes ``batches_per_generation`` per cadence.
+        Each batch is quarantine-screened before the estimator sees it.
+    checkpoint : FitCheckpoint — the stream's rotating snapshot sink
+        (rollback target, preemption resume point, and the
+        adoption-gated state embedded in every exported bundle).
+    pipeline_of : callable(estimator, generation) -> ServePipeline —
+        builds the servable chain from the live model for one
+        generation's export.
+    bundle_dir : str — one ``gen_NNNNNN.dsb.npz`` artifact per
+        generation plus the ``ledger.jsonl`` promotion ledger.
+    router, tenant : the serving side.  None disables canary/promote —
+        the trainer still trains and exports verified bundles
+        (``verdict="exported"``).  The FIRST generation registers the
+        tenant (initial deploy, gated before any traffic); later ones
+        canary against the serving primary.
+    buckets : bucket ladder for the exported executables (default per
+        ``serving.buckets.bucket_ladder``).
+    batches_per_generation / canary_fraction / promote_budget : the
+        ``DSLIB_TRAINER_*`` knobs (module docstring).
+    retry : Retry — the export-seam policy; default
+        ``Retry.from_env(attempts=DSLIB_TRAINER_EXPORT_ATTEMPTS,
+        backoff=0.1)`` with ``SnapshotCorrupt`` classified transient at
+        this seam (a torn artifact is fixed by rewriting it).
+    health : HealthPolicy | None — passed through to the estimator's
+        stream (fault injectors are policies; see ``utils.faults``).
+    health_gate : callable(LoadedBundle, generation) -> bool — the
+        promotion gate.  None gates on the default probe predict (all
+        outputs finite).  A gate that RAISES counts as unhealthy (the
+        error is recorded in the ledger entry), except control-flow
+        exceptions which propagate.
+    probe : ndarray (rows, n_features) | None — rows for the default
+        gate's warmup predict; None with no ``health_gate`` accepts
+        every verified bundle.
+    quota_rows / deadline_ms : forwarded to the tenant registration and
+        the per-generation ``PredictServer``.
+    quarantine : tri-state passed to the batch screen (None reads
+        ``DSLIB_QUARANTINE``).
+    """
+
+    def __init__(self, estimator, stream, checkpoint, pipeline_of,
+                 bundle_dir, router=None, tenant=None, buckets=None,
+                 batches_per_generation=None, canary_fraction=None,
+                 promote_budget=None, retry=None, health=None,
+                 health_gate=None, probe=None, quota_rows=None,
+                 deadline_ms=None, quarantine=None, name="trainer"):
+        self.estimator = estimator
+        self._stream = iter(stream)
+        self.checkpoint = checkpoint
+        self.pipeline_of = pipeline_of
+        self.bundle_dir = str(bundle_dir)
+        os.makedirs(self.bundle_dir, exist_ok=True)
+        self.router = router
+        self.tenant = tenant
+        self.buckets = buckets
+        self.batches_per_generation = \
+            _env_int("DSLIB_TRAINER_BATCHES", 8) \
+            if batches_per_generation is None else int(batches_per_generation)
+        self.canary_fraction = \
+            _env_float("DSLIB_TRAINER_CANARY_FRACTION", 0.5) \
+            if canary_fraction is None else float(canary_fraction)
+        self.promote_budget = _env_int("DSLIB_TRAINER_PROMOTE_BUDGET", 3) \
+            if promote_budget is None else int(promote_budget)
+        self.retry = retry if retry is not None else Retry.from_env(
+            attempts=_env_int("DSLIB_TRAINER_EXPORT_ATTEMPTS", 4),
+            backoff=0.1, classify=self._classify_export)
+        self.health = health
+        self.health_gate = health_gate
+        self.probe = None if probe is None else np.asarray(probe, np.float32)
+        self.quota_rows = quota_rows
+        self.deadline_ms = deadline_ms
+        self.quarantine = quarantine
+        self.name = name
+
+        self.generation = 0             # last trained generation
+        self.served_generation = None   # what the tenant's primary serves
+        self.ledger: list[dict] = []    # promotion ledger, oldest first
+        self._last_good = None          # (generation, bundle path)
+        self._primary_server = None     # the server this trainer installed
+        self._consecutive_rejections = 0
+        self._exhausted = False
+        self._counters = {
+            "promotions": 0,            # generations made primary
+            "canary_rejections": 0,     # health gate said no
+            "promote_failures": 0,      # budget exhaustions (typed raise)
+            "rollbacks": 0,             # automatic stay-on-last-good
+            "rollbacks_of_served": 0,   # explicit rollback() calls
+            "exports": 0,
+            "export_retries": 0,
+            "batches": 0,
+            "batches_skipped": 0,       # quarantined to nothing
+            "preemptions": 0,
+        }
+
+    # -- export-seam classification ---------------------------------------
+
+    @staticmethod
+    def _classify_export(exc):
+        """At the export seam a torn/bit-corrupt artifact
+        (``SnapshotCorrupt`` from the read-back) is TRANSIENT: the fix
+        is rewriting the artifact, which is exactly what a retry does.
+        Everything else falls through to the default classification."""
+        if isinstance(exc, SnapshotCorrupt):
+            return True
+        return None
+
+    # -- stream side -------------------------------------------------------
+
+    def train_generation(self) -> bool:
+        """Consume one generation's cadence of batches from the stream —
+        each screened through the ingest quarantine, then fed to the
+        estimator's ``partial_fit`` (checkpoint/health stream-wide).
+        Returns False when the stream is exhausted before yielding a
+        single batch (the daemon's clean shutdown signal); a partial
+        cadence at stream end still forms a final generation.  A
+        mid-stream ``Preempted`` is counted and propagates typed — the
+        snapshot is already flushed, so a restarted trainer resumes."""
+        from dislib_tpu.data import io as _dio
+        g = self.generation + 1
+        pulled = 0
+        while pulled < self.batches_per_generation:
+            try:
+                batch = next(self._stream)
+            except StopIteration:
+                self._exhausted = True
+                break
+            pulled += 1
+            src = f"{self.name}/gen{g}/batch{self._counters['batches'] + 1}"
+            try:
+                clean, _ = _dio.quarantine_batch(batch, source=src,
+                                                 quarantine=self.quarantine)
+            except ValueError:
+                # every row quarantined: nothing to learn from — skip,
+                # count, keep the loop alive (the ledger holds the audit)
+                self._counters["batches_skipped"] += 1
+                continue
+            try:
+                self.estimator.partial_fit(clean, checkpoint=self.checkpoint,
+                                           health=self.health)
+            except Preempted:
+                self._counters["preemptions"] += 1
+                count_resilience("trainer_preemptions")
+                raise
+            self._counters["batches"] += 1
+        if pulled:
+            self.generation = g
+        return pulled > 0
+
+    # -- export seam -------------------------------------------------------
+
+    def _bundle_path(self, g: int) -> str:
+        return os.path.join(self.bundle_dir, f"gen_{g:06d}.dsb.npz")
+
+    def export_generation(self):
+        """Export generation ``self.generation`` as a deployment bundle
+        through the retry policy, CRC-verified end-to-end: the
+        checkpoint flushes (the embedded state reads through the
+        adoption gate), the artifact writes atomically, and the bundle
+        is read BACK through the verified loader before anyone serves
+        it.  A torn/corrupt artifact retries with backoff; budget
+        exhaustion re-raises the last typed error.  Returns
+        ``(path, LoadedBundle)``."""
+        from dislib_tpu.serving.bundle import export_bundle, load_bundle
+        g = self.generation
+        if self.checkpoint is not None:
+            self.checkpoint.flush()
+        pipe = self.pipeline_of(self.estimator, g)
+        path = self._bundle_path(g)
+        attempts = [0]
+
+        def _attempt():
+            attempts[0] += 1
+            export_bundle(pipe, path, buckets=self.buckets,
+                          checkpoint=self.checkpoint)
+            # the read-back IS the verification: CRC over every entry,
+            # zero-retrace executables rehydrated — what serving will use
+            return load_bundle(path)
+
+        loaded = self.retry.call(_attempt)
+        self._counters["exports"] += 1
+        if attempts[0] > 1:
+            self._counters["export_retries"] += attempts[0] - 1
+            count_resilience("trainer_export_retries", attempts[0] - 1)
+        return path, loaded
+
+    # -- promotion seam ----------------------------------------------------
+
+    def _gate(self, loaded, g, record):
+        """Health-gate one loaded bundle.  The user gate wins; the
+        default probe gate requires every probe prediction finite; no
+        gate and no probe accepts (the bundle already CRC-verified)."""
+        if self.health_gate is not None:
+            try:
+                return bool(self.health_gate(loaded, g))
+            except (Preempted, KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — a raising gate is a veto
+                record["gate_error"] = f"{type(e).__name__}: {e}"
+                return False
+        if self.probe is None:
+            return True
+        rows = self.probe
+        fit = [b for b in loaded.buckets if b >= rows.shape[0]]
+        bucket = min(fit) if fit else max(loaded.buckets)
+        rows = rows[: bucket]
+        vals = loaded.pipeline.predict_bucket(rows, bucket)
+        return bool(np.all(np.isfinite(vals)))
+
+    def _make_server(self, loaded, g):
+        from dislib_tpu.serving.server import PredictServer
+        srv = PredictServer(pipeline=loaded.pipeline, buckets=loaded.buckets,
+                            deadline_ms=self.deadline_ms,
+                            name=f"{self.name}-g{g}")
+        srv.start()
+        return srv
+
+    def _commit_record(self, record):
+        record["served"] = self.served_generation
+        record["counters"] = dict(self._counters)
+        self.ledger.append(record)
+        try:
+            with open(os.path.join(self.bundle_dir, "ledger.jsonl"),
+                      "a") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError:
+            pass                        # the in-memory ledger is canonical
+
+    def publish_generation(self) -> dict:
+        """Export → canary → health gate → promote (or automatic
+        rollback to last-good) for the current generation; returns the
+        ledger record.  The served generation moves FORWARD only here
+        (``g > served`` enforced) and BACKWARD only in
+        :meth:`rollback`."""
+        t0 = time.perf_counter()
+        path, loaded = self.export_generation()
+        export_s = time.perf_counter() - t0
+        g = self.generation
+        record = {"generation": g, "path": path,
+                  "checksum": _file_crc(path), "verdict": None,
+                  "export_s": round(export_s, 4)}
+        if self.router is None or self.tenant is None:
+            record["verdict"] = "exported"
+            self._last_good = (g, path)
+            self._commit_record(record)
+            return record
+        if self.served_generation is not None \
+                and g <= self.served_generation:
+            raise RuntimeError(
+                f"{self.name}: refusing to publish generation {g} over "
+                f"served generation {self.served_generation} — the served "
+                "generation moves backward only via rollback()")
+        t0 = time.perf_counter()
+        srv = self._make_server(loaded, g)
+        fresh = self.tenant not in self.router.tenants()
+        if not fresh:
+            self.router.set_canary(self.tenant, srv,
+                                   fraction=self.canary_fraction)
+        if self._gate(loaded, g, record):
+            if fresh:
+                # initial deploy: gated BEFORE any traffic ever routed
+                self.router.add_tenant(self.tenant, srv,
+                                       quota_rows=self.quota_rows)
+            else:
+                self.router.promote(self.tenant)
+            old, self._primary_server = self._primary_server, srv
+            self.served_generation = g
+            self._last_good = (g, path)
+            self._counters["promotions"] += 1
+            self._consecutive_rejections = 0
+            record["verdict"] = "promoted"
+            record["promote_s"] = round(time.perf_counter() - t0, 4)
+            count_resilience("trainer_promotions")
+            self._commit_record(record)
+            if old is not None:
+                old.stop()              # drained; new primary has traffic
+            return record
+        # unhealthy canary: route 100% back to last-good (automatic
+        # rollback), retire the canary server, spend promote budget
+        if not fresh:
+            self.router.abort_canary(self.tenant, failed=True)
+        srv.stop()
+        self._counters["canary_rejections"] += 1
+        self._counters["rollbacks"] += 1
+        self._consecutive_rejections += 1
+        record["verdict"] = "rejected"
+        record["promote_s"] = round(time.perf_counter() - t0, 4)
+        count_resilience("trainer_canary_rejections")
+        if self._consecutive_rejections >= self.promote_budget:
+            self._counters["promote_failures"] += 1
+            record["verdict"] = "rejected+budget"
+            self._commit_record(record)
+            last = self._last_good[0] if self._last_good else None
+            raise PromotionFailed(
+                f"{self.name}: canary health gate refused "
+                f"{self._consecutive_rejections} consecutive generations "
+                f"(budget {self.promote_budget}); generation "
+                f"{last!r} is still serving — operator attention required",
+                tenant=self.tenant, generation=g,
+                attempts=self._consecutive_rejections, last_good=last)
+        self._commit_record(record)
+        return record
+
+    def rollback(self, to_generation=None) -> dict:
+        """EXPLICITLY move the served generation backward: reload an
+        earlier *promoted* generation's bundle (default: the newest one
+        below the served generation) through the verified loader, and
+        re-point the tenant's primary at it via
+        :meth:`ModelRouter.rollback`.  The one sanctioned backwards
+        move — recorded in the ledger (``verdict="rollback"``) and
+        counted (``rollbacks_of_served``)."""
+        if self.router is None or self.tenant is None:
+            raise RuntimeError(f"{self.name}: no router/tenant to roll back")
+        if self.served_generation is None:
+            raise RuntimeError(f"{self.name}: nothing promoted yet")
+        promoted = [r for r in self.ledger if r["verdict"] == "promoted"
+                    and r["generation"] < self.served_generation]
+        if to_generation is not None:
+            promoted = [r for r in promoted
+                        if r["generation"] == int(to_generation)]
+        if not promoted:
+            raise RuntimeError(
+                f"{self.name}: no promoted generation below "
+                f"{self.served_generation}"
+                + (f" matching {to_generation}" if to_generation is not None
+                   else "") + " to roll back to")
+        target = promoted[-1]
+        from dislib_tpu.serving.bundle import load_bundle
+        loaded = load_bundle(target["path"])    # CRC-verified, typed
+        g = target["generation"]
+        srv = self._make_server(loaded, g)
+        self.router.rollback(self.tenant, srv)
+        old, self._primary_server = self._primary_server, srv
+        self.served_generation = g
+        self._last_good = (g, target["path"])
+        self._consecutive_rejections = 0
+        self._counters["rollbacks_of_served"] += 1
+        count_resilience("trainer_rollbacks_of_served")
+        record = {"generation": g, "path": target["path"],
+                  "checksum": target["checksum"], "verdict": "rollback"}
+        self._commit_record(record)
+        if old is not None:
+            old.stop()
+        return record
+
+    # -- daemon loop -------------------------------------------------------
+
+    def step(self) -> dict | None:
+        """One full cadence: train a generation, publish it.  None when
+        the stream is exhausted."""
+        if not self.train_generation():
+            return None
+        return self.publish_generation()
+
+    def run(self, generations=None) -> dict:
+        """Drive :meth:`step` until the stream exhausts or ``generations``
+        cadences complete (None = forever).  ``Preempted`` and
+        :class:`PromotionFailed` propagate typed — the orchestrator
+        decides restart vs page; a re-instantiated trainer resumes the
+        stream from the checkpoint.  Returns :meth:`stats`."""
+        done = 0
+        while generations is None or done < generations:
+            if self.step() is None:
+                break
+            done += 1
+        return self.stats()
+
+    def close(self) -> None:
+        """Stop the primary server this trainer installed (canary
+        servers are retired as they lose; the router only stops servers
+        it started itself)."""
+        srv, self._primary_server = self._primary_server, None
+        if srv is not None:
+            srv.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        """The resilience + promotion counters, end-to-end: the
+        trainer's own seam counters, the stream driver's ``fit_info_``
+        (rollbacks / mesh resizes inherited from ``ChunkedFitLoop``),
+        and the process quarantine ledger's exact stream totals."""
+        from dislib_tpu.data.io import quarantine_ledger
+        led = quarantine_ledger()
+        info = getattr(self.estimator, "fit_info_", None) or {}
+        out = dict(self._counters)
+        out.update({
+            "generation": self.generation,
+            "served_generation": self.served_generation,
+            "last_good": self._last_good[0] if self._last_good else None,
+            "stream_exhausted": self._exhausted,
+            "ledger_entries": len(self.ledger),
+            "quarantine": {"n_quarantined": led.n_quarantined,
+                           "n_loaded": led.n_loaded,
+                           "reports_retained": len(led.reports)},
+            "stream": {"chunks": info.get("chunks", 0),
+                       "rollbacks": info.get("rollbacks", 0),
+                       "mesh_shrinks": info.get("mesh_shrinks", 0),
+                       "mesh_grows": info.get("mesh_grows", 0)},
+        })
+        return out

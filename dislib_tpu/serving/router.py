@@ -60,7 +60,8 @@ class TenantQuotaExceeded(RuntimeError):
 
 class _Tenant:
     __slots__ = ("name", "server", "quota_rows", "inflight_rows",
-                 "canary", "canary_fraction", "quota_shed", "promotions")
+                 "canary", "canary_fraction", "quota_shed", "promotions",
+                 "promote_failures", "rollbacks")
 
     def __init__(self, name, server, quota_rows):
         self.name = name
@@ -71,6 +72,8 @@ class _Tenant:
         self.canary_fraction = 0.0
         self.quota_shed = 0
         self.promotions = 0
+        self.promote_failures = 0
+        self.rollbacks = 0
 
 
 def _request_hash(rows: np.ndarray, key) -> int:
@@ -155,13 +158,17 @@ class ModelRouter:
             t.canary = server
             t.canary_fraction = float(fraction)
 
-    def abort_canary(self, tenant: str) -> None:
+    def abort_canary(self, tenant: str, *, failed: bool = False) -> None:
         """Route 100% of ``tenant`` back to its primary (the canary
-        server keeps running — its owner decides its fate)."""
+        server keeps running — its owner decides its fate).
+        ``failed=True`` records the abort as a promotion failure (the
+        health gate refused the candidate) in the tenant's counters."""
         with self._lock:
             t = self._get(tenant)
             t.canary = None
             t.canary_fraction = 0.0
+            if failed:
+                t.promote_failures += 1
 
     def promote(self, tenant: str) -> None:
         """Make ``tenant``'s canary its primary — but only while the
@@ -175,10 +182,12 @@ class ModelRouter:
         with self._lock:
             t = self._get(tenant)
             if t.canary is None:
+                t.promote_failures += 1
                 raise RuntimeError(f"tenant {tenant!r} has no canary to "
                                    "promote")
             pool = t.canary._pool
             if pool is not None and pool.current()[1] is None:
+                t.promote_failures += 1
                 raise RuntimeError(
                     f"tenant {tenant!r}: canary has not adopted a live "
                     "generation through the adoption gate (last "
@@ -188,6 +197,32 @@ class ModelRouter:
             t.canary = None
             t.canary_fraction = 0.0
             t.promotions += 1
+
+    def rollback(self, tenant: str, server: PredictServer) -> None:
+        """EXPLICITLY re-point ``tenant``'s primary at ``server`` — the
+        one sanctioned way the served generation moves backward (an
+        earlier generation's bundle reloaded by its owner, e.g.
+        :meth:`~dislib_tpu.runtime.trainer.ContinuousTrainer.rollback`).
+        Any pending canary is cleared (a rollback supersedes an A/B in
+        flight); the demoted primary keeps running — its owner decides
+        its fate.  Counted per tenant (``rollbacks`` in :meth:`stats`)."""
+        if not isinstance(server, PredictServer):
+            raise TypeError(f"tenant {tenant!r}: rollback target must be "
+                            f"a PredictServer, got {type(server).__name__}")
+        with self._lock:
+            self._get(tenant)           # typed before any side effect
+            active = bool(self._started)
+        # same lifecycle rule as set_canary: never publish a
+        # not-yet-running server as a route target
+        if active and not server._running:
+            server.start()
+            self._started.append(server)
+        with self._lock:
+            t = self._get(tenant)
+            t.server = server
+            t.canary = None
+            t.canary_fraction = 0.0
+            t.rollbacks += 1
 
     def route(self, tenant: str, rows, key=None):
         """(server, label) this request would take — the canary split
@@ -292,17 +327,20 @@ class ModelRouter:
         with self._lock:
             tenants = {name: (t.server, t.canary, t.canary_fraction,
                               t.inflight_rows, t.quota_rows, t.quota_shed,
-                              t.promotions)
+                              t.promotions, t.promote_failures, t.rollbacks)
                        for name, t in self._tenants.items()}
         out = {}
         for name, (server, canary, frac, inflight, quota, shed,
-                   promotions) in tenants.items():
+                   promotions, promote_failures, rollbacks) in \
+                tenants.items():
             sstats = server.stats()
             entry = {"server": server.name,
                      "inflight_rows": inflight,
                      "quota_rows": quota,
                      "quota_shed": shed,
                      "promotions": promotions,
+                     "promote_failures": promote_failures,
+                     "rollbacks": rollbacks,
                      "serving": sstats["tenants"].get(
                          name, {"requests": 0, "shed": 0})}
             if canary is not None:

@@ -40,7 +40,8 @@ from dislib_tpu.utils.checkpoint import FitCheckpoint
 __all__ = ["CallbackCheckpoint", "SigtermAtNthSave", "sigterm_self",
            "corrupt_snapshot", "FlakyCall", "FlakyOpen",
            "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk",
-           "FaultAtTier", "CapacityAtSave", "oscillation_schedule"]
+           "FaultAtTier", "CapacityAtSave", "oscillation_schedule",
+           "TornBundleWrite", "CanaryGateTrip"]
 
 
 class CallbackCheckpoint(FitCheckpoint):
@@ -374,6 +375,56 @@ def oscillation_schedule(home_devices, seed, period=2, swings=2):
         at += 2 * int(period)
     sched[at] = None
     return sched
+
+
+class TornBundleWrite:
+    """Bundle-export seam injector (round-17 trainer): a drop-in for
+    ``dislib_tpu.serving.bundle.write_bundle`` whose first ``failures``
+    calls complete the REAL atomic write and then damage the published
+    artifact in place (:func:`corrupt_snapshot` ``mode``) — the
+    post-rename torn/bit-rotted bundle a crash-mid-export or a flaky
+    filesystem leaves behind.  This is deliberately *worse* than a tear
+    the atomic rename can mask: the damage lands on the final path, so
+    only the CRC-verified read-back (``SnapshotCorrupt``) can catch it.
+    Later calls delegate untouched; ``calls`` counts every invocation.
+    Install with ``monkeypatch.setattr("dislib_tpu.serving.bundle."
+    "write_bundle", TornBundleWrite(failures=1))``."""
+
+    def __init__(self, failures: int = 1, mode: str = "truncate"):
+        from dislib_tpu.runtime.bundle_io import write_bundle
+        self._real = write_bundle       # captured BEFORE any patching
+        self.failures = int(failures)
+        self.mode = mode
+        self.calls = 0
+
+    def __call__(self, path, arrays):
+        self.calls += 1
+        out = self._real(path, arrays)
+        if self.calls <= self.failures:
+            corrupt_snapshot(path, mode=self.mode)
+        return out
+
+
+class CanaryGateTrip:
+    """Promotion-seam injector: a ``health_gate(loaded, generation)``
+    callable that refuses the first ``times`` checks (the unhealthy
+    canary) and delegates to ``then`` — or accepts — afterwards.
+    ``checks`` counts every gate evaluation; schedule-driven like every
+    injector here, so the trainer's reject → stay-on-last-good →
+    budget-exhaustion path reproduces bit-identically."""
+
+    def __init__(self, times: int = 1, then=None):
+        self.times = int(times)
+        self.then = then
+        self.checks = 0
+
+    def __call__(self, loaded, generation) -> bool:
+        self.checks += 1
+        if self.checks <= self.times:
+            return False
+        if self.then is not None:
+            return bool(self.then(loaded, generation))
+        return True
 
 
 class FaultAtTier(HealthPolicy):

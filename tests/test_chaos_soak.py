@@ -140,6 +140,270 @@ def test_chaos_soak(tmp_path, monkeypatch):
 
 
 @pytest.mark.slow
+@pytest.mark.filterwarnings("ignore::RuntimeWarning")
+def test_chaos_trainer_soak(tmp_path, monkeypatch):
+    """Round-17 continuous-learning soak (``tools/chaos_soak.sh
+    --trainer``): ONE ContinuousTrainer driven through six generations
+    with a deterministic fault at every seam —
+
+    - gen 2: crash-mid-export TORN bundle (truncated artifact; the CRC
+      read-back catches it and the export retries),
+    - gen 3: canary health-gate trip (rejected, traffic stays on
+      last-good),
+    - gen 5: preemption mid-stream (typed ``Preempted``, snapshot
+      flushed, stream resumes),
+    - gen 6: corrupt-on-disk bundle (bit flip) PLUS a capacity
+      shrink → grow oscillation during training,
+    - finale: an EXPLICIT rollback of the served generation
+
+    — while client threads hammer the router continuously.  Invariants:
+    every response decodes to a (tenant, generation) that was actually
+    serving (no torn responses, no unsanctioned generation), the served
+    generation never moves backwards except via the explicit rollback,
+    promoted-generation quality (holdout MSE) is monotone non-increasing,
+    quarantine totals accumulate across generations, and the predict
+    path performs ZERO traces in quiescent windows after warmup."""
+    import threading
+    import time
+
+    from test_trainer import (BASE, BUCKETS, NF, STEP, TENANT, StreamLR,
+                              _pipeline_of, _stream)
+    from dislib_tpu.runtime import ContinuousTrainer, Retry
+    from dislib_tpu.serving import ModelRouter
+    from dislib_tpu.utils import profiling as prof
+
+    monkeypatch.setenv("DSLIB_RETRY_BACKOFF", "0")
+    seed = int(os.environ.get("DSLIB_SOAK_SEED", "0"))
+    ds.init()
+    home = int(np.prod(list(ds.get_mesh().shape.values())))
+    rng = np.random.RandomState(seed)
+    hold_x = rng.rand(256, NF).astype(np.float32)
+    hold_y = hold_x.sum(axis=1)
+
+    def dirty_stream():
+        """Noisy [x|y] batches; every 3rd batch carries one NaN row the
+        quarantine seam must strip (totals audited at the end)."""
+        for i, b in enumerate(_stream(seed=seed + 1, rows=32, sigma=0.05)):
+            if i % 3 == 0:
+                b[0, 0] = np.nan
+            yield b
+
+    ck = FitCheckpoint(str(tmp_path / "ck.npz"), every=1, keep=2)
+    router = ModelRouter(name="soak-router")
+    # capacity walk keyed on STREAM-WIDE save counts (2 saves/generation;
+    # gen 5 spends an extra save on the preempted batch): save 11 is gen
+    # 5's last — its dip makes gen 6's first batch shrink; the grow-back
+    # lands on gen 6's second batch; then the override clears
+    cap = faults.CapacityAtSave({11: max(1, home // 2), 12: home, 13: None})
+    trainer = ContinuousTrainer(
+        StreamLR(NF), dirty_stream(), ck, _pipeline_of(0),
+        str(tmp_path / "bundles"), router=router, tenant=TENANT,
+        buckets=BUCKETS, batches_per_generation=2, canary_fraction=0.5,
+        promote_budget=3, health=cap,
+        retry=Retry(attempts=4, backoff=0.0,
+                    classify=ContinuousTrainer._classify_export),
+        probe=rng.rand(4, NF).astype(np.float32), name="soak-trainer")
+
+    lock = threading.Lock()
+    valid, promoted = set(), set()
+    epoch = [0]
+    stop_evt = threading.Event()
+    errors: list[str] = []
+    n_requests = [0]
+
+    def publish():
+        g = trainer.generation
+        with lock:
+            valid.add(g)
+        rec = trainer.publish_generation()
+        with lock:
+            if rec["verdict"].startswith("promoted"):
+                promoted.add(g)
+            else:
+                valid.discard(g)        # canary aborted AND drained
+        return rec
+
+    def mse():
+        w = np.asarray(trainer.estimator.coef_, np.float64).ravel()
+        yhat = hold_x @ w + float(trainer.estimator.intercept_)
+        return float(np.mean((yhat - hold_y) ** 2))
+
+    def client(cid):
+        crng = np.random.RandomState(100 + cid)
+        last_g, last_epoch = -1, 0
+        i = 0
+        while not stop_evt.is_set():
+            i += 1
+            k = int(crng.randint(1, BUCKETS[0] + 1))
+            rows = crng.rand(k, NF).astype(np.float32)
+            with lock:
+                allowed = set(valid)
+            try:
+                r = router.submit(rows, TENANT,
+                                  key=f"c{cid}:{i}").result(timeout=60)
+            except Exception as e:  # noqa: BLE001 — any failure fails soak
+                errors.append(f"client {cid}: {type(e).__name__}: {e}")
+                return
+            vals = np.asarray(r.values).ravel() - rows.sum(axis=1) - BASE
+            dec = np.unique(np.round(vals / STEP).astype(int))
+            if len(dec) != 1:
+                errors.append(f"client {cid}: TORN response {vals}")
+                return
+            g = int(dec[0])
+            with lock:
+                ok = g in allowed or g in valid
+                is_promoted = g in promoted
+                ep = epoch[0]
+            n_requests[0] += 1
+            if not ok:
+                errors.append(f"client {cid}: unsanctioned generation {g}")
+                return
+            # served generation monotone per client — checked strictly
+            # before the explicit rollback; afterwards (epoch 1) the
+            # old-primary drain legitimately interleaves, so the steady
+            # state is asserted by the main thread's decode burst
+            if is_promoted and ep == 0:
+                if last_epoch == 0 and g < last_g:
+                    errors.append(f"client {cid}: served generation went "
+                                  f"backwards ({g} after {last_g}) without "
+                                  "an explicit rollback")
+                    return
+                last_g, last_epoch = g, ep
+
+    def burst(expect, n=8):
+        got = set()
+        brng = np.random.RandomState(7)
+        for i in range(n):
+            k = int(brng.randint(1, BUCKETS[0] + 1))
+            rows = brng.rand(k, NF).astype(np.float32)
+            r = router.submit(rows, TENANT, key=f"b{i}").result(timeout=60)
+            vals = np.asarray(r.values).ravel() - rows.sum(axis=1) - BASE
+            got.update(np.round(vals / STEP).astype(int).tolist())
+        assert got == expect, f"steady-state decode {got}, want {expect}"
+
+    quality: dict[int, float] = {}
+    seams: dict[str, object] = {}
+    traces_quiescent = []
+    threads: list[threading.Thread] = []
+    from dislib_tpu.runtime.preemption import clear_capacity
+
+    with router:
+        try:
+            # -- gen 1: clean initial deploy ------------------------------
+            assert trainer.train_generation()
+            quality[1] = mse()
+            assert publish()["verdict"] == "promoted"
+            threads.extend(threading.Thread(target=client, args=(c,))
+                           for c in range(2))
+            for t in threads:
+                t.start()
+
+            # -- gen 2: crash-mid-export torn bundle ----------------------
+            assert trainer.train_generation()
+            quality[2] = mse()
+            torn = faults.TornBundleWrite(failures=1, mode="truncate")
+            with monkeypatch.context() as m:
+                m.setattr("dislib_tpu.serving.bundle.write_bundle", torn)
+                assert publish()["verdict"] == "promoted"
+            assert torn.calls == 2      # torn once, rewritten clean
+            seams["torn_export"] = "retried+promoted"
+
+            # -- gen 3: canary health-gate trip ---------------------------
+            assert trainer.train_generation()
+            quality[3] = mse()
+            trip = faults.CanaryGateTrip(times=1)
+            trainer.health_gate = trip
+            rec = publish()
+            trainer.health_gate = None
+            assert rec["verdict"] == "rejected" and trip.checks == 1
+            assert trainer.served_generation == 2    # stayed on last-good
+            seams["canary_trip"] = "rejected+stayed_on_last_good"
+
+            # -- gen 4: clean promote (budget reset proven) ---------------
+            assert trainer.train_generation()
+            quality[4] = mse()
+            assert publish()["verdict"] == "promoted"
+            t0 = prof.trace_count()
+            time.sleep(0.4)             # clients hammer; training idle
+            traces_quiescent.append(prof.trace_count() - t0)
+
+            # -- gen 5: preemption mid-stream -----------------------------
+            request_preemption()
+            with pytest.raises(Preempted):
+                trainer.train_generation()
+            clear_preemption()
+            assert trainer.stats()["preemptions"] == 1
+            assert trainer.train_generation()        # stream resumes
+            quality[5] = mse()
+            assert publish()["verdict"] == "promoted"
+            seams["preemption"] = "typed+resumed"
+
+            # -- gen 6: corrupt-on-disk bundle + capacity oscillation -----
+            assert trainer.train_generation()
+            quality[6] = mse()
+            info = trainer.stats()["stream"]
+            assert info["mesh_shrinks"] == 1, info
+            assert info["mesh_grows"] == 1, info
+            seams["capacity"] = {"shrinks": info["mesh_shrinks"],
+                                 "grows": info["mesh_grows"]}
+            flip = faults.TornBundleWrite(failures=1, mode="flip")
+            with monkeypatch.context() as m:
+                m.setattr("dislib_tpu.serving.bundle.write_bundle", flip)
+                assert publish()["verdict"] == "promoted"
+            assert flip.calls == 2
+            seams["corrupt_bundle"] = "retried+promoted"
+            t0 = prof.trace_count()
+            time.sleep(0.4)
+            traces_quiescent.append(prof.trace_count() - t0)
+
+            # -- finale: the EXPLICIT rollback ----------------------------
+            with lock:
+                epoch[0] += 1
+            assert trainer.rollback()["generation"] == 5
+            time.sleep(0.3)             # old primary drains under load
+            t0 = prof.trace_count()
+            burst({5})                  # steady state: rollback target only
+            traces_quiescent.append(prof.trace_count() - t0)
+        finally:
+            stop_evt.set()
+            clear_capacity()
+            clear_preemption()
+            for t in threads:
+                t.join()
+            trainer.close()
+
+    assert not errors, "trainer soak failures:\n  " + "\n  ".join(errors)
+    stats = trainer.stats()
+    served_path = [r["served"] for r in trainer.ledger]
+    assert served_path == [1, 2, 2, 4, 5, 6, 5]
+    assert stats["promotions"] == 5 and stats["canary_rejections"] == 1
+    assert stats["export_retries"] == 2
+    assert stats["rollbacks_of_served"] == 1
+    assert traces_quiescent == [0, 0, 0], traces_quiescent
+    assert n_requests[0] > 50, n_requests
+    q = stats["quarantine"]
+    assert q["n_quarantined"] >= 4      # every 3rd batch carried poison
+    promoted_q = [quality[g] for g in sorted(promoted)]
+    for a, b in zip(promoted_q, promoted_q[1:]):
+        assert b <= a * 1.25 + 1e-6, (promoted_q, quality)
+    assert promoted_q[-1] < promoted_q[0], promoted_q
+
+    summary = {"metric": "chaos_trainer", "seed": seed,
+               "seams": seams, "served_path": served_path,
+               "promotions": stats["promotions"],
+               "canary_rejections": stats["canary_rejections"],
+               "export_retries": stats["export_retries"],
+               "rollbacks_of_served": stats["rollbacks_of_served"],
+               "preemptions": stats["preemptions"],
+               "quarantine": q, "client_requests": n_requests[0],
+               "traces_quiescent": traces_quiescent,
+               "quality_mse": {str(g): round(v, 8)
+                               for g, v in sorted(quality.items())},
+               "resilience": prof.resilience_counters()}
+    print("CHAOS_TRAINER_SUMMARY " + json.dumps(summary))
+
+
+@pytest.mark.slow
 def test_chaos_oscillation_soak(tmp_path, monkeypatch):
     """Round-16 oscillating-capacity tier (``tools/chaos_soak.sh
     --oscillate``): a seeded shrink → heal → grow capacity walk

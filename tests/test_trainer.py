@@ -1,0 +1,462 @@
+"""ContinuousTrainer (round-17 tentpole): the train → bundle → canary →
+promote loop, tier-1 slice.
+
+The test vehicle is ``StreamLR`` — a streaming least-squares estimator
+riding ``ChunkedFitLoop.run_one`` exactly like ``MiniBatchKMeans`` does
+(same protocol, tiny closed-form solve), chosen because its predictions
+decode to an exact oracle: the export pipeline's intercept encodes
+(tenant, generation) as ``1000·(tenant+1) + 10·gen``, so every routed
+response names which generation answered.  One module-scoped run drives
+three generations through a live ModelRouter plus an explicit rollback;
+the tests assert on its captured ledger/stats/decodes (compile-cache
+friendly — the expensive loop runs once).  The slow end-to-end soak with
+faults at every seam is ``tests/test_chaos_soak.py::
+test_chaos_trainer_soak``; this file keeps the fast, deterministic
+pins: ledger/checksum integrity, export retry/backoff + the
+atomic-no-partial-artifact invariant, canary budget exhaustion to the
+typed ``PromotionFailed``, and quarantine accounting across generations.
+"""
+
+import json
+import os
+import zlib
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import dislib_tpu as ds
+from dislib_tpu.runtime import (ContinuousTrainer, PromotionFailed, Retry,
+                                fitloop as _fitloop)
+from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.runtime import health as _health
+from dislib_tpu.serving import ModelRouter, ServePipeline
+from dislib_tpu.utils.checkpoint import FitCheckpoint, SnapshotCorrupt
+from dislib_tpu.utils.faults import (CanaryGateTrip, FlakyCall,
+                                     TornBundleWrite)
+from dislib_tpu.utils.profiling import profiled_jit as _pjit
+
+NF = 4
+BUCKETS = (8,)
+TENANT = "alpha"
+BASE = 1000.0           # intercept encodes tenant...
+STEP = 10.0             # ...and generation: 1000·(tenant+1) + 10·gen
+
+
+@partial(_pjit, name="stream_lr_step")
+def _slr_step(b, xtx, xty):
+    """One streaming normal-equations accumulation — the whole batch is
+    ONE fused dispatch, health vector included (the fitloop recipe)."""
+    x = b[:, :-1]
+    y = b[:, -1]
+    x1 = jnp.concatenate([x, jnp.ones((x.shape[0], 1), x.dtype)], axis=1)
+    new_xtx = xtx + x1.T @ x1
+    new_xty = xty + x1.T @ y
+    hvec = _health.health_vec(carries=(new_xtx, new_xty), inputs=(x1,))
+    return new_xtx, new_xty, hvec
+
+
+class StreamLR:
+    """Streaming least squares on combined ``[x | y]`` host batches,
+    riding ``ChunkedFitLoop.run_one`` — zero bespoke resilience code;
+    rollback/watchdog/preemption/capacity all come from the driver (the
+    ``MiniBatchKMeans`` recipe, linear-model edition so the trainer soak
+    gets an exact decode oracle and a closed-form quality measure)."""
+
+    def __init__(self, n_features):
+        self.n_features = int(n_features)
+        self._n1 = self.n_features + 1
+        self._loop = None
+
+    def partial_fit(self, batch, y=None, checkpoint=None, health=None):
+        b = np.asarray(batch, np.float32)
+        n1 = self._n1
+        if self._loop is None:
+            self._batch = {}
+            self._loop = _fitloop.ChunkedFitLoop(
+                "stream_lr", checkpoint=checkpoint, health=health,
+                carry_names=("xtx", "xty"),
+                carry_shapes=((n1, n1), (n1,)),
+                save_every=checkpoint.every if checkpoint is not None else 1,
+                # host-replicated carries: nothing to re-lay out on a
+                # resize, but the hook's presence arms the elastic tier
+                # and the capacity-driven resizes
+                elastic=lambda mesh: None)
+        loop = self._loop
+        self._batch["b"] = jnp.asarray(b)
+
+        def init(rem):
+            return _fitloop.LoopState(
+                (jnp.asarray(rem.perturb(np.zeros((n1, n1), np.float32))),
+                 jnp.asarray(rem.perturb(np.zeros((n1,), np.float32)))))
+
+        def restore(snap, rem):
+            xtx = np.asarray(snap["xtx"])
+            if xtx.shape != (n1, n1):
+                raise ValueError(f"checkpoint xtx shape {xtx.shape} does "
+                                 f"not match this stream {(n1, n1)}")
+            return _fitloop.LoopState(
+                (jnp.asarray(rem.perturb(xtx)),
+                 jnp.asarray(rem.perturb(np.asarray(snap["xty"])))),
+                it=int(snap["n_batches"]))
+
+        def step(st, chunk):
+            xtx, xty, hvec = _slr_step(self._batch["b"], *st.carries)
+            return _fitloop.ChunkOutcome(
+                lambda: _fitloop.LoopState((xtx, xty), st.it + 1),
+                hvec=hvec)
+
+        def snapshot(st):
+            return {"xtx": _fetch(st.carries[0], blocking=False),
+                    "xty": _fetch(st.carries[1], blocking=False),
+                    "n_batches": st.it}
+
+        st = loop.run_one(init=init, step=step, restore=restore,
+                          snapshot=snapshot)
+        xtx = np.asarray(jax.device_get(st.carries[0]), np.float64)
+        xty = np.asarray(jax.device_get(st.carries[1]), np.float64)
+        w = np.linalg.solve(xtx + 1e-6 * np.eye(n1), xty)
+        self.coef_ = w[:-1].astype(np.float32).reshape(-1, 1)
+        self.intercept_ = np.float32(w[-1])
+        self.n_batches_ = st.it
+        self.fit_info_ = loop.info
+        return self
+
+
+def _pipeline_of(tenant_idx=0):
+    """pipeline_of factory: the exported model's intercept encodes
+    (tenant, generation) — every response decodes to who answered."""
+    def factory(est, gen):
+        lr = ds.LinearRegression()
+        lr.coef_ = np.asarray(est.coef_, np.float32).reshape(NF, 1)
+        lr.intercept_ = np.asarray(
+            [float(est.intercept_) + BASE * (tenant_idx + 1) + STEP * gen],
+            np.float32)
+        return ServePipeline(lr, n_features=NF)
+    return factory
+
+
+def _stream(seed=0, rows=32, sigma=0.0):
+    """Infinite [x | y] batch stream with y = Σx (+ noise)."""
+    rng = np.random.RandomState(seed)
+    while True:
+        x = rng.rand(rows, NF).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True) \
+            + sigma * rng.randn(rows, 1).astype(np.float32)
+        yield np.concatenate([x, y], axis=1)
+
+
+def _decode(router, rng, n=6, tenant=TENANT, tenant_idx=0):
+    """Submit n mixed-size requests; return the set of generations that
+    answered (asserting every response is whole — no torn batches)."""
+    gens = set()
+    for i in range(n):
+        k = int(rng.randint(1, BUCKETS[0] + 1))
+        rows = rng.rand(k, NF).astype(np.float32)
+        r = router.submit(rows, tenant, key=f"d{i}").result(timeout=60)
+        vals = np.asarray(r.values).ravel() - rows.sum(axis=1) \
+            - BASE * (tenant_idx + 1)
+        g = np.unique(np.round(vals / STEP).astype(int))
+        assert len(g) == 1, f"torn response: {vals}"
+        gens.add(int(g[0]))
+    return gens
+
+
+@pytest.fixture(scope="module")
+def ctx(tmp_path_factory):
+    """One full trainer run: three generations promoted through a live
+    router, a decode burst per promotion, then an explicit rollback.
+    Everything the module's tests assert on is captured here — the
+    expensive loop runs once."""
+    from dislib_tpu.utils import profiling as prof
+    root = tmp_path_factory.mktemp("trainer")
+    ck = FitCheckpoint(str(root / "ck.npz"), every=1, keep=2)
+    est = StreamLR(NF)
+    router = ModelRouter(name="t-router")
+    rng = np.random.RandomState(7)
+    out = {"bundle_dir": str(root / "bundles"), "decoded": [],
+           "trace_deltas": []}
+    with router:
+        tr = ContinuousTrainer(
+            est, _stream(), ck, _pipeline_of(0), out["bundle_dir"],
+            router=router, tenant=TENANT, buckets=BUCKETS,
+            batches_per_generation=2, canary_fraction=0.5,
+            promote_budget=2, retry=Retry(attempts=3, backoff=0.0),
+            probe=rng.rand(4, NF).astype(np.float32))
+        records = [tr.step() for _ in range(3)]
+        # decode burst against the served generation — and pin the
+        # serving hot path's zero-retrace discipline while training idles
+        t0 = prof.trace_count()
+        out["decoded"].append(_decode(router, rng))
+        out["trace_deltas"].append(prof.trace_count() - t0)
+        rb = tr.rollback()
+        t0 = prof.trace_count()
+        out["decoded"].append(_decode(router, rng))
+        out["trace_deltas"].append(prof.trace_count() - t0)
+        out.update(records=records, rollback_record=rb,
+                   ledger=list(tr.ledger), stats=tr.stats(),
+                   router_stats=router.stats(), est=est)
+        tr.close()
+    return out
+
+
+class TestTrainerLoop:
+    def test_three_generations_promoted(self, ctx):
+        assert [r["verdict"] for r in ctx["records"]] == ["promoted"] * 3
+        assert [r["generation"] for r in ctx["records"]] == [1, 2, 3]
+        s = ctx["stats"]
+        assert s["promotions"] == 3 and s["exports"] == 3
+        assert s["canary_rejections"] == 0 and s["promote_failures"] == 0
+
+    def test_served_generation_monotone_then_explicit_rollback(self, ctx):
+        served = [r["served"] for r in ctx["ledger"]]
+        assert served == [1, 2, 3, 2]       # monotone, then rollback
+        assert ctx["rollback_record"]["verdict"] == "rollback"
+        assert ctx["stats"]["served_generation"] == 2
+        assert ctx["stats"]["rollbacks_of_served"] == 1
+
+    def test_decode_oracle_tracks_promotion_and_rollback(self, ctx):
+        # after 3 promotions every response comes from gen 3; after the
+        # explicit rollback every response comes from gen 2
+        assert ctx["decoded"][0] == {3}
+        assert ctx["decoded"][1] == {2}
+
+    def test_zero_retrace_on_the_serving_path(self, ctx):
+        assert ctx["trace_deltas"] == [0, 0]
+
+    def test_ledger_checksums_match_artifacts(self, ctx):
+        for rec in ctx["ledger"]:
+            with open(rec["path"], "rb") as f:
+                assert rec["checksum"] == zlib.crc32(f.read()), rec
+
+    def test_ledger_jsonl_mirrors_memory(self, ctx):
+        path = os.path.join(ctx["bundle_dir"], "ledger.jsonl")
+        rows = [json.loads(line) for line in open(path)]
+        assert rows == ctx["ledger"]
+
+    def test_stats_surface(self, ctx):
+        s = ctx["stats"]
+        for key in ("promotions", "canary_rejections", "promote_failures",
+                    "rollbacks", "rollbacks_of_served", "exports",
+                    "export_retries", "batches", "batches_skipped",
+                    "preemptions", "generation", "served_generation",
+                    "last_good", "quarantine", "stream"):
+            assert key in s, key
+        assert s["generation"] == 3 and s["last_good"] == 2
+        assert s["batches"] == 6 and s["stream"]["chunks"] == 6
+
+    def test_router_stats_gain_failure_and_rollback_counts(self, ctx):
+        rs = ctx["router_stats"][TENANT]
+        # gen 1 is the initial deploy (add_tenant), gens 2 and 3 are
+        # router promotions; the explicit rollback is counted once
+        assert rs["promotions"] == 2
+        assert rs["promote_failures"] == 0
+        assert rs["rollbacks"] == 1
+
+    def test_model_actually_learned(self, ctx):
+        est = ctx["est"]
+        np.testing.assert_allclose(np.asarray(est.coef_).ravel(),
+                                   np.ones(NF), atol=1e-3)
+        assert abs(float(est.intercept_)) < 1e-2
+
+
+class TestExportRetry:
+    """Satellite: the bundle-export retry/backoff seam — transient IO,
+    torn artifacts, budget exhaustion, and the atomic no-partial-artifact
+    invariant."""
+
+    def _trainer(self, tmp_path, retry):
+        ck = FitCheckpoint(str(tmp_path / "ck.npz"), every=1)
+        return ContinuousTrainer(
+            StreamLR(NF), _stream(seed=3), ck, _pipeline_of(0),
+            str(tmp_path / "bundles"), batches_per_generation=1,
+            buckets=BUCKETS, retry=retry)
+
+    def test_eintr_style_transient_succeeds_within_budget(
+            self, tmp_path, monkeypatch):
+        from dislib_tpu.runtime.bundle_io import write_bundle as real
+        flaky = FlakyCall(real, failures=2,
+                          exc_factory=lambda: InterruptedError("EINTR"))
+        monkeypatch.setattr("dislib_tpu.serving.bundle.write_bundle", flaky)
+        tr = self._trainer(tmp_path, Retry(attempts=4, backoff=0.0))
+        rec = tr.step()
+        assert rec["verdict"] == "exported"
+        assert flaky.calls == 3
+        assert tr.stats()["export_retries"] == 2
+
+    def test_torn_then_clean_succeeds_and_artifact_verifies(
+            self, tmp_path, monkeypatch):
+        torn = TornBundleWrite(failures=1, mode="truncate")
+        monkeypatch.setattr("dislib_tpu.serving.bundle.write_bundle", torn)
+        tr = self._trainer(tmp_path, Retry(
+            attempts=3, backoff=0.0,
+            classify=ContinuousTrainer._classify_export))
+        rec = tr.step()
+        assert rec["verdict"] == "exported" and torn.calls == 2
+        assert tr.stats()["export_retries"] == 1
+        # the artifact that survived is the CLEAN rewrite — loads whole
+        from dislib_tpu.serving.bundle import load_bundle
+        assert load_bundle(rec["path"]).buckets == BUCKETS
+
+    def test_corrupt_on_disk_exhausts_to_typed_error(
+            self, tmp_path, monkeypatch):
+        torn = TornBundleWrite(failures=10, mode="flip")
+        monkeypatch.setattr("dislib_tpu.serving.bundle.write_bundle", torn)
+        tr = self._trainer(tmp_path, Retry(
+            attempts=2, backoff=0.0,
+            classify=ContinuousTrainer._classify_export))
+        with pytest.raises(SnapshotCorrupt):
+            tr.step()
+        assert torn.calls == 2              # budget spent, typed raise
+
+    def test_transient_exhaustion_leaves_no_partial_artifact(
+            self, tmp_path, monkeypatch):
+        def _always_eintr(path, arrays):
+            raise InterruptedError("EINTR")
+        monkeypatch.setattr("dislib_tpu.serving.bundle.write_bundle",
+                            _always_eintr)
+        tr = self._trainer(tmp_path, Retry(attempts=3, backoff=0.0))
+        with pytest.raises(InterruptedError):
+            tr.step()
+        # atomic invariant, counter-asserted: nothing — no bundle, no
+        # tmp file — is visible in the bundle dir after exhaustion
+        assert os.listdir(tmp_path / "bundles") == []
+
+    def test_snapshot_corrupt_is_fatal_without_export_classify(self):
+        # regression pin: SnapshotCorrupt is a ValueError, so the DEFAULT
+        # classification calls it fatal — the trainer's export seam must
+        # override (a torn artifact is fixed by rewriting it)
+        from dislib_tpu.runtime.retry import is_transient_error
+        exc = SnapshotCorrupt("torn")
+        assert not is_transient_error(exc)
+        assert ContinuousTrainer._classify_export(exc) is True
+        assert ContinuousTrainer._classify_export(OSError(5, "eio")) is None
+
+
+class TestPromotionGate:
+    def test_canary_budget_exhausts_to_promotion_failed(self, tmp_path):
+        trip = CanaryGateTrip(times=99)
+
+        def gate(loaded, g):
+            return True if g == 1 else trip(loaded, g)
+
+        ck = FitCheckpoint(str(tmp_path / "ck.npz"), every=1)
+        router = ModelRouter(name="gate-router")
+        rng = np.random.RandomState(11)
+        with router:
+            tr = ContinuousTrainer(
+                StreamLR(NF), _stream(seed=5), ck, _pipeline_of(0),
+                str(tmp_path / "bundles"), router=router, tenant=TENANT,
+                buckets=BUCKETS, batches_per_generation=1,
+                promote_budget=2, health_gate=gate,
+                retry=Retry(attempts=2, backoff=0.0))
+            assert tr.step()["verdict"] == "promoted"      # initial deploy
+            assert tr.step()["verdict"] == "rejected"      # stays on 1
+            assert _decode(router, rng, n=3) == {1}
+            with pytest.raises(PromotionFailed) as ei:
+                tr.step()
+            err = ei.value
+            assert err.tenant == TENANT and err.last_good == 1
+            assert err.attempts == 2 and err.generation == 3
+            # the rejected canaries never took the primary: last-good
+            # still answers every request
+            assert _decode(router, rng, n=3) == {1}
+            s = tr.stats()
+            assert s["canary_rejections"] == 2 and s["rollbacks"] == 2
+            assert s["promote_failures"] == 1
+            assert s["served_generation"] == 1
+            rs = router.stats()[TENANT]
+            assert rs["promote_failures"] == 2 and rs["promotions"] == 0
+            verdicts = [r["verdict"] for r in tr.ledger]
+            assert verdicts == ["promoted", "rejected", "rejected+budget"]
+            tr.close()
+
+    def test_gate_exception_counts_as_veto_not_crash(self, tmp_path):
+        def gate(loaded, g):
+            if g == 1:
+                return True
+            raise RuntimeError("probe service down")
+
+        ck = FitCheckpoint(str(tmp_path / "ck.npz"), every=1)
+        router = ModelRouter(name="veto-router")
+        with router:
+            tr = ContinuousTrainer(
+                StreamLR(NF), _stream(seed=6), ck, _pipeline_of(0),
+                str(tmp_path / "bundles"), router=router, tenant=TENANT,
+                buckets=BUCKETS, batches_per_generation=1,
+                promote_budget=3, health_gate=gate,
+                retry=Retry(attempts=2, backoff=0.0))
+            tr.step()
+            rec = tr.step()
+            assert rec["verdict"] == "rejected"
+            assert "probe service down" in rec["gate_error"]
+            assert tr.stats()["served_generation"] == 1
+            tr.close()
+
+
+class TestQuarantineSeam:
+    """Satellite: the trainer's stream rides the QuarantineLedger per
+    batch — totals accumulate across generations, reports stay capped."""
+
+    def test_totals_accumulate_and_reports_cap_under_always_dirty(
+            self, tmp_path, monkeypatch):
+        from dislib_tpu.data import io as dio
+        led = dio.QuarantineLedger(max_reports=3)
+        monkeypatch.setattr(dio, "_LEDGER", led)
+        ck = FitCheckpoint(str(tmp_path / "ck.npz"), every=1)
+        dirty = (np.full((4, NF + 1), np.nan, np.float32)
+                 for _ in range(8))
+        tr = ContinuousTrainer(
+            StreamLR(NF), dirty, ck, _pipeline_of(0),
+            str(tmp_path / "bundles"), batches_per_generation=4)
+        with pytest.warns(RuntimeWarning):
+            assert tr.train_generation()    # all 4 batches skipped
+            assert tr.train_generation()
+        s = tr.stats()
+        assert s["batches"] == 0 and s["batches_skipped"] == 8
+        # exact totals survive past the retained-report cap
+        assert s["quarantine"]["n_quarantined"] == 32
+        assert s["quarantine"]["reports_retained"] == 3
+        assert led.n_quarantined == 32 and len(led.reports) == 3
+
+    def test_mixed_stream_feeds_clean_rows_only(self, tmp_path,
+                                                monkeypatch):
+        from dislib_tpu.data import io as dio
+        monkeypatch.setattr(dio, "_LEDGER", dio.QuarantineLedger())
+
+        def mixed():
+            for b in _stream(seed=9, rows=16):
+                b[0, 0] = np.nan            # one dirty row per batch
+                yield b
+
+        ck = FitCheckpoint(str(tmp_path / "ck.npz"), every=1)
+        tr = ContinuousTrainer(
+            StreamLR(NF), mixed(), ck, _pipeline_of(0),
+            str(tmp_path / "bundles"), batches_per_generation=3)
+        with pytest.warns(RuntimeWarning):
+            assert tr.train_generation()
+        s = tr.stats()
+        assert s["batches"] == 3 and s["batches_skipped"] == 0
+        assert s["quarantine"]["n_quarantined"] == 3
+        assert s["quarantine"]["n_loaded"] == 45
+        # the model never saw the poison: it still solves exactly
+        np.testing.assert_allclose(
+            np.asarray(tr.estimator.coef_).ravel(), np.ones(NF), atol=1e-3)
+
+
+class TestStreamEnd:
+    def test_finite_stream_exhausts_cleanly(self, tmp_path):
+        finite = (b for b in [next(_stream(seed=13)) for _ in range(3)])
+        ck = FitCheckpoint(str(tmp_path / "ck.npz"), every=1)
+        tr = ContinuousTrainer(
+            StreamLR(NF), finite, ck, _pipeline_of(0),
+            str(tmp_path / "bundles"), batches_per_generation=2,
+            buckets=BUCKETS, retry=Retry(attempts=2, backoff=0.0))
+        assert tr.step()["verdict"] == "exported"   # 2 batches
+        assert tr.step()["verdict"] == "exported"   # final partial (1)
+        assert tr.step() is None                    # exhausted
+        s = tr.stats()
+        assert s["stream_exhausted"] and s["generation"] == 2
+        assert s["batches"] == 3

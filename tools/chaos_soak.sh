@@ -7,6 +7,7 @@
 # Usage:  tools/chaos_soak.sh [RUNS] [SEED]
 #         tools/chaos_soak.sh --matrix [SEED] [OUT_JSONL]
 #         tools/chaos_soak.sh --oscillate [SEED]
+#         tools/chaos_soak.sh --trainer [SEED] [OUT_JSONL]
 #
 # Default mode runs the `slow`-marked tests/test_chaos_soak.py (excluded
 # from tier-1) and echoes the machine-readable summary line; append it to
@@ -22,8 +23,33 @@
 # shrink → heal → grow device-availability walk across every chunked
 # estimator family, asserting zero consumed rollback budget and an
 # oracle-matching model after every swing (bidirectional elasticity).
+#
+# --trainer (round-17) runs the CONTINUOUS-LEARNING soak: one
+# ContinuousTrainer driven train → bundle → canary → promote through six
+# generations with a fault at every seam (torn export, corrupt bundle,
+# canary gate trip, preemption, capacity shrink/grow, explicit rollback)
+# while client threads decode (tenant, generation) from every response —
+# and APPENDS the summary to OUT_JSONL (default BENCH_local_r15.jsonl).
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
+if [ "$1" = "--trainer" ]; then
+    SEED="${2:-0}"
+    OUT="${3:-BENCH_local_r15.jsonl}"
+    LOG="$(mktemp)"
+    env JAX_PLATFORMS=cpu DSLIB_SOAK_SEED="$SEED" \
+        python -m pytest tests/test_chaos_soak.py::test_chaos_trainer_soak \
+        -q -m slow -s -p no:cacheprovider 2>&1 | tee "$LOG"
+    rc=${PIPESTATUS[0]}
+    echo "-- trainer soak summary --"
+    grep -a "^CHAOS_TRAINER_SUMMARY" "$LOG" | sed 's/^CHAOS_TRAINER_SUMMARY //'
+    if [ "$rc" -eq 0 ]; then
+        grep -a "^CHAOS_TRAINER_SUMMARY" "$LOG" \
+            | sed 's/^CHAOS_TRAINER_SUMMARY //' >> "$OUT"
+        echo "appended to $OUT"
+    fi
+    rm -f "$LOG"
+    exit $rc
+fi
 if [ "$1" = "--oscillate" ]; then
     SEED="${2:-0}"
     LOG="$(mktemp)"

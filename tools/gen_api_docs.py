@@ -89,14 +89,18 @@ SECTIONS = [
      ["write_bundle", "read_bundle", "BundleIncompatible"]),
     ("Multi-tenant routing", "dislib_tpu.serving",
      ["ModelRouter", "TenantQuotaExceeded"]),
+    ("Continuous-learning trainer (train → bundle → canary → promote)",
+     "dislib_tpu.runtime",
+     ["ContinuousTrainer", "PromotionFailed"]),
     ("Ingest quarantine", "dislib_tpu",
      ["QuarantineReport", "QuarantineLedger", "last_quarantine_report",
-      "quarantine_ledger"]),
+      "quarantine_ledger", "quarantine_batch"]),
     ("Fault injection", "dislib_tpu.utils.faults",
      ["CallbackCheckpoint", "SigtermAtNthSave", "corrupt_snapshot",
       "FlakyCall", "FlakyOpen",
       "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk",
-      "FaultAtTier"]),
+      "FaultAtTier", "CapacityAtSave", "oscillation_schedule",
+      "TornBundleWrite", "CanaryGateTrip"]),
     ("Profiling", "dislib_tpu.utils.profiling",
      ["trace", "annotate", "op_graph", "profiled_jit", "dispatch_count",
       "trace_count", "transfer_count", "counters", "reset_counters",
