@@ -2318,7 +2318,7 @@ def bench_als_sparse(n_users, n_items, nnz_per_user, tag, n_f=16, iters=3):
             "proxy_rmse": round(rmse_prx, 4)}
 
 
-def bench_sparse(m, n, k, density, tag, panels=2, min_speedup=2.0,
+def bench_sparse(m, n, k, density, tag, panels=4, min_speedup=2.0,
                  temp_ratio_max=1.0):
     """Round-14 sparse fast path: the sharded masked-psum SpMM vs the
     densify route (to_dense + dense GEMM — what every sparse matmul paid
@@ -2335,13 +2335,17 @@ def bench_sparse(m, n, k, density, tag, panels=2, min_speedup=2.0,
       route's floor IS that allocation);
     - speedup = densify_wall / spmm_wall ≥ ``min_speedup``
       (``DSLIB_SPMM_SPEEDUP_MIN`` overrides) at ≤1% density.
-    ``panels`` is recorded in the row: the panel count trades in-flight
-    panel memory against per-entry masking inflation (ops/spmm)."""
+    ``panels`` is recorded in the row.  Round 17: the default moved to 4
+    — the col-partitioned slot-range layout collapsed per-entry masking
+    work from O(steps·nse) to O(nse + steps·quantum), so the panel count
+    is now a pure memory knob; the row carries the masking-work
+    accounting (``spmm_masking_work``) as the evidence."""
     import scipy.sparse as sp
 
     import dislib_tpu as ds
     from dislib_tpu.data.sparse import SparseArray
-    from dislib_tpu.ops.spmm import spmm, spmm_memory_analysis
+    from dislib_tpu.ops.spmm import (spmm, spmm_masking_work,
+                                     spmm_memory_analysis)
     from dislib_tpu.utils import profiling as _prof
 
     assert density <= 0.01 + 1e-9, "the headline gate is the ≤1% regime"
@@ -2421,6 +2425,11 @@ def bench_sparse(m, n, k, density, tag, panels=2, min_speedup=2.0,
     pipe.predict_bucket(batch, 8)                   # warm
     t_fold = _median_time(lambda: pipe.predict_bucket(batch, 8))
 
+    # masking-work accounting: what the slot-range layout saves per
+    # dispatch vs the legacy re-mask-everything layout at this panel
+    # count — the "panels is a pure memory knob now" evidence
+    mw = spmm_masking_work(xs, b, panels=panels)
+
     res = {"metric": f"sparse_{tag}_spmm_speedup_vs_densify (baseline: "
                      "to_dense + dense GEMM per product)",
            "value": round(speedup, 2), "unit": "x",
@@ -2428,6 +2437,9 @@ def bench_sparse(m, n, k, density, tag, panels=2, min_speedup=2.0,
            "densify_wall_s": round(t_dn, 4),
            "shape": [m, n, k], "density": density, "nnz": int(mat.nnz),
            "panels": panels, "steps": ma["steps"],
+           "masked_layout_work": mw["masked_work"],
+           "slots_layout_work": mw["slots_work"],
+           "masking_inflation_removed": mw["inflation"],
            "dispatches_per_op": 1, "host_transfers": 0,
            "temp_vs_dense": ma["temp_vs_dense"],
            "temp_ratio_max": ratio_max,
@@ -2444,6 +2456,125 @@ def bench_sparse(m, n, k, density, tag, panels=2, min_speedup=2.0,
     if speedup < floor:
         msg = (f"SPMM SPEEDUP GATE FAILED: {speedup:.2f}x below the "
                f"{floor:.2f}x floor vs the densify route")
+        print(msg, file=sys.stderr, flush=True)
+        raise AssertionError(msg)
+    return res
+
+
+def bench_trees(m, n_feat, n_nodes, n_bins, tag, s=3, min_speedup=1.2):
+    """Round-17 Pallas tier two: the forest level histogram — the fit
+    loop's scatter-shaped hot op — as the one-hot-GEMM Pallas kernel
+    (``ops/pallas_kernels.node_histogram``) vs the XLA scatter-add it
+    replaces, at the routed ``trees/decision_tree._node_histogram``
+    surface.
+
+    Gates (all fail the config loudly):
+    - BIT-equality: pallas == xla == a NumPy scatter oracle (the forest's
+      contributions — Poisson weights × count/target stats — are
+      integer-representable, so both summation orders are exact);
+    - the routed forest fit is counter-observable (``hist:pallas``) and a
+      warm same-shape refit compiles ZERO new programs;
+    - speedup = xla_wall / pallas_wall >= the floor.  MXU-class backends
+      (real TPUs, where the one-hot GEMM is dense MXU work against a
+      serialized scatter loop) get ``min_speedup``; interpret-mode rigs
+      (this CPU box) get 0.0 — Pallas interpret mode is a correctness
+      rig, not wall-clock evidence (the bf16 parity-class-floor
+      precedent).  ``DSLIB_HIST_SPEEDUP_MIN`` overrides either floor."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+    import dislib_tpu as ds
+    from dislib_tpu.ops import pallas_kernels as _pk
+    from dislib_tpu.trees import RandomForestClassifier
+    from dislib_tpu.trees.decision_tree import _node_histogram
+    from dislib_tpu.utils import profiling as _prof
+
+    if not _pk.hist_available():
+        raise RuntimeError("pallas histogram kernel unavailable on this "
+                           "backend (hist_available probe failed)")
+    rng = np.random.RandomState(0)
+    node_h = rng.randint(0, n_nodes, m).astype(np.int32)
+    bx_h = rng.randint(0, n_bins, (m, n_feat)).astype(np.int32)
+    w_h = rng.poisson(1.0, m).astype(np.float32)
+    stats_h = rng.randint(0, 3, (m, s)).astype(np.float32)
+    node, bx = jnp.asarray(node_h), jnp.asarray(bx_h)
+    w, stats = jnp.asarray(w_h), jnp.asarray(stats_h)
+
+    fns = {sched: jax.jit(
+        lambda nd, b, ww, st, _s=sched: _node_histogram(
+            nd, b, ww, st, n_nodes, n_bins, hist=_s))
+        for sched in ("xla", "pallas")}
+
+    # correctness gate: both routes vs each other AND a host oracle
+    outs = {k: np.asarray(f(node, bx, w, stats)) for k, f in fns.items()}
+    np.testing.assert_array_equal(outs["xla"], outs["pallas"])
+    want = np.zeros((n_nodes, n_feat, n_bins, s), np.float32)
+    contrib = w_h[:, None] * stats_h
+    for f_i in range(n_feat):
+        np.add.at(want, (node_h, f_i, bx_h[:, f_i]), contrib)
+    np.testing.assert_array_equal(outs["xla"], want)
+
+    # interleaved best-of walls (the bench_sparse precedent: alternating
+    # arms under the same load profile, best per arm)
+    t_x, t_p = [], []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(fns["xla"](node, bx, w, stats)[:1])
+        t_x.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        np.asarray(fns["pallas"](node, bx, w, stats)[:1])
+        t_p.append(time.perf_counter() - t0)
+    t_x, t_p = min(t_x), min(t_p)
+    speedup = t_x / t_p
+
+    # routed-fit evidence: the hist:<sched> counter at the fit boundary,
+    # and zero new programs on a warm same-shape refit
+    x_fit = rng.rand(512, 8).astype(np.float32)
+    y_fit = (x_fit[:, 0] > 0.5).astype(np.float32)[:, None]
+    prev = os.environ.get("DSLIB_OVERLAP")
+    os.environ["DSLIB_OVERLAP"] = "pallas"
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")     # pallas warns off-TPU
+            RandomForestClassifier(n_estimators=2, random_state=0).fit(
+                ds.array(x_fit), ds.array(y_fit))       # warm
+            _prof.reset_counters()
+            RandomForestClassifier(n_estimators=2, random_state=0).fit(
+                ds.array(x_fit), ds.array(y_fit))
+        sc = _prof.schedule_counters()
+        assert sc.get("hist:pallas", 0) >= 1, \
+            f"routed forest fit left no hist:pallas counter: {sc}"
+        traces = _prof.trace_count()
+        assert traces == 0, \
+            f"warm same-shape refit compiled {traces} new programs"
+    finally:
+        if prev is None:
+            os.environ.pop("DSLIB_OVERLAP", None)
+        else:
+            os.environ["DSLIB_OVERLAP"] = prev
+
+    interpret = jax.default_backend() != "tpu"
+    floor = float(os.environ.get("DSLIB_HIST_SPEEDUP_MIN",
+                                 0.0 if interpret else min_speedup))
+    res = {"metric": f"trees_{tag}_hist_speedup_vs_scatter (baseline: "
+                     "the XLA scatter-add histogram, same shapes)",
+           "value": round(speedup, 2), "unit": "x",
+           "vs_baseline": round(speedup, 2),
+           "xla_wall_s": round(t_x, 5), "pallas_wall_s": round(t_p, 5),
+           "shape": [m, n_feat], "n_nodes": n_nodes, "n_bins": n_bins,
+           "stats_width": s, "interpret_mode": interpret,
+           "speedup_floor": floor, "fresh": True,
+           "note": "gates: pallas == xla == numpy oracle BIT-equal, "
+                   "hist:pallas counter on a routed fit, 0 traces on a "
+                   "warm refit, speedup >= floor (floor 0.0 on "
+                   "interpret-mode rigs — wall clock there is a "
+                   "correctness rig, not MXU evidence; "
+                   "DSLIB_HIST_SPEEDUP_MIN overrides)"}
+    if speedup < floor:
+        msg = (f"HIST SPEEDUP GATE FAILED: one-hot-GEMM histogram at "
+               f"{speedup:.2f}x the XLA scatter is below the "
+               f"{floor:.2f}x floor")
         print(msg, file=sys.stderr, flush=True)
         raise AssertionError(msg)
     return res
@@ -2591,11 +2722,17 @@ def _configs():
                                    buckets=(1, 8, 64), deadline_ms=2)),
             ("als_smoke", lambda: bench_als_sparse(1000, 400, 10, "smoke",
                                                    n_f=8, iters=2)),
-            # round-14 sparse fast path: SpMM >= 2x the densify A/B at
-            # 1% density, 1 dispatch, O(nnz) peak-live, db==seq bit-equal
+            # round-14 sparse fast path (round-17: default panels=4 under
+            # the slot-range layout — a pure memory knob now, masking-work
+            # accounting in the row): SpMM >= 2x the densify A/B at 1%
+            # density, 1 dispatch, O(nnz) peak-live, db==seq bit-equal
             ("sparse_smoke",
-             lambda: bench_sparse(4096, 2048, 64, 0.01, "smoke",
-                                  panels=2)),
+             lambda: bench_sparse(4096, 2048, 64, 0.01, "smoke")),
+            # round-17 Pallas tier two: the forest level histogram as a
+            # one-hot GEMM vs the XLA scatter — bit-equal gated; the
+            # speedup floor arms on MXU-class backends only
+            ("trees_smoke",
+             lambda: bench_trees(2048, 8, 16, 32, "smoke")),
             ("shuffle_smoke", lambda: bench_shuffle(4096, 16, "smoke",
                                                     chain=3)),
             ("kmeans_smoke_star",
@@ -2675,11 +2812,16 @@ def _configs():
         ("als_sparse_100000x10000_nnz100_f16_3it_wall_s",
          lambda: bench_als_sparse(100_000, 10_000, 100,
                                   "100000x10000_nnz100")),
-        # round-14 sparse fast path at paper scale: the sharded SpMM vs
+        # round-14 sparse fast path at paper scale (round-17: default
+        # panels=4 under the slot-range layout): the sharded SpMM vs
         # the densify route on this rig, same gates as the smoke tier
         ("sparse_16384x8192_spmm_speedup_vs_densify",
-         lambda: bench_sparse(16_384, 8_192, 64, 0.01, "16384x8192",
-                              panels=2)),
+         lambda: bench_sparse(16_384, 8_192, 64, 0.01, "16384x8192")),
+        # round-17 Pallas tier two at paper-ish shape: one-hot-GEMM
+        # histogram vs the XLA scatter, bit-equal + hist:<sched> routing
+        # gated; speedup floor arms on MXU-class backends
+        ("trees_16384x8_hist_speedup_vs_scatter",
+         lambda: bench_trees(16_384, 8, 32, 32, "16384x8")),
         # round-9 serving layer: warm micro-batched p50 vs per-call cold
         # predict, 1-dispatch-per-batch asserted in-config
         ("serving_1000000x100_k10_warm_p50_ms",

@@ -520,17 +520,36 @@ def _solve_level_batched(xv, yv, nodes, c, n_feat, kernel, gamma,
 
     if n_nodes <= batch and k_of is None:
         return solve_chunk(nodes)
-    alphas, objs = [], []
-    for s in range(0, n_nodes, batch):
-        chunk = nodes[s:s + batch]
+    # batched level: the dispatch→read sequence pipelines through the
+    # shared host-loop discipline — batch t's blocking reads run under
+    # batch t+1's solve (one extra batch in flight), db/seq bit-equal by
+    # construction; the routing is observable through the schedule
+    # counter like every other overlap site
+    from dislib_tpu.ops import overlap as _ov
+    from dislib_tpu.utils import profiling as _prof
+    sched = _ov.resolve()
+    _prof.count_schedule("csvm_batches", sched)
+
+    def fetch(i):
+        chunk = nodes[i * batch:(i + 1) * batch]
         if chunk.shape[0] < batch:
             chunk = np.concatenate(
                 [chunk, np.full((batch - chunk.shape[0], cap), -1, np.int64)])
         a, o = solve_chunk(chunk)
-        alphas.append(np.asarray(a))
-        objs.append(np.asarray(o))
-    return (np.concatenate(alphas)[:n_nodes],
-            np.concatenate(objs)[:n_nodes])
+        # start the device→host DMA too, so consume()'s blocking read
+        # finds the bytes already on their way
+        for buf in (a, o):
+            if hasattr(buf, "copy_to_host_async"):
+                buf.copy_to_host_async()
+        return a, o
+
+    def consume(i, pair):
+        return np.asarray(pair[0]), np.asarray(pair[1])
+
+    res = _ov.host_pipeline(-(-n_nodes // batch), fetch, consume,
+                            overlap=_ov.overlapped(sched))
+    return (np.concatenate([a for a, _ in res])[:n_nodes],
+            np.concatenate([o for _, o in res])[:n_nodes])
 
 
 def _pack_nodes(rows):

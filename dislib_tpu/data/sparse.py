@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import math
 import os
+from collections import namedtuple
 from functools import partial
 
 import jax
@@ -51,7 +52,7 @@ from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.utils.profiling import count_transfer as _count_transfer
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
 
-__all__ = ["SparseArray", "ShardedSparse", "nse_quantum"]
+__all__ = ["SparseArray", "ShardedSparse", "SparsePanelView", "nse_quantum"]
 
 
 def nse_quantum() -> int:
@@ -105,14 +106,21 @@ class ShardedSparse:
     Host metadata (control plane only — never a device transfer):
     ``counts`` (tuple of per-shard ints), ``row_nnz`` (int64 (m,) per-row
     entry histogram, layout-independent: relayout target shapes are
-    computed from it on host, so no device sync ever decides a shape).
+    computed from it on host, so no device sync ever decides a shape),
+    and ``cols_host`` (int32 (nnz,) global live-COLUMN stream in the
+    row-sorted global entry order).  The column stream is as
+    layout-independent as ``row_nnz`` — relayout permutes entries between
+    shards but never reorders the global stream — so the rechunk
+    schedules carry it through unchanged, and the col-partitioned panel
+    view below sizes its slot ranges from it without a device sync.
     """
 
     __slots__ = ("data", "lrows", "cols", "_counts_dev", "counts",
-                 "row_nnz", "shape", "mesh", "m_local", "nse", "_rowsq")
+                 "row_nnz", "shape", "mesh", "m_local", "nse", "_rowsq",
+                 "cols_host", "_pviews", "_ell", "_rsteps")
 
     def __init__(self, data, lrows, cols, counts_dev, counts, row_nnz,
-                 shape, mesh):
+                 shape, mesh, cols_host=None):
         self.data = data
         self.lrows = lrows
         self.cols = cols
@@ -124,6 +132,11 @@ class ShardedSparse:
         self.m_local = _padded_rows(shape[0], mesh) // int(data.shape[0])
         self.nse = int(data.shape[1])
         self._rowsq = None
+        self.cols_host = None if cols_host is None \
+            else np.asarray(cols_host, np.int32)
+        self._pviews = {}
+        self._ell = None
+        self._rsteps = {}
 
     @property
     def counts_dev(self):
@@ -180,10 +193,12 @@ class ShardedSparse:
         data[shard, slot] = vals
         lr[shard, slot] = rows - shard * m_local
         cc[shard, slot] = cols
-        return cls._place(data, lr, cc, counts, row_nnz, (m, n), mesh)
+        return cls._place(data, lr, cc, counts, row_nnz, (m, n), mesh,
+                          cols_host=cols.astype(np.int32))
 
     @classmethod
-    def _place(cls, data, lr, cc, counts, row_nnz, shape, mesh):
+    def _place(cls, data, lr, cc, counts, row_nnz, shape, mesh,
+               cols_host=None):
         sh1 = jax.sharding.NamedSharding(mesh,
                                          jax.sharding.PartitionSpec(_mesh.ROWS))
         return cls(jax.device_put(jnp.asarray(data), sh1),
@@ -191,7 +206,7 @@ class ShardedSparse:
                    jax.device_put(jnp.asarray(cc), sh1),
                    jax.device_put(jnp.asarray(np.asarray(counts, np.int32)),
                                   sh1),
-                   counts, row_nnz, shape, mesh)
+                   counts, row_nnz, shape, mesh, cols_host=cols_host)
 
     def rowsq(self):
         """Device (p, m_local) per-row ‖x_i‖² — the KMeans/kNN distance
@@ -219,6 +234,131 @@ class ShardedSparse:
         cat = (np.concatenate(x) if x else np.zeros(0)
                for x in (rows_l, cols_l, vals_l))
         return tuple(cat)
+
+    # -- col-partitioned panel view (the SpMM slot-range layout) -------------
+
+    def _cols_stream(self):
+        """Host int32 (nnz,) global live-column stream — ``cols_host``,
+        or (for a representation built before the stream metadata
+        existed) ONE blessed fetch through the transfer counter, cached.
+        The stream is shard-major over live slots, which by the
+        row-sorted invariant IS the global row-sorted entry order."""
+        if self.cols_host is None:
+            _count_transfer()
+            cc = np.asarray(jax.device_get(self.cols))
+            self.cols_host = np.concatenate(
+                [cc[s, :k] for s, k in enumerate(self.counts)]
+            ).astype(np.int32)
+        return self.cols_host
+
+    def panel_counts(self, steps, h):
+        """Host (p, steps) per-shard-per-PANEL live-entry histogram
+        (panel t owns columns [t·h, (t+1)·h)) — the control-plane input
+        that sizes the panel view's uniform slot ranges.  Pure host
+        arithmetic over ``cols_host`` + ``counts``: no device sync ever
+        decides a shape, the ``row_nnz`` discipline applied to the
+        column axis."""
+        cs = self._cols_stream()
+        start = np.concatenate([[0], np.cumsum(self.counts)]).astype(np.int64)
+        pc = np.zeros((self.p, steps), np.int64)
+        for s in range(self.p):
+            seg = cs[start[s]:start[s + 1]] // h
+            if seg.size:
+                pc[s, :] = np.bincount(seg, minlength=steps)[:steps]
+        return pc
+
+    def panel_view(self, steps, h):
+        """Cached col-partitioned :class:`SparsePanelView` for a
+        ``steps``-panel schedule of width ``h`` columns.
+
+        Each shard's live entries are re-sorted (stably, so row order
+        survives within a panel) into per-panel slot ranges: panel t owns
+        slots [t·nse_p, (t+1)·nse_p) with nse_p the nse-quantum-rounded
+        max per-(shard, panel) count.  An SpMM panel step then touches
+        ONLY its own contiguous slot range — O(nse + steps·quantum) total
+        masking work instead of re-masking all nse entries per panel
+        (O(steps·nse)) — which is what makes ``DSLIB_SPMM_PANELS`` a pure
+        memory knob.  Stored columns are PANEL-LOCAL (col − t·h); pads
+        rebuild from the zero canvas (poisoned primary pads are dropped
+        by the slot mask before the re-sort ever sees them).  Built on
+        device in one jitted dispatch; derived + cached, so rechunk
+        products simply rebuild it lazily."""
+        key = (int(steps), int(h))
+        if key not in self._pviews:
+            pc = self.panel_counts(steps, h)
+            nse_p = _round_nse(int(pc.max(initial=0)))
+            d, lr, cc = _panel_view_kernel(self.data, self.lrows, self.cols,
+                                           self.counts_dev, self.mesh,
+                                           int(steps), int(h), nse_p)
+            cdev = _pcounts_kernel(tuple(map(tuple, pc.tolist())), self.mesh)
+            self._pviews[key] = SparsePanelView(d, lr, cc, cdev, nse_p,
+                                                int(steps), int(h))
+        return self._pviews[key]
+
+    # -- estimator staging views (built on device, no host round-trip) -------
+
+    def ell_buffers(self):
+        """Padded ELL ``(vals (p·m_local, r), cols (p·m_local, r))`` with
+        r = max row nnz, built ON DEVICE from the sharded buffers (one
+        jitted shard-local scatter — the entries are row-sorted within a
+        shard, so slot-within-row is position minus the row's first
+        occurrence).  Rows stay P('rows')-sharded; padded rows past the
+        logical m are all-zero, so a row gather past m contributes
+        nothing.  Derived + cached: the device replacement for the host
+        ``argsort``/bincount staging, which is what makes a sharded-backed
+        CascadeSVM fit entry transfer-free."""
+        if self._ell is None:
+            r = max(1, int(self.row_nnz.max(initial=1)))
+            self._ell = _ell_kernel(self.data, self.lrows, self.cols,
+                                    self.counts_dev, self.mesh, r,
+                                    self.m_local)
+        return self._ell
+
+    def row_step_plan(self, chunk):
+        """Host ``(steps, budget)`` greedy row-step packing from
+        ``row_nnz`` alone — identical math to the legacy host-CSR plan
+        (same steps, same budget), but pure control-plane arithmetic:
+        no device sync ever decides the step shapes.  Each step is
+        ``(row_off, rows_in, nnz_lo, nnz_hi)`` over the global row-sorted
+        entry stream; steps tile the stream contiguously."""
+        m = self.shape[0]
+        row_start = np.concatenate([[0], np.cumsum(self.row_nnz)])
+        avg_chunk_nnz = max(1, int(np.ceil(int(row_start[-1]) * chunk
+                                           / max(m, 1))))
+        budget = max(64, 4 * avg_chunk_nnz, int(self.row_nnz.max(initial=1)))
+        steps = []
+        r = 0
+        while r < m:
+            r_end = r
+            while (r_end < m and r_end - r < chunk
+                   and (r_end == r
+                        or row_start[r_end + 1] - row_start[r] <= budget)):
+                r_end += 1
+            steps.append((r, r_end - r, int(row_start[r]),
+                          int(row_start[r_end])))
+            r = r_end
+        if not steps:
+            steps = [(0, 0, 0, 0)]
+        return steps, budget
+
+    def row_step_buffers(self, chunk):
+        """The kNN streaming buffers ``(data (s, budget), local_rows,
+        cols, row_off (s,), rows_in (s,))`` gathered ON DEVICE: by the
+        row-sorted invariant (and the canonical row split — shards own
+        contiguous disjoint row ranges) the shard-major live stream IS the
+        global row-sorted stream, so each shard scatters its own slice of
+        every step and one psum replicates the result.  Bit-identical to
+        the legacy host-CSR staging (same plan, same entry order).
+        Cached per chunk."""
+        key = int(chunk)
+        if key not in self._rsteps:
+            plan, budget = self.row_step_plan(chunk)
+            starts = tuple(int(v) for v in
+                           np.concatenate([[0], np.cumsum(self.counts)]))
+            self._rsteps[key] = _row_steps_kernel(
+                self.data, self.lrows, self.cols, self.counts_dev,
+                self.mesh, tuple(plan), int(budget), self.m_local, starts)
+        return self._rsteps[key]
 
 
 def _padded_rows(m, mesh):
@@ -263,6 +403,157 @@ def _rowsq_kernel(data, lrows, counts, mesh, m_local):
         out_specs=P(_mesh.ROWS),
         check_vma=True,
     )(data, lrows, counts)
+
+
+SparsePanelView = namedtuple(
+    "SparsePanelView",
+    ("data", "lrows", "cols", "counts_dev", "nse_p", "steps", "h"))
+SparsePanelView.__doc__ = """Col-partitioned derived view of a
+:class:`ShardedSparse` (see :meth:`ShardedSparse.panel_view`): ``data`` /
+``lrows`` / ``cols`` are (p, steps·nse_p) buffers whose panel-t live
+entries occupy slots [t·nse_p, t·nse_p + counts_dev[s, t]); ``cols``
+holds PANEL-LOCAL column ids (col − t·h); ``counts_dev`` is the (p,
+steps) per-shard-per-panel live-count table (a jit-embedded constant —
+transfer-guard clean)."""
+
+
+@partial(_pjit, static_argnames=("pcounts", "mesh"), name="sparse_pcounts")
+def _pcounts_kernel(pcounts, mesh):
+    tab = jnp.asarray(np.asarray(pcounts, np.int32))
+    return jax.lax.with_sharding_constraint(
+        tab, jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(_mesh.ROWS)))
+
+
+@partial(_pjit, static_argnames=("mesh", "steps", "h", "nse_p"),
+         name="sparse_panel_view")
+def _panel_view_kernel(data, lrows, cols, counts, mesh, steps, h, nse_p):
+    """Device re-sort of each shard's live entries into per-panel slot
+    ranges (ONE jitted dispatch, the staging half of the slot-range SpMM
+    layout).  Stable within a panel: rank-within-panel comes from a
+    cumulative one-hot count over the (row-sorted) live stream, so row
+    order — and with it segment-sum determinism — survives.  Pads and
+    anything the slot mask rejects scatter with ``mode="drop"`` onto the
+    zero canvas: a poisoned primary-buffer tail cannot enter the view."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(d_s, lr_s, cc_s, cnt_s):
+        d, lr, cc, cnt = d_s[0], lr_s[0], cc_s[0], cnt_s[0]
+        nse = d.shape[0]
+        live = jax.lax.broadcasted_iota(jnp.int32, (nse,), 0) < cnt
+        pan = jnp.where(live, cc // h, steps)          # sentinel for pads
+        pan_c = jnp.clip(pan, 0, steps - 1)
+        onehot = (pan[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (nse, steps), 1)).astype(jnp.int32)
+        rank = jnp.take_along_axis(jnp.cumsum(onehot, axis=0),
+                                   pan_c[:, None], axis=1)[:, 0] - 1
+        dest = jnp.where(live, pan_c * nse_p + rank, steps * nse_p)
+
+        def scat(src, dt):
+            z = jnp.zeros((steps * nse_p,), dt)
+            return z.at[dest].set(src.astype(dt), mode="drop")
+
+        nd = scat(jnp.where(live, d, jnp.zeros((), d.dtype)), d.dtype)
+        nlr = scat(jnp.where(live, lr, 0), jnp.int32)
+        ncc = scat(jnp.where(live, cc - pan_c * h, 0), jnp.int32)
+        return nd[None], nlr[None], ncc[None]
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(_mesh.ROWS),) * 4,
+        out_specs=(P(_mesh.ROWS),) * 3,
+        check_vma=True,
+    )(data, lrows, cols, counts)
+
+
+@partial(_pjit, static_argnames=("mesh", "r", "m_local"), name="sparse_ell")
+def _ell_kernel(data, lrows, cols, counts, mesh, r, m_local):
+    """Shard-local ELL build: entries are row-sorted within a shard, so
+    slot-within-row = position − searchsorted-first-occurrence (pads are
+    pushed to the ``m_local`` sentinel row first, keeping the keys
+    sorted).  Pads scatter with ``mode="drop"`` onto the zero canvas —
+    poisoned tails never enter the view."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(d_s, lr_s, cc_s, cnt_s):
+        d, lr, cc, cnt = d_s[0], lr_s[0], cc_s[0], cnt_s[0]
+        nse = d.shape[0]
+        pos = jax.lax.broadcasted_iota(jnp.int32, (nse,), 0)
+        live = pos < cnt
+        keys = jnp.where(live, lr, m_local)
+        slot = pos - jnp.searchsorted(keys, keys, side="left").astype(
+            jnp.int32)
+        dest = jnp.where(live, lr * r + slot, m_local * r)
+
+        def scat(src, dt):
+            z = jnp.zeros((m_local * r,), dt)
+            return z.at[dest].set(src.astype(dt), mode="drop")
+
+        vals = scat(jnp.where(live, d, jnp.zeros((), d.dtype)), d.dtype)
+        ccc = scat(jnp.where(live, cc, 0), jnp.int32)
+        return (vals.reshape(1, m_local, r), ccc.reshape(1, m_local, r))
+
+    ev, ec = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(_mesh.ROWS),) * 4,
+        out_specs=(P(_mesh.ROWS),) * 2,
+        check_vma=True,
+    )(data, lrows, cols, counts)
+    p = data.shape[0]
+    return ev.reshape(p * m_local, r), ec.reshape(p * m_local, r)
+
+
+@partial(_pjit, static_argnames=("mesh", "plan", "budget", "m_local",
+                                 "starts"),
+         name="sparse_row_steps")
+def _row_steps_kernel(data, lrows, cols, counts, mesh, plan, budget,
+                      m_local, starts):
+    """Device gather of the kNN row-step buffers: shard s owns global
+    stream ids [starts[s], starts[s+1]) (shard-major live slots ARE the
+    global row-sorted stream), so each shard scatters its slice of every
+    step — destination step by searchsorted over the static step
+    boundaries — and a psum over 'rows' replicates the (s, budget)
+    rectangles.  Step tables are jit-embedded constants (transfer-guard
+    clean)."""
+    from jax.sharding import PartitionSpec as P
+
+    s = len(plan)
+    row_off_np = np.asarray([st[0] for st in plan], np.int32)
+    rows_in_np = np.asarray([st[1] for st in plan], np.int32)
+    nlo_np = np.asarray([st[2] for st in plan], np.int64)
+
+    def local(d_s, lr_s, cc_s, cnt_s):
+        d, lr, cc, cnt = d_s[0], lr_s[0], cc_s[0], cnt_s[0]
+        nse = d.shape[0]
+        my = jax.lax.axis_index(_mesh.ROWS)
+        e0 = jnp.asarray(np.asarray(starts, np.int32))[my]
+        pos = jax.lax.broadcasted_iota(jnp.int32, (nse,), 0)
+        live = pos < cnt
+        g = e0 + pos                                # global stream id
+        nlo = jnp.asarray(nlo_np.astype(np.int32))
+        step = jnp.clip(jnp.searchsorted(nlo, g, side="right").astype(
+            jnp.int32) - 1, 0, s - 1)
+        within = g - nlo[step]
+        lrl = lr + my * m_local - jnp.asarray(row_off_np)[step]
+        dest = jnp.where(live, step * budget + within, s * budget)
+
+        def scat(src, dt):
+            z = jnp.zeros((s * budget,), dt)
+            return z.at[dest].set(src.astype(dt), mode="drop")
+
+        out = tuple(
+            jax.lax.psum(scat(jnp.where(live, v, jnp.zeros((), v.dtype)),
+                              v.dtype).reshape(s, budget), _mesh.ROWS)
+            for v in (d, lrl, cc))
+        return out
+
+    dta, lrl, ccl = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(_mesh.ROWS),) * 4,
+        out_specs=(P(None, None),) * 3,
+        check_vma=True,
+    )(data, lrows, cols, counts)
+    return (dta, lrl, ccl, jnp.asarray(row_off_np), jnp.asarray(rows_in_np))
 
 
 class SparseArray:
@@ -614,10 +905,25 @@ class SparseArray:
         Skew guard: one dense row inflates r to n, making the buffers
         O(m·n) — when the padded bytes exceed ``budget`` (default
         ``DSLIB_SPARSE_ELL_BUDGET``, 2 GiB) this returns None and callers
-        fall back to host-CSR staging.  Cached."""
+        fall back to host-CSR staging.  Cached.
+
+        A sharded-backed array builds the buffers ON DEVICE from the
+        :class:`ShardedSparse` buffers (`ell_buffers` — r and the budget
+        check come from the host ``row_nnz`` metadata, so the whole
+        staging is transfer-free); the host ``argsort`` path below is the
+        BCOO-only ingest fallback."""
         import os
         if budget is None:
             budget = int(os.environ.get("DSLIB_SPARSE_ELL_BUDGET", 2 << 30))
+        rep = self._sharded_rep
+        if rep is not None:
+            r = max(1, int(rep.row_nnz.max(initial=1)))
+            # budget on the real (padded-rows) allocation; re-checked on
+            # every call so lowering the budget between fits gets the
+            # fallback, not the over-budget cache
+            if rep.p * rep.m_local * r * 8 > budget:
+                return None
+            return rep.ell_buffers()
         # budget is re-checked against the CACHED buffers too: a caller
         # lowering the budget between fits must get the fallback, not the
         # over-budget cache
@@ -651,7 +957,16 @@ class SparseArray:
         rectangles to O(n_steps · max_chunk_nnz) — total padding is at most
         ~one budget per step.  Returns (data (s, budget), local_rows,
         cols, row_off (s,), rows_in (s,)); padding entries are (v=0,
-        row=0, col=0) and scatter-add to nothing.  Cached per chunk."""
+        row=0, col=0) and scatter-add to nothing.  Cached per chunk.
+
+        A sharded-backed array plans the steps from host ``row_nnz``
+        metadata and gathers the buffers ON DEVICE (`row_step_buffers` —
+        bit-identical plan and entry order to the host staging, zero
+        transfers); the host path below is the BCOO-only fallback."""
+        if self._sharded_rep is not None:
+            # sharded() (not the raw rep): a backing laid out for another
+            # mesh re-lands on the library mesh first, on device
+            return self.sharded().row_step_buffers(chunk)
         cached = getattr(self, "_row_steps_cache", None)
         if cached is not None and cached[0] == chunk:
             return cached[1]

@@ -193,11 +193,14 @@ def _kneighbors_sparse(x, f, k):
                   f._data[: f.shape[0], : f.shape[1]])
     mesh = _mesh.get_mesh()
     if isinstance(x, SparseArray):
-        if mesh.shape[_mesh.ROWS] > 1:
+        if mesh.shape[_mesh.ROWS] > 1 or x._sharded_rep is not None:
             # row-sharded schedule: each shard rebuilds its local BCOO
             # from the rectangular `sharded_rows` buffers and streams the
             # replicated fit windows — same shard_map reasoning as the
-            # dense-query path (GSPMD would gather the top-k operand)
+            # dense-query path (GSPMD would gather the top-k operand).
+            # Sharded-BACKED queries take it even on a 1-row mesh: the
+            # buffers are already device-resident, while the BCOO kernel
+            # below would materialise host triplets first.
             qdat, qlr, qcol, qrsq = x.sharded_rows(mesh)
             return _kneighbors_sparse_sharded_sq(
                 qdat, qlr, qcol, qrsq, *f_args, n=n, mq=x.shape[0],

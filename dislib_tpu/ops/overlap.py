@@ -30,6 +30,11 @@ so they are BIT-EQUAL by construction (pinned by ``tests/test_overlap``
 over a schedule × mesh × dtype grid), and both remain ONE dispatch — the
 pipeline lives inside the kernel's existing jitted ``shard_map``.
 
+:func:`host_pipeline` is the same discipline for the fit drivers' HOST
+loops (dispatch → blocking read per step): issue step t+1's async device
+work before blocking on step t, one extra step in flight, bit-equal
+orders.
+
 Routing (``DSLIB_OVERLAP``, the ``DSLIB_MATMUL_ALGO`` pattern): ``db``
 (default) = double-buffered, ``seq`` = sequential-phase, ``pallas`` =
 double-buffered with the hot inner compute (SUMMA's panel GEMM, the ring
@@ -81,17 +86,24 @@ def resolve(explicit=None) -> str:
     return key
 
 
-_PALLAS_WARNED = False
+# pallas-degradation dedupe registry (the ``__warningregistry__`` shape:
+# one key per distinct warning).  Every dispatch site funnels through
+# :func:`resolve` with a DIFFERENT caller frame, so stacklevel-keyed
+# registry entries — or no dedupe at all — would fire once per site per
+# filter reset; this module-owned registry makes it exactly once per
+# process, independent of the active warning filters.  Tests clear it to
+# re-observe the warning (pinned in tests/test_overlap).
+_WARN_REGISTRY: dict = {}
 
 
 def _warn_pallas_unavailable():
-    global _PALLAS_WARNED
-    if not _PALLAS_WARNED:
-        warnings.warn(
-            "DSLIB_OVERLAP=pallas requested but the backend can't run the "
-            "Pallas kernels — falling back to the double-buffered XLA "
-            "schedule ('db')", RuntimeWarning, stacklevel=3)
-        _PALLAS_WARNED = True
+    if "pallas_unavailable" in _WARN_REGISTRY:
+        return
+    _WARN_REGISTRY["pallas_unavailable"] = 1
+    warnings.warn(
+        "DSLIB_OVERLAP=pallas requested but the backend can't run the "
+        "Pallas kernels — falling back to the double-buffered XLA "
+        "schedule ('db')", RuntimeWarning, stacklevel=3)
 
 
 def overlapped(schedule: str) -> bool:
@@ -147,3 +159,38 @@ def panel_pipeline(steps, pan0, fetch, consume, acc0, overlap):
         return acc, pan
     acc, _ = lax.fori_loop(1, steps, body, (acc, pan0))
     return acc
+
+
+def host_pipeline(steps, fetch, consume, overlap=True):
+    """:func:`panel_pipeline`'s discipline lifted to HOST loops — the fit
+    drivers' dispatch→read sequences (the CSVM cascade's per-level node
+    batches, the forest's per-level snapshot/adoption fetches), where the
+    "collective" is an async device dispatch or device→host copy and the
+    "compute" is the blocking host read.
+
+    ``fetch(t)`` ISSUES step t's async work (a jitted dispatch, a
+    ``copy_to_host_async``) and returns its handle without blocking;
+    ``consume(t, handle)`` blocks on the handle and returns the step's
+    host result.  ``overlap=True`` issues fetch(t+1) before consume(t) —
+    step t's blocking read runs under step t+1's device work, with
+    exactly ONE extra step in flight (panel_pipeline's carry discipline,
+    so the memory gate transfers unchanged).  ``overlap=False`` is the
+    strict fetch-then-consume chain.  Both orders evaluate the same
+    ``consume(t, fetch(t))`` pairs in the same order, so the schedules
+    are bit-equal by construction.  Returns ``[consume(0, ...), ...,
+    consume(steps-1, ...)]``."""
+    steps = int(steps)
+    out = []
+    if steps <= 0:
+        return out
+    if overlap:
+        pending = fetch(0)
+        for t in range(1, steps):
+            nxt = fetch(t)                 # issue step t (async) ...
+            out.append(consume(t - 1, pending))   # ... under t-1's read
+            pending = nxt
+        out.append(consume(steps - 1, pending))   # epilogue drain
+        return out
+    for t in range(steps):
+        out.append(consume(t, fetch(t)))
+    return out

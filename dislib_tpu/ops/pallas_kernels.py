@@ -144,3 +144,84 @@ def distances_sq(a, b, precision=None):
         out_shape=jax.ShapeDtypeStruct((m, kf), out_dt),
         interpret=_interpret(),
     )(a, b)
+
+
+def node_histogram(node, bx, contrib, n_nodes, n_bins, policy=px.FLOAT32):
+    """The tree level's (node, feature, bin) weighted histogram as a
+    row-tiled Pallas kernel — the forest fit's scatter-shaped hot loop
+    (``trees/decision_tree._node_histogram``) re-expressed as an MXU
+    contraction: per feature, the (node, bin) scatter index one-hot
+    encodes into a (rows, n_nodes·n_bins) matrix whose transpose-GEMM
+    against the per-sample stats IS the histogram.  XLA schedules the
+    scatter as a serialized loop; the one-hot GEMM is dense MXU work
+    with a (feature, row-tile) grid, the output block revisited across
+    row tiles (zero-init at tile 0) so each feature's histogram
+    accumulates in-register.
+
+    ``node`` (m,) int32, ``bx`` (m, n) int32 bin ids, ``contrib``
+    (m, S) per-sample weighted stats (w·stats — computed by the caller
+    so the kernel stays a pure contraction).  Returns (n_nodes, n,
+    n_bins, S) at the policy accumulation dtype promoted with the
+    contribution dtype — the plain path's f32, f64 for x64-mode f64
+    stats.  With integer-representable contributions (Poisson-weight ×
+    count stats — the forest's actual regime) the sums are exact, so
+    this route is BIT-equal to the XLA scatter, not merely allclose."""
+    from jax.experimental import pallas as pl
+
+    contrib = px.to_compute(contrib, policy)
+    acc_dt = jnp.promote_types(px.accum_dtype(policy), contrib.dtype)
+    m, n = bx.shape
+    s = contrib.shape[1]
+    nb = int(n_nodes) * int(n_bins)
+    bm = _row_block(m)
+
+    def kern(n_ref, b_ref, c_ref, o_ref):
+        i = pl.program_id(1)
+
+        @pl.when(i == 0)
+        def _init():
+            o_ref[...] = jnp.zeros(o_ref.shape, o_ref.dtype)
+
+        idx = n_ref[:] * n_bins + b_ref[:, 0]               # (bm,)
+        onehot = (idx[:, None] == jax.lax.broadcasted_iota(
+            jnp.int32, (bm, nb), 1)).astype(acc_dt)
+        o_ref[0, :, :] += jnp.dot(onehot.T, c_ref[:, :],
+                                  preferred_element_type=acc_dt,
+                                  precision=policy.dot_precision)
+
+    out = pl.pallas_call(
+        kern,
+        grid=(n, m // bm),
+        in_specs=[pl.BlockSpec((bm,), lambda f, i: (i,)),
+                  pl.BlockSpec((bm, 1), lambda f, i: (i, f)),
+                  pl.BlockSpec((bm, s), lambda f, i: (i, 0))],
+        out_specs=pl.BlockSpec((1, nb, s), lambda f, i: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, nb, s), acc_dt),
+        interpret=_interpret(),
+    )(node, bx, contrib)
+    # (n, n_nodes·n_bins, S) → the scatter path's (n_nodes, n, n_bins, S)
+    return out.reshape(n, n_nodes, n_bins, s).transpose(1, 0, 2, 3)
+
+
+_HIST_AVAILABLE: bool | None = None
+
+
+def hist_available() -> bool:
+    """Cached probe for the histogram kernel specifically: its grid /
+    block shapes (tiny lane dims, 1-D blocks) stress different Mosaic
+    paths than :func:`panel_gemm`, so the forest router probes THIS
+    kernel before trusting it — a failure degrades the fit to the XLA
+    scatter, never to a crash mid-growth."""
+    global _HIST_AVAILABLE
+    if _HIST_AVAILABLE is None:
+        try:
+            import numpy as np
+            node = jnp.asarray([0, 0, 1, 1, 1, 0, 1, 0], jnp.int32)
+            bx = jnp.asarray(np.arange(8, dtype=np.int32)[:, None] % 2)
+            contrib = jnp.ones((8, 1), px.compute_dtype(px.FLOAT32))
+            out = np.asarray(node_histogram(node, bx, contrib, 2, 2))
+            _HIST_AVAILABLE = bool(out.shape == (2, 1, 2, 1)
+                                   and abs(float(out.sum()) - 8.0) < 1e-6)
+        except Exception:  # noqa: BLE001 — any failure means "not here"
+            _HIST_AVAILABLE = False
+    return _HIST_AVAILABLE

@@ -742,8 +742,11 @@ def reshard_sparse(rep, dst_mesh, schedule="auto", nse=None, overlap=None):
                                        rep.counts_dev, plan["nse2"],
                                        dst_mesh)
         counts_dev = rep.counts_dev if dst_mesh is rep.mesh else None
+        # `cols_host` rides along unchanged: relayout permutes entries
+        # between shards but never reorders the global row-sorted stream
         return ShardedSparse(d, lr, cc, counts_dev, rep.counts,
-                             rep.row_nnz, rep.shape, dst_mesh)
+                             rep.row_nnz, rep.shape, dst_mesh,
+                             cols_host=rep.cols_host)
     if sched == "panels":
         if not set(dst_mesh.devices.flat) <= set(rep.mesh.devices.flat):
             raise ValueError(
@@ -761,7 +764,8 @@ def reshard_sparse(rep, dst_mesh, schedule="auto", nse=None, overlap=None):
     return ShardedSparse(
         jax.device_put(d, sh1), jax.device_put(lr, sh1),
         jax.device_put(cc, sh1), None,
-        plan["cnt_dst"], rep.row_nnz, rep.shape, dst_mesh)
+        plan["cnt_dst"], rep.row_nnz, rep.shape, dst_mesh,
+        cols_host=rep.cols_host)
 
 
 def _sparse_index_map(plan, nse1):
@@ -850,7 +854,8 @@ def _sparse_panels_run(rep, dst_mesh, plan, overlap=None):
 
     d, lr, cc = (rewrap(a) for a in outs)
     return ShardedSparse(d, lr, cc, None, plan["cnt_dst"],
-                         rep.row_nnz, rep.shape, dst_mesh)
+                         rep.row_nnz, rep.shape, dst_mesh,
+                         cols_host=rep.cols_host)
 
 
 @partial(_pjit, static_argnames=("src_mesh", "tr_key", "e0_src", "e0_dst",

@@ -39,6 +39,8 @@ from jax import lax
 
 from dislib_tpu.base import BaseEstimator
 from dislib_tpu.data.array import Array, _repad
+from dislib_tpu.ops import precision as px
+from dislib_tpu.utils import profiling as _prof
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
 from dislib_tpu.runtime import fetch as _fetch, repad_rows as _repad_rows
 from dislib_tpu.runtime import fitloop as _fitloop
@@ -82,14 +84,26 @@ def _bin_data(xp, shape, edges):
     return jnp.sum(xv[:, :, None] > edges[None, :, :], axis=2).astype(jnp.int32)
 
 
-def _node_histogram(node, bx, w, stats, n_nodes, n_bins):
-    """Scatter-add per-sample `stats` (m, S) into (n_nodes, n, n_bins, S)."""
+def _node_histogram(node, bx, w, stats, n_nodes, n_bins, hist="xla"):
+    """Per-sample `stats` (m, S) histogrammed into (n_nodes, n, n_bins,
+    S).  ``hist`` is the schedule (a jit static resolved ONCE at the
+    forest-fit boundary, `hist:<sched>` counter): "xla" is the plain
+    scatter-add; "pallas" routes the one-hot-GEMM Pallas kernel
+    (``ops/pallas_kernels.node_histogram``) — bit-equal here because the
+    forest's contributions (Poisson weights × count/target stats) are
+    integer-representable, so the sums are exact under either order."""
+    if hist == "pallas":
+        from dislib_tpu.ops import pallas_kernels as _pk
+        return _pk.node_histogram(node, bx, w[:, None] * stats,
+                                  n_nodes, n_bins).astype(
+            px.compute_dtype(px.FLOAT32))
     m, n = bx.shape
+    acc_dt = px.compute_dtype(px.FLOAT32)
     feat = lax.broadcasted_iota(jnp.int32, (m, n), 1)
-    hist = jnp.zeros((n_nodes, n, n_bins, stats.shape[1]), jnp.float32)
-    contrib = (w[:, None, None] * stats[:, None, :])          # (m, 1|n? , S)
+    hist_acc = jnp.zeros((n_nodes, n, n_bins, stats.shape[1]), acc_dt)
+    contrib = (w[:, None, None] * stats[:, None, :]).astype(acc_dt)
     contrib = jnp.broadcast_to(contrib, (m, n, stats.shape[1]))
-    return hist.at[node[:, None], feat, bx].add(contrib)
+    return hist_acc.at[node[:, None], feat, bx].add(contrib)
 
 
 def _gain_and_split(hist, criterion):
@@ -133,10 +147,10 @@ def _mask_features(gain, key, try_features):
 
 
 def _level_step(node, bx, w, stats, key, n_nodes, try_features, min_gain,
-                criterion, n_bins):
+                criterion, n_bins, hist="xla"):
     """Grow one level of one tree. Returns (feat, thr_bin, is_split, new_node,
     node_totals)."""
-    hist = _node_histogram(node, bx, w, stats, n_nodes, n_bins)
+    hist = _node_histogram(node, bx, w, stats, n_nodes, n_bins, hist=hist)
     gain, totals = _gain_and_split(hist, criterion)
     gain = _mask_features(gain, key, try_features)
     flat = gain.reshape(n_nodes, -1)
@@ -164,12 +178,13 @@ def _level_step(node, bx, w, stats, key, n_nodes, try_features, min_gain,
 # `node` to the output each level and never touches the old buffer (snapshot
 # fetches read the NEW node, blocking, before the next level dispatches).
 @partial(_pjit, static_argnames=("n_nodes", "try_features", "criterion",
-                                 "n_bins"),
+                                 "n_bins", "hist"),
          donate_argnames=("node",), name="forest_level")
 def _forest_level(node, bx, w, stats, keys, n_nodes, try_features,
-                  min_gain, criterion, n_bins):
+                  min_gain, criterion, n_bins, hist="xla"):
     step = partial(_level_step, n_nodes=n_nodes, try_features=try_features,
-                   min_gain=min_gain, criterion=criterion, n_bins=n_bins)
+                   min_gain=min_gain, criterion=criterion, n_bins=n_bins,
+                   hist=hist)
     feat, tbin, is_split, new_node, totals = \
         jax.vmap(step, in_axes=(0, None, 0, None, 0))(
             node, bx, w, stats, keys)
@@ -298,6 +313,16 @@ class _BaseTreeEnsemble(BaseEstimator):
 
         n_bins = self._n_bins()
         try_features = self._try_features_count(n)
+        # histogram schedule: resolved ONCE here (the fit boundary — the
+        # spmm/summa routing precedent, so a DSLIB_OVERLAP flip retraces
+        # and the run is `hist:<sched>` counter-observable).  "pallas"
+        # needs the hist-specific probe on top of the router's: a Mosaic
+        # rejection of THIS kernel's shapes degrades to the XLA scatter.
+        from dislib_tpu.ops import overlap as _ov
+        from dislib_tpu.ops import pallas_kernels as _pk
+        hist_sched = "pallas" if (_ov.resolve(None) == "pallas"
+                                  and _pk.hist_available()) else "xla"
+        _prof.count_schedule("hist", hist_sched)
         box = {"feats": [], "tbins": [], "x": x}
 
         def _stage():
@@ -312,7 +337,8 @@ class _BaseTreeEnsemble(BaseEstimator):
             box["edges"] = _quantile_bins(xd, (m, n), n_bins)
             box["bx"] = _bin_data(xd, (m, n), box["edges"])
             box["mp"] = mp
-            box["valid"] = (np.arange(mp) < m).astype(np.float32)
+            box["valid"] = (np.arange(mp) < m).astype(
+                px.compute_dtype(px.FLOAT32))
             sh = np.asarray(stats_host)
             if sh.shape[0] != mp:       # host re-pad: pad rows carry w=0
                 out = np.zeros((mp, sh.shape[1]), sh.dtype)
@@ -360,8 +386,8 @@ class _BaseTreeEnsemble(BaseEstimator):
             box["feats"], box["tbins"] = [], []
             mp = box["mp"]
             if bootstrap:
-                w = jax.random.poisson(k_boot, 1.0,
-                                       (n_trees, mp)).astype(jnp.float32)
+                w = jax.random.poisson(k_boot, 1.0, (n_trees, mp)).astype(
+                    px.compute_dtype(px.FLOAT32))
             else:
                 w = jnp.ones((n_trees, mp), jnp.float32)
             w = w * jnp.asarray(box["valid"])[None, :]
@@ -402,7 +428,8 @@ class _BaseTreeEnsemble(BaseEstimator):
             (w,) = st.carries
             feat, tbin, is_split, node, _, hvec = _forest_level(
                 st.extra, box["bx"], w, box["stats"], keys, 2 ** st.it,
-                try_features, 0.0, self._criterion, n_bins)
+                try_features, 0.0, self._criterion, n_bins,
+                hist=hist_sched)
             box["feats"].append(feat)
             box["tbins"].append(tbin)
             nxt = st.it + 1
@@ -417,9 +444,26 @@ class _BaseTreeEnsemble(BaseEstimator):
             state = {"lvl": st.it, "seed": box["seed"], "fp": fp,
                      "digest": digest, "node": _fetch(st.extra),
                      "w": _fetch(st.carries[0])}
-            for i, (f_, t_) in enumerate(zip(box["feats"], box["tbins"])):
-                state[f"feats_{i}"] = _fetch(f_)
-                state[f"tbins_{i}"] = _fetch(t_)
+            # the per-level feats/tbins drain through the shared host-loop
+            # pipeline: level i's blocking fetch runs under level i+1's
+            # device→host DMA (db/seq bit-equal by construction, routed +
+            # counter-observable like every overlap site)
+            sched = _ov.resolve()
+            _prof.count_schedule("forest_snapshot", sched)
+            pairs = list(zip(box["feats"], box["tbins"]))
+
+            def issue(i):
+                for buf in pairs[i]:
+                    if hasattr(buf, "copy_to_host_async"):
+                        buf.copy_to_host_async()
+                return pairs[i]
+
+            def drain(i, pair):
+                state[f"feats_{i}"] = _fetch(pair[0])
+                state[f"tbins_{i}"] = _fetch(pair[1])
+
+            _ov.host_pipeline(len(pairs), issue, drain,
+                              overlap=_ov.overlapped(sched))
             return state
 
         st = loop.run(init=init, step=step, restore=restore,
@@ -468,7 +512,21 @@ class _BaseTreeEnsemble(BaseEstimator):
         wide = 2 ** (grown["depth"] - 1)
 
         def _pack(levels):
-            host = [np.asarray(jax.device_get(a)) for a in levels]
+            # adoption's per-level reads pipeline like the snapshot loop:
+            # level i's host landing overlaps level i+1's device→host DMA
+            from dislib_tpu.ops import overlap as _ov
+            sched = _ov.resolve()
+            _prof.count_schedule("forest_adopt", sched)
+
+            def issue(i):
+                if hasattr(levels[i], "copy_to_host_async"):
+                    levels[i].copy_to_host_async()
+                return levels[i]
+
+            host = _ov.host_pipeline(
+                len(levels), issue,
+                lambda i, a: np.asarray(jax.device_get(a)),
+                overlap=_ov.overlapped(sched))
             return np.stack([np.pad(a, ((0, 0), (0, wide - a.shape[1])))
                              for a in host], axis=1)
 

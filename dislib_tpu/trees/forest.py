@@ -15,6 +15,7 @@ from jax import lax
 
 from dislib_tpu.data.array import (Array, _padded_dim, _place_region,
                                    ensure_canonical, fused_kernel)
+from dislib_tpu.ops import precision as px
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.trees.decision_tree import (_BaseTreeEnsemble,
                                             _forest_apply, _forest_apply_core,
@@ -119,7 +120,8 @@ class _RegressorMixin:
         cached = getattr(y, "_tree_enc_cache", None)
         if cached is not None and cached[0] == ("reg", mp):
             return cached[1]
-        y_host = np.asarray(y.collect()).ravel().astype(np.float32)
+        y_host = np.asarray(y.collect()).ravel().astype(
+            px.compute_dtype(px.FLOAT32))
         stats = np.zeros((mp, 3), np.float32)               # [w, wy, wy²] basis
         stats[: len(y_host), 0] = 1.0
         stats[: len(y_host), 1] = y_host

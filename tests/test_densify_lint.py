@@ -107,3 +107,21 @@ def test_sparse_fit_and_serve_paths_scanned():
               "dislib_tpu/serving/sparse.py",
               "dislib_tpu/cluster/kmeans.py"):
         assert f in scanned, f"{f} escaped the densify lint"
+
+
+def test_device_staging_never_densifies():
+    """The round-17 device staging views (ELL, row steps, the
+    col-partitioned panel view) exist precisely so sparse fit entry is
+    O(nnz) on device — none of them may densify or detour through the
+    host triplet path.  data/sparse.py sits outside SCANNED_DIRS (it
+    legitimately DEFINES to_dense), so the staging methods are pinned
+    here by name."""
+    path = os.path.join(REPO, "dislib_tpu/data/sparse.py")
+    staging = {"ell", "ell_buffers", "row_steps", "row_step_buffers",
+               "row_step_plan", "panel_view", "panel_counts",
+               "_cols_stream"}
+    hits = [f"{fname}:{lineno} .{attr}()"
+            for fname, lineno, attr in _densify_calls(path)
+            if fname in staging]
+    assert not hits, ("sparse staging densified an operand:\n  "
+                      + "\n  ".join(hits))

@@ -66,7 +66,9 @@ ALLOWLIST = {
     ("dislib_tpu/classification/csvm.py", "step"),
     ("dislib_tpu/classification/csvm.py", "_merge_level"),
     ("dislib_tpu/classification/csvm.py", "k_of"),
-    ("dislib_tpu/classification/csvm.py", "_solve_level_batched"),
+    # (_solve_level_batched left the list in round-17: its batch loop now
+    # pipelines through ops/overlap.host_pipeline — the blocking reads
+    # live in the shared discipline, not in an estimator-file loop.)
     # async-trial grid search: block_until_ready/float AFTER every trial
     # of a fold is dispatched — the protocol's single collection point
     ("dislib_tpu/model_selection/search.py", "_block_tree"),

@@ -68,12 +68,27 @@ class TestRouter:
     def test_pallas_degrades_to_db_when_unavailable(self, monkeypatch):
         from dislib_tpu.ops import pallas_kernels as _pk
         monkeypatch.setattr(_pk, "_AVAILABLE", False)
-        monkeypatch.setattr(_ov, "_PALLAS_WARNED", False)
+        monkeypatch.setattr(_ov, "_WARN_REGISTRY", {})
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
             assert _ov.resolve("pallas") == "db"
         assert any("falling back" in str(x.message) for x in w), \
             "the pallas→db degrade must warn (sequential stays explicit)"
+
+    def test_pallas_degrade_warns_once_per_process(self, monkeypatch):
+        """The degradation warning dedupes through the module registry:
+        many dispatch sites resolve the schedule (spmm, forest, rechunk,
+        the ring tiers), and even under an ``always`` warning filter the
+        process must see the degrade exactly ONCE, not once per site."""
+        from dislib_tpu.ops import pallas_kernels as _pk
+        monkeypatch.setattr(_pk, "_AVAILABLE", False)
+        monkeypatch.setattr(_ov, "_WARN_REGISTRY", {})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for _ in range(4):              # four "dispatch sites"
+                assert _ov.resolve("pallas") == "db"
+        hits = [x for x in w if "falling back" in str(x.message)]
+        assert len(hits) == 1, f"expected one degrade warning, got {len(hits)}"
 
     def test_public_observability_entry(self, monkeypatch):
         monkeypatch.delenv("DSLIB_OVERLAP", raising=False)
@@ -117,6 +132,113 @@ class TestPanelPipeline:
         for ov in (False, True):
             out = _ov.panel_pipeline(0, None, None, None, acc0, ov)
             assert out is acc0
+
+
+# ---------------------------------------------------------------------------
+# 2b. the host-loop pipeline (round 17: panel_pipeline's discipline
+#     lifted to the fit drivers' dispatch→read sequences)
+# ---------------------------------------------------------------------------
+
+class TestHostPipeline:
+    @pytest.mark.parametrize("steps", [0, 1, 2, 5])
+    def test_same_pairs_same_order_both_schedules(self, steps):
+        logs = {}
+        for ov in (False, True):
+            calls = []
+
+            def fetch(t):
+                calls.append(("fetch", t))
+                return t * 10
+
+            def consume(t, h):
+                calls.append(("consume", t))
+                assert h == t * 10, "handle paired with the wrong step"
+                return h + t
+
+            out = _ov.host_pipeline(steps, fetch, consume, overlap=ov)
+            assert out == [t * 11 for t in range(steps)]
+            logs[ov] = calls
+        # both schedules evaluate the same consume(t, fetch(t)) pairs in
+        # the same consume order (bit-equal by construction); what the
+        # pipelined order changes is ONLY the issue point — fetch(t+1)
+        # lands before consume(t), where the strict chain interleaves
+        consumed = [c for c in logs[True] if c[0] == "consume"]
+        assert consumed == [c for c in logs[False] if c[0] == "consume"]
+        if steps >= 2:
+            assert logs[True].index(("fetch", 1)) \
+                < logs[True].index(("consume", 0))
+            assert logs[False].index(("consume", 0)) \
+                < logs[False].index(("fetch", 1))
+
+    def test_exactly_one_extra_step_in_flight(self):
+        for ov, want_peak in ((False, 1), (True, 2)):
+            live = {"now": 0, "peak": 0}
+
+            def fetch(t):
+                live["now"] += 1
+                live["peak"] = max(live["peak"], live["now"])
+                return t
+
+            def consume(t, h):
+                live["now"] -= 1
+                return h
+
+            _ov.host_pipeline(6, fetch, consume, overlap=ov)
+            assert live["now"] == 0, "a step was never drained"
+            assert live["peak"] == want_peak, \
+                (ov, live["peak"], "pipelined carry must hold exactly ONE "
+                                   "extra in-flight step")
+
+    def test_csvm_batched_level_routed_and_counted(self, monkeypatch):
+        """A partition cap + tiny solve budget force the CSVM level solve
+        into multiple batches — the batch loop must pipeline through the
+        host-loop router (counter-observable) and both schedules must
+        pick the same support vectors."""
+        import scipy.sparse as sp
+        from dislib_tpu.classification import CascadeSVM
+        from dislib_tpu.data.sparse import SparseArray
+        rs = np.random.RandomState(7)
+        m_sp = sp.random(200, 24, density=0.08, format="coo",
+                         random_state=rs, dtype=np.float32)
+        row_sum = np.asarray(m_sp.sum(axis=1)).ravel()
+        y = ds.array((row_sum > np.median(row_sum))
+                     .astype(np.float32).reshape(-1, 1))
+        monkeypatch.setenv("DSLIB_CSVM_MAX_PARTITION", "64")
+        monkeypatch.setenv("DSLIB_CSVM_SOLVE_BUDGET", str(1 << 16))
+        svs = {}
+        for sched in ("db", "seq"):
+            monkeypatch.setenv("DSLIB_OVERLAP", sched)
+            _prof.reset_counters()
+            est = CascadeSVM(cascade_arity=2, max_iter=2, c=1.0,
+                             gamma=0.1).fit(SparseArray.from_scipy(m_sp), y)
+            sc = _prof.schedule_counters()
+            assert sc.get(f"csvm_batches:{sched}", 0) >= 1, (sched, sc)
+            svs[sched] = np.sort(np.asarray(est._sv_idx))
+        np.testing.assert_array_equal(svs["db"], svs["seq"])
+
+    def test_forest_snapshot_and_adopt_routed_and_counted(
+            self, tmp_path, monkeypatch, rng):
+        """A checkpointed forest fit drains its per-level snapshot fetches
+        and the adoption reads through the host-loop router — both sites
+        counter-observable, predictions bit-equal across schedules."""
+        from dislib_tpu.trees import RandomForestClassifier
+        from dislib_tpu.utils.checkpoint import FitCheckpoint
+        x = rng.rand(200, 4).astype(np.float32)
+        y = (x[:, 0] > 0.5).astype(np.float32).reshape(-1, 1)
+        probs = {}
+        for sched in ("db", "seq"):
+            monkeypatch.setenv("DSLIB_OVERLAP", sched)
+            _prof.reset_counters()
+            f = RandomForestClassifier(n_estimators=2, random_state=0).fit(
+                ds.array(x), ds.array(y),
+                checkpoint=FitCheckpoint(
+                    str(tmp_path / f"ck_{sched}"), every=1))
+            probs[sched] = np.asarray(
+                f.predict_proba(ds.array(x)).collect())
+            sc = _prof.schedule_counters()
+            assert sc.get(f"forest_snapshot:{sched}", 0) >= 1, (sched, sc)
+            assert sc.get(f"forest_adopt:{sched}", 0) >= 1, (sched, sc)
+        np.testing.assert_array_equal(probs["db"], probs["seq"])
 
 
 # ---------------------------------------------------------------------------

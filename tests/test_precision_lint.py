@@ -36,6 +36,10 @@ KERNEL_DIRS = (
     # fold-in peinsum/pdot, the SpMM gather/segment contraction) — its
     # homes may not hardcode compute dtypes either
     "dislib_tpu/recommendation",
+    # round-17: the forest's histogram loop became a routed kernel (XLA
+    # scatter / Pallas one-hot GEMM) — its home must route every compute
+    # dtype through ops/precision like the other kernel tiers
+    "dislib_tpu/trees",
 )
 
 # single FILES scanned alongside the dirs (their siblings are host

@@ -2,6 +2,8 @@
 test_decision_tree.py — SURVEY.md §5 oracle pattern: accuracy/R² vs sklearn
 on the same data)."""
 
+import warnings
+
 import numpy as np
 import pytest
 
@@ -186,3 +188,125 @@ class TestNBinsContract:
             RandomForestClassifier(n_estimators=2, random_state=0).fit(
                 ds.array(x), ds.array(y[:, None]),
                 checkpoint=FitCheckpoint(path, every=1))
+
+
+# ---------------------------------------------------------------------------
+# round-17 Pallas tier two: the level histogram as a one-hot GEMM
+# ---------------------------------------------------------------------------
+
+class TestHistogramKernel:
+    """The forest's (node, feature, bin) scatter-add re-expressed as a
+    Pallas one-hot GEMM must be BIT-equal to the XLA scatter (the
+    forest's contributions — Poisson weights × count/target stats — are
+    integer-representable, so both summation orders are exact), routed
+    once at the fit boundary, and counter-observable."""
+
+    def _inputs(self, rng, m, n, n_nodes, n_bins, s, dtype=np.float32):
+        node = rng.randint(0, n_nodes, m).astype(np.int32)
+        bx = rng.randint(0, n_bins, (m, n)).astype(np.int32)
+        w = rng.poisson(1.0, m).astype(dtype)
+        stats = rng.randint(0, 3, (m, s)).astype(dtype)
+        return node, bx, w, stats
+
+    @pytest.mark.parametrize("shape", [(64, 3, 2, 4, 2),
+                                       (128, 5, 4, 8, 3),
+                                       (200, 2, 8, 32, 1)])
+    def test_pallas_bit_equal_to_xla_scatter(self, rng, shape):
+        import jax.numpy as jnp
+        from dislib_tpu.ops import pallas_kernels as _pk
+        from dislib_tpu.trees.decision_tree import _node_histogram
+        if not _pk.hist_available():
+            pytest.skip("pallas histogram kernel unavailable")
+        m, n, n_nodes, n_bins, s = shape
+        node, bx, w, stats = self._inputs(rng, m, n, n_nodes, n_bins, s)
+        outs = {}
+        for sched in ("xla", "pallas"):
+            outs[sched] = np.asarray(_node_histogram(
+                jnp.asarray(node), jnp.asarray(bx), jnp.asarray(w),
+                jnp.asarray(stats), n_nodes, n_bins, hist=sched))
+        assert outs["xla"].dtype == outs["pallas"].dtype
+        np.testing.assert_array_equal(outs["xla"], outs["pallas"])
+        # and the histogram is the histogram: a plain numpy scatter oracle
+        want = np.zeros((n_nodes, n, n_bins, s), np.float32)
+        for i in range(m):
+            for f in range(n):
+                want[node[i], f, bx[i, f]] += w[i] * stats[i]
+        np.testing.assert_array_equal(outs["xla"], want)
+
+    def test_bit_equal_f64_x64_mode(self, rng):
+        import jax
+        import jax.numpy as jnp
+        from dislib_tpu.ops import pallas_kernels as _pk
+        from dislib_tpu.trees.decision_tree import _node_histogram
+        if not _pk.hist_available():
+            pytest.skip("pallas histogram kernel unavailable")
+        with jax.enable_x64(True):
+            node, bx, w, stats = self._inputs(rng, 96, 3, 4, 8, 2,
+                                              dtype=np.float64)
+            a = np.asarray(_node_histogram(
+                jnp.asarray(node), jnp.asarray(bx), jnp.asarray(w),
+                jnp.asarray(stats), 4, 8, hist="xla"))
+            b = np.asarray(_node_histogram(
+                jnp.asarray(node), jnp.asarray(bx), jnp.asarray(w),
+                jnp.asarray(stats), 4, 8, hist="pallas"))
+            assert a.dtype == b.dtype
+            np.testing.assert_array_equal(a, b)
+
+    def test_schedule_routed_counted_and_forest_bit_equal(self, rng,
+                                                          monkeypatch):
+        """DSLIB_OVERLAP resolves the histogram schedule ONCE at the fit
+        boundary (`hist:<sched>` counter), and the FITTED forests agree
+        bit-for-bit across schedules — same splits, same probabilities."""
+        from dislib_tpu.ops import pallas_kernels as _pk
+        from dislib_tpu.utils import profiling as prof
+        if not _pk.hist_available():
+            pytest.skip("pallas histogram kernel unavailable")
+        x, y = _class_data(rng, n=120, d=4, k=2)
+        proba = {}
+        for env, sched in (("db", "xla"), ("pallas", "pallas")):
+            monkeypatch.setenv("DSLIB_OVERLAP", env)
+            prof.reset_counters()
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")   # pallas warns off-TPU
+                rf = RandomForestClassifier(n_estimators=4, random_state=0)
+                rf.fit(ds.array(x), ds.array(y[:, None]))
+                assert prof.schedule_counters().get(f"hist:{sched}", 0) >= 1
+                proba[sched] = np.asarray(
+                    rf.predict_proba(ds.array(x)).collect())
+        assert (proba["xla"] == proba["pallas"]).all()
+
+    def test_degrades_to_xla_when_hist_probe_fails(self, rng, monkeypatch):
+        """A Mosaic rejection of THIS kernel's shapes degrades the fit to
+        the XLA scatter — never a crash mid-growth."""
+        from dislib_tpu.ops import pallas_kernels as _pk
+        from dislib_tpu.utils import profiling as prof
+        monkeypatch.setenv("DSLIB_OVERLAP", "pallas")
+        monkeypatch.setattr(_pk, "_HIST_AVAILABLE", False)
+        x, y = _class_data(rng, n=90, d=3, k=2)
+        prof.reset_counters()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            rf = RandomForestClassifier(n_estimators=3, random_state=0)
+            rf.fit(ds.array(x), ds.array(y[:, None]))
+        sc = prof.schedule_counters()
+        assert sc.get("hist:xla", 0) >= 1 and "hist:pallas" not in sc
+        assert rf.score(ds.array(x), ds.array(y[:, None])) >= 0.85
+
+    def test_warm_refit_traces_nothing_new(self, rng, monkeypatch):
+        """The routed kernel is a jit STATIC resolved at the fit
+        boundary: a second same-shape fit under the pallas route compiles
+        zero new programs (the zero-new-hot-path-traces acceptance)."""
+        from dislib_tpu.ops import pallas_kernels as _pk
+        from dislib_tpu.utils import profiling as prof
+        if not _pk.hist_available():
+            pytest.skip("pallas histogram kernel unavailable")
+        monkeypatch.setenv("DSLIB_OVERLAP", "pallas")
+        x, y = _class_data(rng, n=120, d=4, k=2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            RandomForestClassifier(n_estimators=4, random_state=0).fit(
+                ds.array(x), ds.array(y[:, None]))      # warm
+            prof.reset_counters()
+            RandomForestClassifier(n_estimators=4, random_state=0).fit(
+                ds.array(x), ds.array(y[:, None]))
+        assert prof.trace_count() == 0

@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# One-command chip capture (round-17 satellite, ROADMAP bench item): run
+# the FULL-config bench tiers on the real TPU and emit BENCH_r06.json in
+# the same wrapper shape as the existing BENCH_r0*.json artifacts
+# ({n, cmd, rc, tail, parsed}), plus a "rows" list with every parsed
+# metric row — so the long-owed chip refresh (stale since PR 5) is a
+# single command on real hardware.
+#
+# Usage:  tools/bench_chip.sh [OUT_JSON] [ROUND_N]
+#         OUT_JSON defaults to BENCH_r06.json, ROUND_N to the digits in
+#         OUT_JSON's name.
+#
+# Must run on a rig with the TPU visible (bench.py's device probe aborts
+# fast on a dead tunnel and replays the latest local capture as an
+# explicit stale carryover — rc stays non-zero, so this script will NOT
+# overwrite a previous fresh artifact with carryover rows).
+set -u
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+OUT="${1:-BENCH_r06.json}"
+N="${2:-$(basename "$OUT" | tr -cd '0-9' | sed 's/^0*//')}"
+# same persistent compile cache bench.py's children use: repeat captures
+# skip the 20-40 s TPU compiles of unchanged configs
+export JAX_COMPILATION_CACHE_DIR="${JAX_COMPILATION_CACHE_DIR:-$PWD/.jax_cache}"
+LOG="$(mktemp)"
+CMD="python bench.py"
+# full mode: BENCH_SMOKE must NOT be set — guard against an inherited one
+unset BENCH_SMOKE
+echo "=== chip capture -> $OUT (round $N): $CMD ===" >&2
+$CMD 2> >(tail -40 >&2) | tee "$LOG"
+RC=$?
+python - "$LOG" "$OUT" "$N" "$CMD" "$RC" <<'EOF'
+import json
+import sys
+
+log, out, n, cmd, rc = sys.argv[1:6]
+rows = []
+with open(log) as f:
+    for line in f:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+tail = open(log).read()[-4000:]
+stale = [r["metric"] for r in rows if r.get("stale") and r.get("metric")]
+doc = {"n": int(n), "cmd": cmd, "rc": int(rc), "tail": tail,
+       "parsed": rows[-1] if rows else None, "rows": rows,
+       "fresh_rows": sum(1 for r in rows if r.get("fresh")),
+       "stale_rows": len(stale)}
+with open(out, "w") as f:
+    json.dump(doc, f, indent=1)
+    f.write("\n")
+print(f"wrote {out}: {len(rows)} rows "
+      f"({doc['fresh_rows']} fresh, {len(stale)} stale), rc={rc}",
+      file=sys.stderr)
+if stale:
+    print("WARNING: stale rows present (device probe fell back?) — this "
+          "artifact is NOT a fresh chip capture:", file=sys.stderr)
+    for m in stale[:10]:
+        print(f"  stale: {m}", file=sys.stderr)
+EOF
+rm -f "$LOG"
+exit "$RC"

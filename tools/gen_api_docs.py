@@ -32,7 +32,7 @@ SECTIONS = [
     ("Sharded sparse fast path", "dislib_tpu.data.sparse",
      ["ShardedSparse", "nse_quantum"]),
     ("Sparse matmul (masked-psum SpMM)", "dislib_tpu.ops.spmm",
-     ["spmm", "spmm_steps", "spmm_memory_analysis"]),
+     ["spmm", "spmm_steps", "spmm_memory_analysis", "spmm_masking_work"]),
     ("Blocked linear algebra", "dislib_tpu",
      ["matmul", "kron", "svd", "qr", "polar", "tsqr", "random_svd",
       "lanczos_svd"]),
@@ -40,9 +40,10 @@ SECTIONS = [
      ["Policy", "resolve", "to_compute", "f32", "pdot", "peinsum",
       "precise"]),
     ("Overlap schedules (comm–compute pipelining)", "dislib_tpu.ops.overlap",
-     ["resolve", "overlapped", "panel_pipeline"]),
+     ["resolve", "overlapped", "panel_pipeline", "host_pipeline"]),
     ("Pallas fallback kernels", "dislib_tpu.ops.pallas_kernels",
-     ["available", "panel_gemm", "distances_sq"]),
+     ["available", "panel_gemm", "distances_sq", "node_histogram",
+      "hist_available"]),
     ("Decomposition", "dislib_tpu", ["PCA"]),
     ("Clustering", "dislib_tpu.cluster",
      ["KMeans", "MiniBatchKMeans", "GaussianMixture", "DBSCAN", "Daura"]),
