@@ -2245,6 +2245,97 @@ def bench_knn(m_fit, n, mq, k, tag):
             "wall_s": round(t, 4)}
 
 
+def bench_ann(m, d, mq, k, nlist, nprobe, tag, kmeans_max_iter=2):
+    """Round-18 IVF-ANN retrieval tier vs the EXACT kneighbors ring at
+    the same scale on the same backend.  Gates: recall@k ≥
+    ``DSLIB_ANN_RECALL_MIN`` (0.95, tie-tolerant: a found id counts if
+    its true distance is within the k-th oracle distance + eps) and
+    speedup ≥ ``DSLIB_ANN_SPEEDUP_MIN`` (3×) over the exact ring scan,
+    with the warm search counter-asserted as ONE fused dispatch / 0
+    transfers / 0 traces.  QPS, p99, and pad waste are informational."""
+    import dislib_tpu as ds
+    from dislib_tpu.neighbors import NearestNeighbors
+    from dislib_tpu.retrieval import IVFIndex
+    from dislib_tpu.utils import profiling as prof
+
+    rng = np.random.RandomState(3)
+    # clustered catalog — the regime IVF exists for (uniform data has no
+    # list structure to exploit); blob count = nlist so the quantizer has
+    # a natural partition to find even at tiny max_iter
+    centers = rng.standard_normal((nlist, d)).astype(np.float32) * 4.0
+    x = (centers[rng.randint(0, nlist, m)]
+         + rng.standard_normal((m, d))).astype(np.float32)
+    q = (centers[rng.randint(0, nlist, mq)]
+         + rng.standard_normal((mq, d))).astype(np.float32)
+
+    # exact oracle (host, f64, query-chunked so the distance slab never
+    # materializes at mq×m) with the tie band
+    xf = x.astype(np.float64)
+    xsq = (xf ** 2).sum(1)
+    kth = np.empty(mq)
+    for s in range(0, mq, 256):
+        qc = q[s:s + 256].astype(np.float64)
+        d2c = (qc ** 2).sum(1)[:, None] - 2.0 * qc @ xf.T + xsq[None]
+        kth[s:s + 256] = np.partition(d2c, k - 1, axis=1)[:, k - 1]
+
+    ix = IVFIndex(n_lists=nlist, nprobe=nprobe,
+                  kmeans_max_iter=kmeans_max_iter, random_state=0).fit(x)
+    qa = ds.array(q)
+    _, idx = ix.search(qa, k=k, nprobe=nprobe)          # warmup/compile
+    found = np.asarray(idx.collect()).astype(np.int64)
+    d_found = ((q[:, None, :].astype(np.float64)
+                - xf[found]) ** 2).sum(-1)              # (mq, k) only
+    hit = (d_found <= kth[:, None] + 1e-4) & (found >= 0)
+    recall = float(hit.mean())
+    recall_min = float(os.environ.get("DSLIB_ANN_RECALL_MIN", "0.95"))
+    assert recall >= recall_min, (
+        f"ann recall@{k} {recall:.4f} < {recall_min} "
+        "(DSLIB_ANN_RECALL_MIN)")
+
+    # the one-dispatch contract on the warm hot path
+    prof.reset_counters()
+    dist, idx = ix.search(qa, k=k, nprobe=nprobe)
+    _sync(dist, idx)
+    c = prof.counters()
+    assert c["dispatch_by"].get("ivf_search") == 1, c["dispatch_by"]
+    assert c["transfers"] == 0 and c["traces"] == 0, c
+
+    nn = NearestNeighbors(n_neighbors=k).fit(
+        ds.array(x, block_size=(8192, d)))
+    de, ie = nn.kneighbors(qa)                          # warmup/compile
+    _sync(de, ie)
+
+    def run_exact():
+        dd, ii = nn.kneighbors(qa)
+        _sync(dd, ii)
+
+    def run_ann():
+        dd, ii = ix.search(qa, k=k, nprobe=nprobe)
+        _sync(dd, ii)
+
+    t_exact = _median_time(run_exact)
+    walls = []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        run_ann()
+        walls.append(time.perf_counter() - t0)
+    t_ann = float(np.median(walls))
+    speedup = t_exact / t_ann
+    speedup_min = float(os.environ.get("DSLIB_ANN_SPEEDUP_MIN", "3"))
+    assert speedup >= speedup_min, (
+        f"ann speedup {speedup:.2f}x < {speedup_min}x vs the exact ring "
+        f"(exact {t_exact:.4f}s, ann {t_ann:.4f}s; "
+        "DSLIB_ANN_SPEEDUP_MIN)")
+    return {"metric": f"ann_{tag}_k{k}_nprobe{nprobe}_queries_per_sec "
+                      "(baseline: exact kneighbors ring, same backend)",
+            "value": round(mq / t_ann, 1), "unit": "queries/s",
+            "vs_baseline": round(speedup, 2),
+            "recall_at_k": round(recall, 4),
+            "p99_ms": round(1e3 * float(np.percentile(walls, 99)), 2),
+            "pad_waste_frac": round(ix.pad_waste["waste_frac"], 4),
+            "wall_s": round(t_ann, 4)}
+
+
 def bench_als_sparse(n_users, n_items, nnz_per_user, tag, n_f=16, iters=3):
     """Sparse ALS (BCOO segment-sum path).  Proxy: same-algorithm NumPy —
     batched per-user/item normal equations from the triplets, ONE
@@ -2733,6 +2824,11 @@ def _configs():
             # speedup floor arms on MXU-class backends only
             ("trees_smoke",
              lambda: bench_trees(2048, 8, 16, 32, "smoke")),
+            # round-18 IVF-ANN retrieval tier: recall@10 >= 0.95 AND
+            # >= 3x the exact kneighbors ring, 1 dispatch / 0 transfers
+            ("ann_smoke",
+             lambda: bench_ann(262_144, 32, 512, 10, 2048, 8, "smoke",
+                               kmeans_max_iter=2)),
             ("shuffle_smoke", lambda: bench_shuffle(4096, 16, "smoke",
                                                     chain=3)),
             ("kmeans_smoke_star",
@@ -2809,6 +2905,12 @@ def _configs():
          lambda: bench_forest(100_000, 20, 16, "100000x20")),
         ("knn_1000000x10_q10000_k10_queries_per_sec",
          lambda: bench_knn(1_000_000, 10, 10_000, 10, "1000000x10_q10000")),
+        # round-18 IVF-ANN retrieval tier at the million-item scale the
+        # subsystem exists for: recall@10 >= 0.95 AND >= 3x the exact
+        # ring, ONE dispatch / 0 transfers counter-asserted in-config
+        ("ann_1000000x64_q4096_k10_queries_per_sec",
+         lambda: bench_ann(1_000_000, 64, 4096, 10, 1024, 32,
+                           "1000000x64_q4096", kmeans_max_iter=5)),
         ("als_sparse_100000x10000_nnz100_f16_3it_wall_s",
          lambda: bench_als_sparse(100_000, 10_000, 100,
                                   "100000x10000_nnz100")),
@@ -2877,7 +2979,7 @@ def _run_one(name):
     # the parent's skip-and-continue and two-timeouts-abort paths)
     if name in os.environ.get("DSLIB_BENCH_FAKE_HANG", "").split(","):
         time.sleep(10_000)
-    if name.startswith(("summa", "rechunk", "overlap", "sparse")) \
+    if name.startswith(("summa", "rechunk", "overlap", "sparse", "ann")) \
             and os.environ.get("BENCH_SMOKE") \
             and (_smoke_wants_cpu()
                  or "cpu" in os.environ.get("JAX_PLATFORMS", "")):

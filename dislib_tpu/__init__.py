@@ -71,7 +71,7 @@ from dislib_tpu.utils.saving import save_model, load_model
 # low-latency predict path with micro-batching and model hot-swap)
 from dislib_tpu import cluster, classification, regression, neighbors, \
     preprocessing, optimization, model_selection, recommendation, \
-    trees, runtime, serving  # noqa: E402,F401
+    trees, runtime, serving, retrieval  # noqa: E402,F401
 
 # estimator classes re-exported at top level so every name in the SURVEY §8
 # parity contract is importable from `dislib_tpu` directly (their canonical
@@ -113,5 +113,5 @@ __all__ = [
     "NearestNeighbors", "LinearRegression", "Lasso", "ADMM", "ALS",
     "StandardScaler", "MinMaxScaler",
     "KFold", "GridSearchCV", "RandomizedSearchCV",
-    "runtime", "serving",
+    "runtime", "serving", "retrieval",
 ]

@@ -1,0 +1,39 @@
+"""dislib_tpu.retrieval — the IVF-ANN candidate-retrieval tier
+(ROADMAP item 3(b): million-item vector search from parts the library
+already owns, served in one dispatch).
+
+An exact ``NearestNeighbors`` ring pass is O(catalog) FLOPs per query
+batch — the right tool for a training-time kNN graph, the wrong one for
+a serving tier answering "which ~10 of a million catalog items is this
+user embedding closest to" thousands of times a second.  The classic
+answer is IVF (inverted-file) approximate search: cluster the catalog
+once (coarse quantizer), keep one *inverted list* of catalog vectors per
+centroid, and at query time scan only the ``nprobe`` lists whose
+centroids are nearest — O(nprobe · list) work for recall@10 ≥ 0.95.
+
+Every part is something the library already owns:
+
+- **coarse quantizer** = :class:`~dislib_tpu.cluster.KMeans`, driven by
+  the chunked fit loop (checkpoint/rollback/elastic resume apply to
+  index builds for free);
+- **inverted lists** = the ``ShardedSparse`` pad discipline: rectangular
+  per-shard buffers with sentinel pads and slot<count masks, every
+  length host-computed so no device sync ever decides a shape;
+- **the scan** = the ring top-k idiom (``ops/ring.ring_kneighbors``)
+  riding ``ops/overlap.panel_pipeline`` under the ``DSLIB_OVERLAP``
+  router — db/seq schedules bit-equal, ONE jitted ``shard_map`` for the
+  whole probe→gather→score→merge path (full-program-compilation
+  discipline, arXiv:1810.09868);
+- **serving** = :class:`RetrievalPipeline` through the ``PredictServer``
+  bucket ladder, bundled by ``serving.bundle.export_bundle`` so a fresh
+  process answers ``[ids | scores]`` rows with zero retraces.
+
+See the user guide's "Vector retrieval serving" section for the index
+layout, the nprobe/recall trade-off, and the pad-waste knob
+(``DSLIB_IVF_LIST_QUANTUM``).
+"""
+
+from dislib_tpu.retrieval.ivf import IVFIndex
+from dislib_tpu.retrieval.serving import RetrievalPipeline
+
+__all__ = ["IVFIndex", "RetrievalPipeline"]

@@ -1,0 +1,424 @@
+"""IVF-ANN index: KMeans coarse quantizer + striped inverted lists +
+ONE fused probe→gather→score→merge search dispatch.
+
+**Index layout (the tentpole's data structure).**  ``fit`` clusters the
+catalog with the library's own :class:`~dislib_tpu.cluster.KMeans`
+(chunked-fit-loop driven — index builds inherit checkpoint/rollback and
+elastic resume), then lays the inverted lists out HOST-side (no device
+sync ever decides a shape) as rectangular per-shard buffers in the
+``ShardedSparse`` pad discipline:
+
+- every list's entries are **striped round-robin over the mesh row
+  shards** (entry rank j of list ℓ lands on shard ``j % p``), so every
+  shard holds a ~1/p sub-list of EVERY list.  Striping kills the two
+  classic IVF layout pathologies at once: the static scan width per ring
+  step is ``cap ≈ max_list/p`` instead of ``max_list`` (a probe costs
+  nprobe·cap·d FLOPs per step, p steps — total ≈ nprobe·max_list·d, no
+  p× replication of masked work), and list-length skew load-balances
+  itself (a hot list's entries spread over all shards);
+- each (shard, list) sub-list pads to a multiple of the
+  ``DSLIB_IVF_LIST_QUANTUM`` pad quantum (default 8) — the skew knob:
+  bigger quantum = fewer distinct list offsets (friendlier gathers),
+  more pad slots.  The measured cost lives in :attr:`IVFIndex.pad_waste`;
+- pad slots carry sentinel id −1, zero vectors, zero norms, and every
+  scan masks ``slot < count | id < 0`` — pads are provably
+  non-load-bearing (the poisoned-slot regression in
+  ``tests/test_retrieval.py`` fills them with garbage per schedule).
+
+**Search (ONE dispatch).**  ``search`` is a single profiled jitted
+``shard_map``: centroid-distance GEMM → static ``lax.top_k`` over
+``nprobe`` → per-ring-step masked gather of the probed sub-lists →
+scored partial top-k → cross-shard merge on the
+:func:`~dislib_tpu.ops.ring.ring_kneighbors` idiom.  The ring step loop
+rides :func:`~dislib_tpu.ops.overlap.panel_pipeline` under the
+``DSLIB_OVERLAP`` router (db/seq bit-equal by construction, routing
+observable as ``ivf_search:<sched>`` schedule counters), contractions
+route through the precision policy layer (``precision=``), and the
+kernel emits ALREADY-PADDED ``(mq_pad, k_pad)`` outputs with zeroed pad
+regions so the host wrapper is ``Array._from_logical_padded`` — no
+repad dispatch, exactly one program per search call.
+
+``nprobe = n_lists`` scans every list exactly once — the exact
+kneighbors result (up to top-k tie order) through the same program.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dislib_tpu.data.array import (Array, _padded_shape, array as _mk_array,
+                                   ensure_canonical)
+from dislib_tpu.ops import overlap as _ov
+from dislib_tpu.ops import precision as px
+from dislib_tpu.ops.base import precise
+from dislib_tpu.ops.ring import _rotate
+from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.utils import profiling as _prof
+
+__all__ = ["IVFIndex"]
+
+_DEFAULT_LIST_QUANTUM = 8
+
+# candidate columns gathered per probe-chunk merge: the static bound on
+# the scan's live gather panel (mq_loc × ~this × d_loc elements)
+_PROBE_BLOCK = 1024
+
+
+def _list_quantum(explicit=None) -> int:
+    """The skew/pad knob: explicit wins, else ``DSLIB_IVF_LIST_QUANTUM``,
+    else 8 (measured waste for any choice lands in ``pad_waste``)."""
+    if explicit is not None:
+        q = int(explicit)
+    else:
+        q = int(os.environ.get("DSLIB_IVF_LIST_QUANTUM",
+                               str(_DEFAULT_LIST_QUANTUM)))
+    if q < 1:
+        raise ValueError(f"list quantum must be >= 1, got {q}")
+    return q
+
+
+@partial(jax.jit, static_argnames=("mesh", "k", "nprobe", "cap", "overlap",
+                                   "policy"))
+@precise
+def _ivf_topk(qp, vecs, ids, vsq, offs, cnts, cents, mesh, k, nprobe, cap,
+              overlap="db", policy=px.FLOAT32):
+    """(d², catalog ids) of the approx k nearest catalog rows per padded
+    query row — the fused IVF scan (plain ``jax.jit``: invoked from the
+    outer profiled kernels, which own the dispatch-count boundary).
+
+    Unfillable slots (fewer than k live candidates in the probed lists)
+    carry distance +inf and id −1.
+    """
+    nrows = mesh.shape[_mesh.ROWS]
+
+    def local(q, v, i, s, of, cn, ce):
+        e_pad = v.shape[0]
+        # full squared norms (features col-sharded → psum over 'cols')
+        q_sq = lax.psum(jnp.sum(q * q, axis=1), _mesh.COLS)
+        # -- phase 1: coarse quantizer — centroid distances, static top-k
+        c_sq = lax.psum(jnp.sum(ce * ce, axis=1), _mesh.COLS)
+        if overlap == "pallas":
+            from dislib_tpu.ops import pallas_kernels as _pk
+            cpart = lax.psum(_pk.panel_gemm(q, ce.T), _mesh.COLS)
+        else:
+            cpart = lax.psum(px.pdot(q, ce.T, policy), _mesh.COLS)
+        cd = q_sq[:, None] - 2.0 * cpart + c_sq[None, :]
+        _, probes = lax.top_k(-cd, nprobe)          # (mq_loc, nprobe)
+
+        # -- phase 2: ring scan of the probed striped sub-lists.
+        # Probes are scanned in CHUNKS of pc lists — one fused gather +
+        # einsum + top-k merge per chunk instead of one per probe: big
+        # ops amortize per-op latency (the whole point of the tier),
+        # while the chunk width keeps the gathered panel's live memory
+        # statically bounded at ~mq_loc × _PROBE_BLOCK × d_loc.
+        of0, cn0 = of[0], cn[0]                     # (nlist,) this shard
+        perm = [(r, (r + 1) % nrows) for r in range(nrows)]
+
+        def fetch(t, prev):
+            return _rotate(perm, *prev)     # one ICI hop per carried array
+
+        pan0 = (v, i, s, of0, cn0)
+        pc = max(1, min(nprobe, _PROBE_BLOCK // max(cap, 1)))
+        n_chunks = -(-nprobe // pc)
+        npb = n_chunks * pc
+        # chunk padding repeats probe slots — masked dead below so a
+        # duplicated list can never seat the same entry twice in the top-k
+        probes_p = jnp.pad(probes, ((0, 0), (0, npb - nprobe)))
+        probe_ok = lax.broadcasted_iota(jnp.int32, (1, npb), 1) < nprobe
+        slot_iota = lax.broadcasted_iota(jnp.int32, (1, 1, cap), 2)
+        acc_dt = jnp.promote_types(q.dtype, v.dtype)
+
+        def consume(t, carry, pan):
+            pv, pi, ps, pof, pcn = pan
+
+            def chunk_body(r, acc):
+                best_d, best_i = acc
+                pr = lax.dynamic_slice_in_dim(probes_p, r * pc, pc,
+                                              axis=1)      # (mq_loc, pc)
+                ok = lax.dynamic_slice_in_dim(probe_ok, r * pc, pc,
+                                              axis=1)       # (1, pc)
+                off = pof[pr]
+                cnt = jnp.where(ok, pcn[pr], 0)
+                ridx = jnp.clip(off[:, :, None] + slot_iota, 0, e_pad - 1)
+                flat = ridx.reshape(q.shape[0], pc * cap)
+                g = jnp.take(pv, flat, axis=0)  # (mq_loc, pc·cap, d_loc)
+                gi = jnp.take(pi, flat, axis=0)
+                gs = jnp.take(ps, flat, axis=0)
+                cross = lax.psum(px.peinsum("qd,qcd->qc", q, g, policy),
+                                 _mesh.COLS)
+                d2 = q_sq[:, None] - 2.0 * cross + gs
+                # the pad/ownership mask: a slot is live iff it is below
+                # its list's count on THIS shard and not a sentinel pad
+                live = (slot_iota < cnt[:, :, None]).reshape(
+                    q.shape[0], pc * cap) & (gi >= 0)
+                d2 = jnp.where(live, d2, jnp.inf)
+                cand_d = jnp.concatenate(
+                    [best_d, d2.astype(best_d.dtype)], axis=1)
+                cand_i = jnp.concatenate([best_i, gi], axis=1)
+                neg, pos = lax.top_k(-cand_d, k)
+                return -neg, jnp.take_along_axis(cand_i, pos, axis=1)
+
+            if n_chunks == 1:
+                return chunk_body(0, carry)
+            return lax.fori_loop(0, n_chunks, chunk_body, carry)
+
+        # constant top-k seeds become row-varying on the first merge;
+        # declaring it up front keeps check_vma provable (ring idiom)
+        acc0 = (lax.pcast(jnp.full((q.shape[0], k), jnp.inf, acc_dt),
+                          (_mesh.ROWS,), to="varying"),
+                lax.pcast(jnp.full((q.shape[0], k), -1, jnp.int32),
+                          (_mesh.ROWS,), to="varying"))
+        best_d, best_i = _ov.panel_pipeline(nrows, pan0, fetch, consume,
+                                            acc0, _ov.overlapped(overlap))
+        return jnp.maximum(best_d, 0.0), best_i
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(_mesh.ROWS, _mesh.COLS),     # queries
+                  P(_mesh.ROWS, _mesh.COLS),     # striped list vectors
+                  P(_mesh.ROWS),                 # entry ids (−1 = pad)
+                  P(_mesh.ROWS),                 # entry ‖x‖²
+                  P(_mesh.ROWS, None),           # per-shard list offsets
+                  P(_mesh.ROWS, None),           # per-shard list counts
+                  P(None, _mesh.COLS)),          # centroids
+        out_specs=(P(_mesh.ROWS, None), P(_mesh.ROWS, None)),
+        check_vma=True,
+    )(qp, vecs, ids, vsq, offs, cnts, cents)
+
+
+@partial(_prof.profiled_jit, name="ivf_search",
+         static_argnames=("mesh", "k", "k_pad", "nprobe", "cap", "mq",
+                          "overlap", "policy"))
+def _ivf_search(qp, vecs, ids, vsq, offs, cnts, cents, mesh, k, k_pad,
+                nprobe, cap, mq, overlap="db", policy=px.FLOAT32):
+    # profiled: this is THE host dispatch boundary — one program per
+    # search call (counter-asserted).  The kernel pads its own output to
+    # (mq_pad, k_pad) with zeroed pad regions, so the host wrapper is
+    # Array._from_logical_padded directly — no repad dispatch.
+    d2, idx = _ivf_topk(qp, vecs, ids, vsq, offs, cnts, cents, mesh=mesh,
+                        k=k, nprobe=nprobe, cap=cap, overlap=overlap,
+                        policy=policy)
+    dist = jnp.sqrt(d2)                  # d² ≥ 0 by the kernel's clamp
+    valid_q = lax.broadcasted_iota(jnp.int32, (dist.shape[0], 1), 0) < mq
+    dist = jnp.where(valid_q, dist, 0.0)
+    idx = jnp.where(valid_q, idx, 0)
+    if k_pad > k:
+        dist = jnp.pad(dist, ((0, 0), (0, k_pad - k)))
+        idx = jnp.pad(idx, ((0, 0), (0, k_pad - k)))
+    return dist, idx
+
+
+class IVFIndex:
+    """Inverted-file ANN index over a catalog of item vectors.
+
+    Parameters
+    ----------
+    n_lists : int or None — inverted-list count (the KMeans cluster
+        count).  None → ``round(sqrt(n_items))`` at fit time, the
+        classic IVF heuristic.
+    nprobe : int, default 8 — lists scanned per query (the recall/speed
+        dial; ``search`` accepts a per-call override).
+    list_quantum : int or None — per-(shard, list) pad quantum; None →
+        ``DSLIB_IVF_LIST_QUANTUM`` (default 8).
+    kmeans_max_iter, random_state, verbose — forwarded to the coarse
+        quantizer's :class:`~dislib_tpu.cluster.KMeans`.
+
+    Attributes
+    ----------
+    quantizer_ : the fitted KMeans (None when built through the layout
+        seam ``_build``).
+    n_lists_, n_items, d : fitted geometry.
+    pad_waste : dict — the measured layout overhead: logical
+        ``entries``, device ``buffer_rows``, quantum pad and
+        shard-balance pad split out, ``waste_frac``, the static scan
+        width ``cap``, and per-shard entry totals.
+    """
+
+    def __init__(self, n_lists=None, nprobe=8, list_quantum=None,
+                 kmeans_max_iter=10, random_state=None, verbose=False):
+        self.n_lists = None if n_lists is None else int(n_lists)
+        self.nprobe = int(nprobe)
+        self.list_quantum = None if list_quantum is None \
+            else int(list_quantum)
+        self.kmeans_max_iter = int(kmeans_max_iter)
+        self.random_state = random_state
+        self.verbose = verbose
+        self.quantizer_ = None
+
+    # -- build ---------------------------------------------------------------
+
+    def fit(self, items, y=None, checkpoint=None, health=None):
+        """Build the index: KMeans coarse quantizer (chunked-fit-loop
+        driven — ``checkpoint=``/``health=`` buy rollback and elastic
+        resume exactly as for any estimator fit), one labels pass, then
+        the host-computed striped layout.  Offline by definition: the
+        build syncs; the search path never does."""
+        from dislib_tpu.cluster import KMeans
+        arr = items if isinstance(items, Array) \
+            else _mk_array(np.atleast_2d(np.asarray(items)))
+        arr = ensure_canonical(arr)
+        n = arr.shape[0]
+        if n < 1:
+            raise ValueError("cannot index an empty catalog")
+        nlist = self.n_lists if self.n_lists is not None \
+            else max(1, int(round(math.sqrt(n))))
+        nlist = min(int(nlist), n)
+        km = KMeans(n_clusters=nlist, max_iter=self.kmeans_max_iter,
+                    random_state=self.random_state, verbose=self.verbose)
+        km.fit(arr, checkpoint=checkpoint, health=health)
+        labels = km.predict(arr).collect().ravel()
+        self._build(arr.collect(), labels, km.centers_)
+        self.quantizer_ = km
+        return self
+
+    def _build(self, items_h, labels_h, centers_h):
+        """The striped-layout seam (host data in, device buffers out) —
+        ``fit`` lands here, and tests craft labels/centroids through it
+        (empty lists, x64 catalogs) without a KMeans run.
+
+        All lengths/offsets are host numpy; nothing here reads a device
+        value, so no sync ever decides a shape."""
+        mesh = _mesh.get_mesh()
+        p, c = _mesh.mesh_shape(mesh)
+        mq_quant = _mesh.pad_quantum(mesh)
+        items_h = np.atleast_2d(np.asarray(items_h))
+        labels_h = np.asarray(labels_h).ravel().astype(np.int64)
+        centers_h = np.atleast_2d(np.asarray(centers_h))
+        n, d = items_h.shape
+        nlist = centers_h.shape[0]
+        if labels_h.shape[0] != n:
+            raise ValueError(f"{n} items but {labels_h.shape[0]} labels")
+        if centers_h.shape[1] != d:
+            raise ValueError(f"centroid width {centers_h.shape[1]} != "
+                             f"item width {d}")
+        if n and (labels_h.min() < 0 or labels_h.max() >= nlist):
+            raise ValueError(f"labels must lie in [0, {nlist})")
+        quantum = _list_quantum(self.list_quantum)
+        dtype = items_h.dtype if np.issubdtype(items_h.dtype, np.floating) \
+            else np.dtype(np.float32)
+        d_pad = _padded_shape((1, d), mq_quant)[1]
+
+        # striped sub-list lengths: entry rank j of list ℓ → shard j % p
+        counts_l = np.bincount(labels_h, minlength=nlist)      # (nlist,)
+        sh = np.arange(p, dtype=np.int64)
+        cnt_ls = np.clip((counts_l[:, None] - sh[None, :] + p - 1) // p,
+                         0, None)                              # (nlist, p)
+        pad_ls = -(-cnt_ls // quantum) * quantum
+        cap = max(int(pad_ls.max(initial=0)), quantum)
+        offs_ls = np.zeros((nlist, p), np.int64)
+        offs_ls[1:] = np.cumsum(pad_ls, axis=0)[:-1]
+        shard_tot = pad_ls.sum(axis=0)                         # (p,)
+        e_pad = max(int(shard_tot.max(initial=0)), cap)
+
+        # vectorized fill: order entries by (list, original id), compute
+        # each entry's (shard, slot) in closed form, scatter once
+        order = np.argsort(labels_h, kind="stable")
+        lbl_sorted = labels_h[order]
+        starts = np.zeros(nlist + 1, np.int64)
+        starts[1:] = np.cumsum(counts_l)
+        rank = np.arange(n, dtype=np.int64) - starts[lbl_sorted]
+        shard = rank % p
+        slot = offs_ls[lbl_sorted, shard] + rank // p
+        vecs_h = np.zeros((p, e_pad, d_pad), dtype)
+        ids_h = np.full((p, e_pad), -1, np.int32)
+        vecs_h[shard, slot, :d] = items_h[order]     # ndarray-assign casts
+        ids_h[shard, slot] = order
+        vsq_h = np.einsum("sed,sed->se", vecs_h, vecs_h)  # pads stay 0
+        cents_h = np.zeros((nlist, d_pad), dtype)
+        cents_h[:, :d] = centers_h
+
+        self._vecs = jax.device_put(vecs_h.reshape(p * e_pad, d_pad),
+                                    _mesh.data_sharding(mesh))
+        self._ids = jax.device_put(ids_h.reshape(p * e_pad),
+                                   NamedSharding(mesh, P(_mesh.ROWS)))
+        self._vsq = jax.device_put(vsq_h.reshape(p * e_pad),
+                                   NamedSharding(mesh, P(_mesh.ROWS)))
+        self._offs = jax.device_put(
+            np.ascontiguousarray(offs_ls.T).astype(np.int32),
+            NamedSharding(mesh, P(_mesh.ROWS, None)))
+        self._cnts = jax.device_put(
+            np.ascontiguousarray(cnt_ls.T).astype(np.int32),
+            NamedSharding(mesh, P(_mesh.ROWS, None)))
+        self._cents = jax.device_put(cents_h,
+                                     NamedSharding(mesh, P(None, _mesh.COLS)))
+        self._cap = int(cap)
+        self.d = int(d)
+        self.n_items = int(n)
+        self.n_lists_ = int(nlist)
+        self._fitted_mesh = (p, c)
+        self._fitted_quantum = int(mq_quant)
+        list_pad = int(pad_ls.sum() - counts_l.sum())
+        self.pad_waste = {
+            "entries": int(n),
+            "buffer_rows": int(p * e_pad),
+            "list_pad_entries": list_pad,
+            "balance_pad_rows": int(p * e_pad - pad_ls.sum()),
+            "waste_frac": float(1.0 - n / float(p * e_pad)),
+            "cap": int(cap),
+            "quantum": int(quantum),
+            "per_shard_entries": [int(v) for v in cnt_ls.sum(axis=0)],
+        }
+        return self
+
+    def _check_fitted(self):
+        if getattr(self, "n_items", None) is None:
+            raise RuntimeError("IVFIndex is not fitted — call fit() first")
+        mesh = _mesh.get_mesh()
+        now = _mesh.mesh_shape(mesh)
+        if now != self._fitted_mesh \
+                or _mesh.pad_quantum(mesh) != self._fitted_quantum:
+            raise RuntimeError(
+                f"IVFIndex was built on mesh {self._fitted_mesh} (quantum "
+                f"{self._fitted_quantum}) but the current mesh is {now} "
+                f"(quantum {_mesh.pad_quantum(mesh)}) — the striped list "
+                "buffers are mesh-shaped; refit (or rebuild via _build) "
+                "on the new mesh")
+
+    # -- query ---------------------------------------------------------------
+
+    def search(self, queries, k=10, nprobe=None, precision=None,
+               overlap=None):
+        """Approximate k-nearest catalog rows per query — ONE fused
+        dispatch for the whole probe→gather→score→merge path.
+
+        Returns ``(distances, ids)`` — both ``(n_queries, k)`` ds-arrays
+        (euclidean distance, int32 catalog row ids), nearest first.
+        Slots the probed lists could not fill carry id −1 and distance
+        +inf.  ``nprobe=n_lists_`` scans everything (exact up to top-k
+        tie order); ``precision=``/``overlap=`` route through the policy
+        layer and the ``DSLIB_OVERLAP`` schedule router.
+        """
+        self._check_fitted()
+        q = queries if isinstance(queries, Array) \
+            else _mk_array(np.atleast_2d(np.asarray(queries)))
+        q = ensure_canonical(q)
+        if q.shape[1] != self.d:
+            raise ValueError(f"queries have {q.shape[1]} features, the "
+                             f"index holds {self.d}")
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        nprobe = self.nprobe if nprobe is None else int(nprobe)
+        nprobe = max(1, min(nprobe, self.n_lists_))
+        mq = q.shape[0]
+        k_pad = _padded_shape((1, k), self._fitted_quantum)[1]
+        # schedule resolved at this host boundary so a DSLIB_OVERLAP flip
+        # retraces via the kernel static (observable via the counters)
+        sched = _ov.resolve(overlap)
+        _prof.count_schedule("ivf_search", sched)
+        policy = px.resolve(precision)
+        dist, idx = _ivf_search(q._data, self._vecs, self._ids, self._vsq,
+                                self._offs, self._cnts, self._cents,
+                                mesh=_mesh.get_mesh(), k=k, k_pad=k_pad,
+                                nprobe=nprobe, cap=self._cap, mq=mq,
+                                overlap=sched, policy=policy)
+        return (Array._from_logical_padded(dist, (mq, k)),
+                Array._from_logical_padded(idx, (mq, k)))

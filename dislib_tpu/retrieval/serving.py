@@ -1,0 +1,168 @@
+"""Retrieval serving — IVF search through the PredictServer bucket
+ladder and the AOT deployment-bundle path.
+
+:class:`RetrievalPipeline` is the ``pipeline=`` drop-in for
+:class:`~dislib_tpu.serving.server.PredictServer`: a request row is a
+query embedding (``n_features = index.d``), a response row is
+``[ids | scores]`` — the k retrieved catalog ids (float32-encoded,
+exact below 2²⁴ — guarded at construction) followed by their k
+distances.  ``predict_bucket`` is the dense serving contract: stage
+into the bucket's padded canvas, ONE fused search dispatch
+(``ivf_serve``), one blessed fetch, slice.
+
+``capture_bucket`` is the deployment-bundle half: the serve kernel is a
+``shard_map`` program (not a fusion-chain lazy array), so instead of
+linearizing a deferred chain like ``ServePipeline``, the pipeline AOT
+``lower().compile()``s its own kernel per bucket and hands
+``serving.bundle.export_bundle`` the serialized executable plus its
+operand leaves (query placeholder + the sharded list buffers +
+centroids) — the artifact carries the WHOLE index, and a fresh process
+serves retrieval with zero retraces through the standard
+``load_bundle`` path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+
+from dislib_tpu.ops import overlap as _ov
+from dislib_tpu.ops import precision as px
+from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.retrieval.ivf import IVFIndex, _ivf_topk
+from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.serving.buckets import BucketTemplate
+from dislib_tpu.utils import profiling as _prof
+
+__all__ = ["RetrievalPipeline"]
+
+_ID_CEIL = 1 << 24          # float32 carries integers exactly below this
+
+
+@partial(_prof.profiled_jit, name="ivf_serve",
+         static_argnames=("mesh", "k", "nprobe", "cap", "overlap",
+                          "policy"))
+def _ivf_serve(qp, vecs, ids, vsq, offs, cnts, cents, mesh, k, nprobe, cap,
+               overlap="db", policy=px.FLOAT32):
+    # the serving response kernel: ONE output array so the bundle path's
+    # single-leaf output contract holds ([ids | dists] rows, float32).
+    # Padded query rows carry garbage — the host slice drops them.
+    d2, idx = _ivf_topk(qp, vecs, ids, vsq, offs, cnts, cents, mesh=mesh,
+                        k=k, nprobe=nprobe, cap=cap, overlap=overlap,
+                        policy=policy)
+    return jnp.concatenate([px.f32(idx), px.f32(jnp.sqrt(d2))], axis=1)
+
+
+class RetrievalPipeline:
+    """A fitted :class:`~dislib_tpu.retrieval.IVFIndex` served as
+    ``[ids | scores]`` rows — the ``pipeline=`` drop-in for
+    :class:`~dislib_tpu.serving.server.PredictServer` (same
+    ``n_features`` / ``predict_bucket`` / ``out_cols`` surface as
+    ``ServePipeline``, so micro-batching, the bucket ladder, tenancy,
+    canaries, and quotas compose unchanged).
+
+    Parameters
+    ----------
+    index : fitted :class:`IVFIndex`.
+    k : int, default 10 — retrieved candidates per query; the response
+        width is ``2·k``.
+    nprobe : int or None — lists probed per query (None → the index's
+        default).
+    precision : policy for the scoring contractions (None → the
+        ``DSLIB_MATMUL_PRECISION`` default).
+
+    Unfillable slots carry id −1 and score +inf (same contract as
+    ``IVFIndex.search``).
+    """
+
+    def __init__(self, index: IVFIndex, k=10, nprobe=None, precision=None):
+        index._check_fitted()
+        if index.n_items >= _ID_CEIL:
+            raise ValueError("catalog ids ≥ 2^24 don't ride the float32 "
+                             "[ids|scores] response encoding")
+        self.index = index
+        self.k = int(k)
+        if self.k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        nprobe = index.nprobe if nprobe is None else int(nprobe)
+        self.nprobe = max(1, min(nprobe, index.n_lists_))
+        self.policy = px.resolve(precision)
+        self.n_features = int(index.d)
+        self.out_cols = 2 * self.k
+        self._templates: dict[int, BucketTemplate] = {}
+
+    def _pshape(self, bucket: int):
+        from dislib_tpu.data.array import _padded_shape
+        return _padded_shape((bucket, self.n_features),
+                             _mesh.pad_quantum())
+
+    def _template(self, bucket: int) -> BucketTemplate:
+        tmpl = self._templates.get(bucket)
+        if tmpl is None:
+            tmpl = self._templates[bucket] = BucketTemplate(
+                self._pshape(bucket))
+        return tmpl
+
+    def _kernel_args(self, dev):
+        ix = self.index
+        return ((dev, ix._vecs, ix._ids, ix._vsq, ix._offs, ix._cnts,
+                 ix._cents),
+                dict(mesh=_mesh.get_mesh(), k=self.k, nprobe=self.nprobe,
+                     cap=ix._cap, policy=self.policy))
+
+    def predict_bucket(self, rows: np.ndarray, bucket: int) -> np.ndarray:
+        """Serve one query batch: stage into the bucket canvas, ONE
+        fused IVF search dispatch, one blessed fetch, slice — the dense
+        ``ServePipeline.predict_bucket`` contract."""
+        import jax
+        self.index._check_fitted()
+        rows = np.asarray(rows, np.float32)
+        if rows.ndim == 1:
+            rows = rows.reshape(1, -1)
+        if rows.shape[1] != self.n_features:
+            raise ValueError(f"request has {rows.shape[1]} features, the "
+                             f"index holds {self.n_features}")
+        if rows.shape[0] > bucket:
+            raise ValueError(f"{rows.shape[0]} rows exceed bucket {bucket}")
+        buf = self._template(bucket).fill(rows)
+        dev = jax.device_put(buf, _mesh.data_sharding())
+        sched = _ov.resolve()
+        _prof.count_schedule("ivf_search", sched)
+        args, kw = self._kernel_args(dev)
+        out = _ivf_serve(*args, overlap=sched, **kw)
+        host = _fetch(out)                  # force: ONE fused dispatch
+        return host[: rows.shape[0], : self.out_cols]
+
+    # -- deployment-bundle capture ------------------------------------------
+
+    def capture_bucket(self, bucket: int) -> dict:
+        """AOT-capture this bucket's serve program for
+        :func:`~dislib_tpu.serving.bundle.export_bundle` WITHOUT
+        executing it: ``lower().compile()`` the serve kernel on a
+        placeholder query canvas and serialize the compiled executable.
+        The operand leaves are the placeholder (the input slot) plus the
+        index's sharded list buffers and centroids — the bundle carries
+        the WHOLE index, so ``load_bundle`` serves retrieval in a fresh
+        process with zero retraces."""
+        import jax
+        from jax.experimental.serialize_executable import serialize
+        self.index._check_fitted()
+        pshape = self._pshape(bucket)
+        placeholder = jax.device_put(np.zeros(pshape, np.float32),
+                                     _mesh.data_sharding())
+        sched = _ov.resolve()
+        args, kw = self._kernel_args(placeholder)
+        # .lower counts a trace, never a dispatch (profiled_jit contract)
+        compiled = _ivf_serve.lower(*args, overlap=sched, **kw).compile()
+        payload, _in_tree, out_tree = serialize(compiled)
+        canon = [jnp.asarray(leaf) for leaf in args]
+        return {
+            "payload": np.frombuffer(payload, np.uint8),
+            "leaves": canon,
+            "input_slot": 0,
+            "n_outs": out_tree.num_leaves,
+            "out_cols": self.out_cols,
+            "pshape": list(pshape),
+        }

@@ -62,7 +62,8 @@ from dislib_tpu.serving.bundle import (BundlePipeline, LoadedBundle,
 from dislib_tpu.serving.cache import ProgramCache
 from dislib_tpu.serving.hotswap import ModelPool
 from dislib_tpu.serving.pipeline import ServePipeline
-from dislib_tpu.serving.router import ModelRouter, TenantQuotaExceeded
+from dislib_tpu.serving.router import (DeadlineShed, ModelRouter,
+                                       TenantQuotaExceeded)
 from dislib_tpu.serving.server import (PredictServer, QueueFull,
                                        ServeResponse)
 from dislib_tpu.serving.sparse import SparseFoldInPipeline, pack_sparse_rows
@@ -75,5 +76,5 @@ __all__ = [
     "SparseFoldInPipeline", "pack_sparse_rows",
     "export_bundle", "load_bundle", "BundlePipeline", "LoadedBundle",
     "runtime_fingerprint",
-    "ModelRouter", "TenantQuotaExceeded",
+    "ModelRouter", "TenantQuotaExceeded", "DeadlineShed",
 ]

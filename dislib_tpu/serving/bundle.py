@@ -178,7 +178,15 @@ def export_bundle(pipeline, path: str, buckets=None, checkpoint=None,
                       "n_features": int(pipeline.n_features),
                       "per_bucket": {}}
     for b in buckets:
-        cap = _capture_bucket(pipeline, b)
+        # capture protocol (round 18): pipelines whose predict program is
+        # not a fusion-chain lazy array (the retrieval tier's shard_map
+        # search, the sparse fold-in) AOT-capture their own kernel via a
+        # ``capture_bucket`` method returning the same dict shape; the
+        # fusion-chain linearizer stays the default
+        if hasattr(pipeline, "capture_bucket"):
+            cap = pipeline.capture_bucket(b)
+        else:
+            cap = _capture_bucket(pipeline, b)
         entries[f"exec_{b}"] = cap["payload"]
         for i, leaf in enumerate(cap["leaves"]):
             # one device→host sync per leaf at EXPORT time (offline by

@@ -36,6 +36,7 @@ itself, not from timing wrapped around it.
 
 from __future__ import annotations
 
+import os
 import threading
 import zlib
 
@@ -44,6 +45,11 @@ import numpy as np
 from dislib_tpu.serving.server import PredictServer
 
 _HASH_BUCKETS = 10_000      # canary fraction resolution: 0.01%
+
+
+def _default_router_deadline_s() -> float | None:
+    raw = os.environ.get("DSLIB_DEADLINE_MS")
+    return None if raw is None else float(raw) / 1e3
 
 
 class TenantQuotaExceeded(RuntimeError):
@@ -58,10 +64,28 @@ class TenantQuotaExceeded(RuntimeError):
         self.quota_rows = quota_rows
 
 
+class DeadlineShed(RuntimeError):
+    """Latency-budget admission control, typed (round 18): the routed
+    server's learned cost model (:meth:`PredictServer.predict_latency`)
+    predicts this request would miss the router's latency budget
+    (``deadline_ms`` / ``DSLIB_DEADLINE_MS``), so it is shed AT
+    ADMISSION — before it queues, where it would also push every request
+    behind it past its own deadline.  Subclasses ``RuntimeError`` like
+    the other shed types; carries the ``tenant``, the ``predicted_ms``,
+    and the ``deadline_ms`` that refused it."""
+
+    def __init__(self, message, tenant=None, predicted_ms=None,
+                 deadline_ms=None):
+        super().__init__(message)
+        self.tenant = tenant
+        self.predicted_ms = predicted_ms
+        self.deadline_ms = deadline_ms
+
+
 class _Tenant:
     __slots__ = ("name", "server", "quota_rows", "inflight_rows",
                  "canary", "canary_fraction", "quota_shed", "promotions",
-                 "promote_failures", "rollbacks")
+                 "promote_failures", "rollbacks", "deadline_shed")
 
     def __init__(self, name, server, quota_rows):
         self.name = name
@@ -74,6 +98,7 @@ class _Tenant:
         self.promotions = 0
         self.promote_failures = 0
         self.rollbacks = 0
+        self.deadline_shed = 0
 
 
 def _request_hash(rows: np.ndarray, key) -> int:
@@ -96,11 +121,15 @@ class ModelRouter:
     state is lock-protected; the heavy lifting stays in the servers.
     """
 
-    def __init__(self, name="router"):
+    def __init__(self, name="router", deadline_ms=None):
         self.name = name
         self._tenants: dict[str, _Tenant] = {}
         self._lock = threading.Lock()
         self._started: list[PredictServer] = []
+        # latency budget (round 18): predicted-miss admission control.
+        # None (and DSLIB_DEADLINE_MS unset) = no budget, never sheds.
+        self.deadline_s = _default_router_deadline_s() \
+            if deadline_ms is None else float(deadline_ms) / 1e3
 
     # -- tenancy -------------------------------------------------------------
 
@@ -244,7 +273,12 @@ class ModelRouter:
         when the tenant's in-flight rows would exceed its quota — only
         the offender's submission fails; the server's own
         :class:`~dislib_tpu.serving.server.QueueFull` backpressure can
-        still fire underneath as the global limit."""
+        still fire underneath as the global limit.  With a latency
+        budget set (``deadline_ms`` / ``DSLIB_DEADLINE_MS``), sheds with
+        :class:`DeadlineShed` when the routed server's learned cost
+        model predicts a budget miss; with no model yet (cold server)
+        the request is ADMITTED — the budget never sheds on
+        ignorance."""
         rows = np.asarray(rows, np.float32)
         if rows.ndim == 1:
             rows = rows.reshape(1, -1)
@@ -267,6 +301,24 @@ class ModelRouter:
             else:
                 server, label = t.server, tenant
             t.inflight_rows += k
+        # the latency-budget check runs OUTSIDE the router lock:
+        # predict_latency takes the server's own condition lock, and the
+        # router must never hold both at once (lock-order discipline).
+        # The inflight reservation above keeps the quota sound meanwhile.
+        if self.deadline_s is not None:
+            predicted = server.predict_latency(k)
+            if predicted is not None and predicted > self.deadline_s:
+                with self._lock:
+                    t.inflight_rows -= k
+                    t.deadline_shed += 1
+                raise DeadlineShed(
+                    f"{self.name}: tenant {tenant!r} request predicted at "
+                    f"{1e3 * predicted:.2f} ms against a "
+                    f"{1e3 * self.deadline_s:.2f} ms budget — shed at "
+                    "admission (queueing it would also push every request "
+                    "behind it past its deadline)",
+                    tenant=tenant, predicted_ms=1e3 * predicted,
+                    deadline_ms=1e3 * self.deadline_s)
         try:
             fut = server.submit(rows, tenant=label)
         except BaseException:
@@ -327,17 +379,19 @@ class ModelRouter:
         with self._lock:
             tenants = {name: (t.server, t.canary, t.canary_fraction,
                               t.inflight_rows, t.quota_rows, t.quota_shed,
-                              t.promotions, t.promote_failures, t.rollbacks)
+                              t.promotions, t.promote_failures, t.rollbacks,
+                              t.deadline_shed)
                        for name, t in self._tenants.items()}
         out = {}
         for name, (server, canary, frac, inflight, quota, shed,
-                   promotions, promote_failures, rollbacks) in \
-                tenants.items():
+                   promotions, promote_failures, rollbacks,
+                   deadline_shed) in tenants.items():
             sstats = server.stats()
             entry = {"server": server.name,
                      "inflight_rows": inflight,
                      "quota_rows": quota,
                      "quota_shed": shed,
+                     "deadline_shed": deadline_shed,
                      "promotions": promotions,
                      "promote_failures": promote_failures,
                      "rollbacks": rollbacks,

@@ -139,6 +139,11 @@ class PredictServer:
         self._tenant_lat: dict[str, deque] = {}
         self._tenant_requests: dict[str, int] = {}
         self._tenant_shed: dict[str, int] = {}
+        # per-bucket wall-clock cost model (round 18): measured
+        # predict_bucket walls keyed by bucket, learned from the server's
+        # own serving — the admission layer (ModelRouter deadline shed)
+        # reads predict_latency() instead of guessing
+        self._bucket_wall: dict[int, deque] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -299,10 +304,13 @@ class PredictServer:
             rows = batch[0].rows if len(batch) == 1 else \
                 np.concatenate([p.rows for p in batch], axis=0)
             pieces = []
+            walls = []
             d0 = _prof.dispatch_count()
             for size in split_rows(total, self.buckets):
                 bucket = bucket_for(size, self.buckets)
+                t_piece = time.perf_counter()
                 pieces.append(pipe.predict_bucket(rows[:size], bucket))
+                walls.append((bucket, time.perf_counter() - t_piece))
                 self.cache.record_hit(gen, bucket)
                 rows = rows[size:]
             dispatches = _prof.dispatch_count() - d0
@@ -320,6 +328,9 @@ class PredictServer:
         with self._cv:
             self._batches += 1
             self._dispatch_hist.append(dispatches)
+            for bucket, wall in walls:
+                self._bucket_wall.setdefault(
+                    bucket, deque(maxlen=512)).append(wall)
             if self._t_first is None:
                 self._t_first = t_done
             self._t_last = t_done
@@ -343,6 +354,50 @@ class PredictServer:
                 p.future.set_result(
                     ServeResponse(out[off:off + k].copy(), gen, lat))
             off += k
+
+    # -- cost model ----------------------------------------------------------
+
+    def bucket_cost(self) -> dict:
+        """The learned per-bucket cost model: ``{bucket: p95 wall
+        seconds}`` over the measured ``predict_bucket`` walls of this
+        server's own serving.  A bucket appears once it has ≥ 3 samples
+        — before that the model declines to predict (None from
+        :meth:`predict_latency`) rather than shed on a guess."""
+        with self._cv:
+            snap = {b: np.asarray(d, np.float64)
+                    for b, d in self._bucket_wall.items()}
+        return {b: float(np.percentile(w, 95))
+                for b, w in sorted(snap.items()) if w.size >= 3}
+
+    def predict_latency(self, n_rows: int) -> float | None:
+        """Predicted submit→response seconds for an ``n_rows`` request
+        arriving NOW: the deadline window the batcher may hold it, plus
+        the predicted execute walls of the rows already queued ahead of
+        it, plus its own bucket pieces — all read from the learned
+        :meth:`bucket_cost` model.  Returns None when any needed bucket
+        has no model yet (an admission layer must not shed on
+        ignorance)."""
+        costs = self.bucket_cost()
+        with self._cv:
+            backlog = self._queued_rows
+        predicted = self.deadline_s
+
+        def _pieces_cost(total: int) -> float | None:
+            acc = 0.0
+            for size in split_rows(int(total), self.buckets):
+                c = costs.get(bucket_for(size, self.buckets))
+                if c is None:
+                    return None
+                acc += c
+            return acc
+
+        for total in (backlog, int(n_rows)):
+            if total:
+                c = _pieces_cost(total)
+                if c is None:
+                    return None
+                predicted += c
+        return predicted
 
     # -- accounting ----------------------------------------------------------
 
@@ -401,6 +456,8 @@ class PredictServer:
             "queue_depth": depth,
             "queued_rows": queued_rows,
             "shed": shed,
+            "bucket_cost_ms": {b: round(1e3 * c, 4)
+                               for b, c in self.bucket_cost().items()},
             "tenants": tenants,
             "swaps": self._pool.adoptions if self._pool is not None
             else None,

@@ -28,14 +28,47 @@ is never re-transferred per batch (counter-asserted).
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
 from dislib_tpu.serving.buckets import BucketTemplate
 from dislib_tpu.runtime import fetch as _fetch
+from dislib_tpu.utils import profiling as _prof
 
 __all__ = ["SparseFoldInPipeline", "pack_sparse_rows"]
 
 _COL_ID_CEIL = 1 << 24        # float32 carries integers exactly below this
+
+
+@partial(_prof.profiled_jit, name="pack_sparse_rows",
+         static_argnames=("nse_cap",))
+def _pack_rows(dense, nse_cap):
+    # device-side [cols | vals] encode of a dense (k, n_items) request
+    # block.  The top_k key ranks observed columns DESCENDING by
+    # (n_items - col), i.e. ascending by column id — CSR order — with
+    # unobserved slots keyed 0 so they sort last.  The per-row observed
+    # count rides as ONE extra packed column (exact in float32 below
+    # 2^24) so the host pays a single fetch for data + overflow check.
+    import jax.numpy as jnp
+    from jax import lax
+    from dislib_tpu.ops import precision as px
+    n_items = dense.shape[1]
+    observed = dense != 0
+    col = lax.broadcasted_iota(jnp.int32, dense.shape, 1)
+    key = jnp.where(observed, n_items - col, 0)
+    kk = min(int(nse_cap), int(n_items))
+    topkey, pos = lax.top_k(key, kk)
+    valid = topkey > 0
+    cols = jnp.where(valid, pos, 0)
+    vals = jnp.where(valid, jnp.take_along_axis(dense, pos, axis=1), 0)
+    if kk < int(nse_cap):
+        padw = ((0, 0), (0, int(nse_cap) - kk))
+        cols = jnp.pad(cols, padw)
+        vals = jnp.pad(vals, padw)
+    counts = jnp.sum(observed, axis=1).astype(jnp.int32)
+    return jnp.concatenate(
+        [px.f32(cols), px.f32(vals), px.f32(counts)[:, None]], axis=1)
 
 
 def pack_sparse_rows(rows, nse_cap, n_items=None):
@@ -45,10 +78,36 @@ def pack_sparse_rows(rows, nse_cap, n_items=None):
     unobserved).  Returns the (k, 2·nse_cap) float32 request block a
     :class:`PredictServer` over a :class:`SparseFoldInPipeline`
     accepts.  A user with more than ``nse_cap`` observed ratings is a
-    typed error (pick the cap at deployment like a bucket ladder)."""
+    typed error (pick the cap at deployment like a bucket ladder).
+
+    The dense-ndarray path packs ON DEVICE — one jitted dispatch
+    (``pack_sparse_rows`` counter), one blessed fetch — so request
+    encode rides the same transfer discipline as the serve kernels;
+    scipy/pair inputs are host metadata and pack in a host loop."""
     import scipy.sparse as sp
     if isinstance(rows, np.ndarray):
-        rows = sp.csr_matrix(np.atleast_2d(np.asarray(rows, np.float32)))
+        import jax
+        import jax.numpy as jnp
+        dense = np.atleast_2d(np.asarray(rows, np.float32))
+        if dense.shape[1] >= _COL_ID_CEIL:
+            raise ValueError("item ids ≥ 2^24 don't ride float32 exactly")
+        if n_items is not None and dense.shape[1] > n_items:
+            bad = np.nonzero((dense[:, n_items:] != 0).any(axis=1))[0]
+            if bad.size:
+                raise ValueError(
+                    f"request row {int(bad[0])}: item ids out of range")
+            dense = dense[:, :n_items]
+        packed = _pack_rows(jax.device_put(jnp.asarray(dense)),
+                            nse_cap=int(nse_cap))
+        host = _fetch(packed)               # ONE fused pack dispatch
+        counts = host[:, -1].astype(np.int64)
+        over = np.nonzero(counts > int(nse_cap))[0]
+        if over.size:
+            i = int(over[0])
+            raise ValueError(
+                f"request row {i} has {int(counts[i])} observed ratings > "
+                f"nse_cap={nse_cap} — raise the pipeline's cap")
+        return np.ascontiguousarray(host[:, :-1])
     if sp.issparse(rows):
         csr = rows.tocsr()
         pairs = [(csr.indices[csr.indptr[i]:csr.indptr[i + 1]],
@@ -76,6 +135,33 @@ def pack_sparse_rows(rows, nse_cap, n_items=None):
         out[i, :k] = cols                   # ndarray assignment casts
         out[i, nse_cap:nse_cap + k] = vals
     return out
+
+
+@partial(_prof.profiled_jit, name="als_fold_in_serve",
+         static_argnames=("lambda_", "n_f", "policy", "top_n"))
+def _fold_in_serve(buf, items, lambda_, n_f, policy, top_n=0):
+    # the bundle-capture variant of `als._als_fold_in_packed`: same
+    # split → solve → predict body, but ONE output array (the bundle
+    # path's single-leaf response contract) — [ids | scores] rows when
+    # ranking, the full score matrix otherwise.
+    import jax.numpy as jnp
+    from dislib_tpu.ops import precision as px
+    from dislib_tpu.ops.base import precise
+    from dislib_tpu.recommendation.als import _fold_in_body
+
+    @precise
+    def body(buf, items):
+        s = buf.shape[1] // 2
+        cols = buf[:, :s].astype(jnp.int32)
+        vals = buf[:, s:]
+        _, preds = _fold_in_body(vals, cols, items, lambda_, n_f, policy,
+                                 top_n=top_n)
+        if top_n:
+            ids, scores = preds
+            return jnp.concatenate([px.f32(ids), px.f32(scores)], axis=1)
+        return preds
+
+    return body(buf, items)
 
 
 class SparseFoldInPipeline:
@@ -165,3 +251,37 @@ class SparseFoldInPipeline:
             host = _fetch(preds)            # force: ONE fused dispatch
         self.out_cols = int(host.shape[1])
         return host[: rows.shape[0]]
+
+    # -- deployment-bundle capture ------------------------------------------
+
+    def capture_bucket(self, bucket: int) -> dict:
+        """AOT-capture this bucket's fold-in program for
+        :func:`~dislib_tpu.serving.bundle.export_bundle` WITHOUT
+        executing it: ``lower().compile()`` the single-output serve
+        kernel on a placeholder request canvas and serialize the
+        compiled executable.  The leaves are the placeholder (the input
+        slot) plus the frozen item factors — the bundle carries the
+        model, so a fresh process serves sparse fold-in with zero
+        retraces through the standard ``load_bundle`` path."""
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental.serialize_executable import serialize
+        placeholder = jax.device_put(
+            jnp.asarray(np.zeros((int(bucket), self.n_features),
+                                 np.float32)))
+        (items,) = self.model._predict_leaves(self.model.items_)
+        top_n = int(self.top_n or 0)
+        # .lower counts a trace, never a dispatch (profiled_jit contract)
+        compiled = _fold_in_serve.lower(
+            placeholder, items, float(self.model.lambda_),
+            int(self.model.n_f), self.policy, top_n=top_n).compile()
+        payload, _in_tree, out_tree = serialize(compiled)
+        out_cols = 2 * top_n if top_n else int(self.model.items_.shape[0])
+        return {
+            "payload": np.frombuffer(payload, np.uint8),
+            "leaves": [placeholder, jnp.asarray(items)],
+            "input_slot": 0,
+            "n_outs": out_tree.num_leaves,
+            "out_cols": out_cols,
+            "pshape": [int(bucket), int(self.n_features)],
+        }

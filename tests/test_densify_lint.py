@@ -29,6 +29,10 @@ SCANNED_DIRS = (
     "dislib_tpu/model_selection",
     "dislib_tpu/preprocessing",
     "dislib_tpu/serving",
+    # round-18: the IVF retrieval tier — its sharded list buffers are
+    # the ShardedSparse pad discipline; densifying them would be the
+    # exact regression this lint guards
+    "dislib_tpu/retrieval",
 )
 
 # (file, enclosing function) pairs allowed to densify, with reasons:
@@ -105,7 +109,10 @@ def test_sparse_fit_and_serve_paths_scanned():
     scanned = {rel for rel, _ in _scanned_files()}
     for f in ("dislib_tpu/recommendation/als.py",
               "dislib_tpu/serving/sparse.py",
-              "dislib_tpu/cluster/kmeans.py"):
+              "dislib_tpu/cluster/kmeans.py",
+              # round-18 retrieval tier
+              "dislib_tpu/retrieval/ivf.py",
+              "dislib_tpu/retrieval/serving.py"):
         assert f in scanned, f"{f} escaped the densify lint"
 
 

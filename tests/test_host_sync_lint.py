@@ -43,6 +43,10 @@ ESTIMATOR_DIRS = (
     # overlap, pallas_kernels) — a host sync inside a panel loop would
     # serialize the very schedule the overlap PR exists to pipeline
     "dislib_tpu/ops",
+    # round-18: the IVF retrieval tier — every list length is
+    # host-computed at build; a device sync deciding a shape in the
+    # search path would kill the one-dispatch contract
+    "dislib_tpu/retrieval",
 )
 
 # single FILES scanned alongside the dirs — round-14: the sparse storage

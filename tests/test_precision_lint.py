@@ -40,6 +40,10 @@ KERNEL_DIRS = (
     # scatter / Pallas one-hot GEMM) — its home must route every compute
     # dtype through ops/precision like the other kernel tiers
     "dislib_tpu/trees",
+    # round-18: the IVF search kernel spells its own distance
+    # contractions (centroid GEMM, probed-list einsum) — routed through
+    # ops/precision like every other kernel tier
+    "dislib_tpu/retrieval",
 )
 
 # single FILES scanned alongside the dirs (their siblings are host
@@ -135,7 +139,10 @@ def test_overlap_kernel_files_are_in_the_scanned_set():
               "dislib_tpu/ops/spmm.py",
               "dislib_tpu/recommendation/als.py",
               "dislib_tpu/data/sparse.py",
-              "dislib_tpu/serving/sparse.py"):
+              "dislib_tpu/serving/sparse.py",
+              # round-18 retrieval tier
+              "dislib_tpu/retrieval/ivf.py",
+              "dislib_tpu/retrieval/serving.py"):
         assert f in scanned, f"{f} escaped the precision lint"
 
 

@@ -6,6 +6,11 @@
 # metric row — so the long-owed chip refresh (stale since PR 5) is a
 # single command on real hardware.
 #
+# Round 18 adds the ann tier (bench_ann): IVF-ANN search vs the exact
+# kneighbors ring — recall@10 >= 0.95 AND >= 3x speedup, one dispatch /
+# zero transfers counter-asserted (DSLIB_ANN_RECALL_MIN /
+# DSLIB_ANN_SPEEDUP_MIN override the floors).
+#
 # Usage:  tools/bench_chip.sh [OUT_JSON] [ROUND_N]
 #         OUT_JSON defaults to BENCH_r06.json, ROUND_N to the digits in
 #         OUT_JSON's name.

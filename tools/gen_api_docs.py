@@ -89,7 +89,9 @@ SECTIONS = [
     ("Bundle I/O (checksummed artifact seam)", "dislib_tpu.runtime",
      ["write_bundle", "read_bundle", "BundleIncompatible"]),
     ("Multi-tenant routing", "dislib_tpu.serving",
-     ["ModelRouter", "TenantQuotaExceeded"]),
+     ["ModelRouter", "TenantQuotaExceeded", "DeadlineShed"]),
+    ("Vector retrieval (IVF-ANN search tier)", "dislib_tpu.retrieval",
+     ["IVFIndex", "RetrievalPipeline"]),
     ("Continuous-learning trainer (train → bundle → canary → promote)",
      "dislib_tpu.runtime",
      ["ContinuousTrainer", "PromotionFailed"]),
