@@ -881,6 +881,123 @@ def bench_rechunk(m, n, tag, panels=4, min_gbps=0.02, peak_ratio_max=1.5):
     return res
 
 
+def bench_dcn(m, n, tag, mock_hosts=4, panels=4):
+    """Hierarchical DCN-aware rechunk tier (round 19, ROADMAP item 2):
+    the ``dcn`` schedule resharding an (m, n) ds-array between two
+    hierarchical 2-D layouts of the same devices, judged on its ANALYTIC
+    inter-host accounting (the ``spmm_masking_work`` exposure pattern) —
+    counters and bytes, not prose.  ``DSLIB_MOCK_HOSTS`` partitions this
+    process's devices into ``mock_hosts`` fake hosts so the whole
+    protocol runs single-process (chip runs use real ``process_index``
+    host maps and take the same code path).
+
+    Gates (all fail the config loudly):
+    - result BIT-EQUAL to the flat ``panels`` schedule (same relayout);
+    - coalesced: inter-host messages per step <= hosts-1 — O(hosts),
+      NOT O(panels) — and strictly fewer total DCN messages than the
+      flat panel exchange on the same topology
+      (``dcn_messages < flat_messages``);
+    - no write amplification: ``dcn_bytes_moved`` <= the deviceput
+      floor (the rows-whose-host-changes bytes ANY schedule must move);
+    - the router actually ran the hierarchical tier (schedule counter
+      ``rechunk_dcn``) and auto-routing picks it on a multi-host mesh;
+    - the relayout genuinely crosses hosts (``dcn_messages > 0``) — a
+      config whose padded row intervals align proves nothing.
+    """
+    import jax
+    import dislib_tpu as ds
+    from dislib_tpu.ops import rechunk as _rc
+    from dislib_tpu.parallel import mesh as _mesh
+    from dislib_tpu.utils import profiling as _prof
+
+    prev = os.environ.get("DSLIB_MOCK_HOSTS")
+    os.environ["DSLIB_MOCK_HOSTS"] = str(mock_hosts)
+    try:
+        ndev = len(jax.devices())
+        if ndev % (2 * mock_hosts):
+            raise RuntimeError(
+                f"dcn bench needs a device count divisible by "
+                f"2*mock_hosts={2 * mock_hosts}, have {ndev}")
+        src, dst = (ndev, 1), (ndev // 2, 2)
+        rng = np.random.RandomState(0)
+        x_host = rng.rand(m, n).astype(np.float32)
+        ds.init(src)
+        a = ds.array(x_host).force()
+        ds.init(dst)
+
+        acct = _rc.dcn_accounting(a._data, a.shape, _mesh.get_mesh(),
+                                  panels=panels)
+        hosts = acct["hosts"]
+        assert hosts == mock_hosts, \
+            f"mock host map bled: {hosts} hosts, wanted {mock_hosts}"
+        assert acct["dcn_messages"] > 0, (
+            f"vacuous config: m={m} pads identically under {src} and "
+            f"{dst} — no rows change host, pick a misaligning m")
+        assert acct["messages_per_step_max"] <= hosts - 1, (
+            f"NOT coalesced: {acct['messages_per_step_max']} messages in "
+            f"one step exceeds hosts-1={hosts - 1} — O(panels) leak")
+        assert acct["dcn_messages"] < acct["flat_messages"], (
+            f"hierarchical schedule sends {acct['dcn_messages']} DCN "
+            f"messages, the flat exchange only {acct['flat_messages']}")
+        assert acct["dcn_bytes_moved"] <= acct["deviceput_bytes"], (
+            f"write amplification: {acct['dcn_bytes_moved']} DCN bytes "
+            f"exceed the {acct['deviceput_bytes']} deviceput floor")
+
+        # correctness gate: bit-equal to the flat panel schedule, and the
+        # router counted the hierarchical tier (+ auto picks it here)
+        _prof.reset_counters()
+        out_dcn = ds.rechunk(a, schedule="dcn", panels=panels)
+        scheds = _prof.schedule_counters()
+        ran = sum(v for k, v in scheds.items()
+                  if k.startswith("rechunk_dcn:"))
+        assert ran == 1, f"rechunk_dcn not counted exactly once: {scheds}"
+        out_flat = ds.rechunk(a, schedule="panels", panels=panels)
+        np.testing.assert_array_equal(np.asarray(out_dcn._data),
+                                      np.asarray(out_flat._data),
+                                      err_msg="dcn != panels (bit-equal "
+                                              "gate)")
+        auto = _rc.pick_schedule(a._data, _mesh.get_mesh(), "auto")
+        assert auto == "dcn", \
+            f"auto-routing picked {auto!r} on a {hosts}-host mesh"
+
+        def run(schedule):
+            y = ds.rechunk(a, schedule=schedule, panels=panels)
+            _sync(y._data)
+
+        run("dcn")
+        t = _median_time(lambda: run("dcn"))
+        t_flat = _median_time(lambda: run("panels"))
+        moved = (int(np.prod(a._pshape))
+                 + int(np.prod(out_dcn._pshape))) * 4
+        return {"metric": f"dcn_rechunk_{tag}_gb_per_sec (baseline: flat "
+                          "panel exchange, same relayout)",
+                "value": round(moved / t / 1e9, 3), "unit": "GB/s",
+                "vs_baseline": round(t_flat / t, 2),
+                "wall_s": round(t, 5), "flat_wall_s": round(t_flat, 5),
+                "mesh_src": list(src), "mesh_dst": list(dst),
+                "hosts": hosts,
+                "dcn_messages": acct["dcn_messages"],
+                "flat_messages": acct["flat_messages"],
+                "messages_per_step_max": acct["messages_per_step_max"],
+                "messages_per_step_bound": hosts - 1,
+                "dcn_bytes_moved": acct["dcn_bytes_moved"],
+                "deviceput_bytes": acct["deviceput_bytes"],
+                "steps": acct["steps"], "panels": acct["panels"],
+                "note": "gates: bit-equal to the flat panel schedule, "
+                        "messages/step <= hosts-1 (coalesced, O(hosts) "
+                        "not O(panels)), dcn_messages < flat_messages, "
+                        "dcn_bytes <= deviceput floor, rechunk_dcn "
+                        "counted, auto-routing picks dcn multi-host; "
+                        "mock-host overlay (DSLIB_MOCK_HOSTS) — wall "
+                        "clock is intra-process, accounting is the "
+                        "evidence"}
+    finally:
+        if prev is None:
+            os.environ.pop("DSLIB_MOCK_HOSTS", None)
+        else:
+            os.environ["DSLIB_MOCK_HOSTS"] = prev
+
+
 def bench_overlap(kind, m, n, tag, hidden_floor=0.0, panels=4, repeats=9):
     """Comm–compute overlap tier (round-13 PR): how much of the panel
     collective does the double-buffered schedule actually hide under
@@ -2750,6 +2867,10 @@ def _configs():
             # round-11 rechunk tier: collective reshard, memory-bounded
             ("rechunk_smoke", lambda: bench_rechunk(2048, 256, "smoke",
                                                     min_gbps=0.02)),
+            # round-19 DCN tier: hierarchical rechunk under the mock
+            # host map — coalesced messages O(hosts) + bytes == deviceput
+            # floor + bit-equal to the flat exchange, all counter-gated
+            ("dcn_smoke", lambda: bench_dcn(2050, 96, "smoke")),
             # round-13 overlap tier: comm-hidden fraction per panel
             # schedule, db==seq bit-equal + 1-dispatch + memory-bounded
             # gated in-config.  Floors are rig-calibrated (the bf16
@@ -2860,6 +2981,13 @@ def _configs():
         # operand between 2-D layouts, peak-live proxy <= 1.5x gated
         ("rechunk_16384x2048_gb_per_sec",
          lambda: bench_rechunk(16384, 2048, "16384x2048", min_gbps=0.2)),
+        # round-19 DCN tier at paper scale: the hierarchical schedule's
+        # accounting gates (messages O(hosts), bytes == deviceput floor)
+        # under the mock host map; m chosen so the two layouts pad
+        # DIFFERENTLY (aligned pads would mean zero cross-host rows and
+        # a vacuous run — the tier rejects that loudly)
+        ("dcn_rechunk_16500x2048_gb_per_sec",
+         lambda: bench_dcn(16500, 2048, "16500x2048")),
         # round-13 overlap tier at paper scale: on real ICI the
         # double-buffered schedule must hide a strictly positive
         # fraction of the panel collective (floor 0.0, armed) —
@@ -2979,7 +3107,8 @@ def _run_one(name):
     # the parent's skip-and-continue and two-timeouts-abort paths)
     if name in os.environ.get("DSLIB_BENCH_FAKE_HANG", "").split(","):
         time.sleep(10_000)
-    if name.startswith(("summa", "rechunk", "overlap", "sparse", "ann")) \
+    if name.startswith(("summa", "rechunk", "overlap", "sparse", "ann",
+                        "dcn")) \
             and os.environ.get("BENCH_SMOKE") \
             and (_smoke_wants_cpu()
                  or "cpu" in os.environ.get("JAX_PLATFORMS", "")):

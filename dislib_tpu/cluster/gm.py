@@ -131,6 +131,8 @@ class GaussianMixture(BaseEstimator):
             increasing=True,            # EM lower bound must not fall
             carry_names=("weights", "means", "covariances"),
             carry_shapes=((self.n_components,), (self.n_components, n)),
+            snapshot_expect={"weights": (self.n_components,),
+                             "means": (self.n_components, n)},
             elastic=_fitloop.data_rebind(box))
 
         def init(rem):
@@ -148,14 +150,10 @@ class GaussianMixture(BaseEstimator):
             box["reg_covar"] = float(self.reg_covar) * rem.damping
             box["resp0"] = jnp.zeros((box["x"]._data.shape[0],
                                       self.n_components), jnp.float32)
+            # weights/means compatibility is declared via snapshot_expect
+            # and judged by the rollback funnel
             ov = tuple(jnp.asarray(rem.perturb(snap[k])) for k in
                        ("weights", "means", "covariances"))
-            want = (self.n_components, n)
-            if ov[1].shape != want:
-                raise ValueError(
-                    f"checkpoint means shape {ov[1].shape} does not "
-                    f"match this estimator/data {want} — stale or foreign "
-                    "snapshot")
             box["lb"] = float(snap["lower_bound"])
             return _fitloop.LoopState(ov, it=int(snap["n_iter"]),
                                       done=bool(snap.get("converged", False)))

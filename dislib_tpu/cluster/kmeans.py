@@ -150,6 +150,7 @@ class KMeans(BaseEstimator):
             "kmeans", checkpoint=checkpoint, health=health,
             max_iter=self.max_iter, carry_names=("centers",),
             carry_shapes=((self.n_clusters, x.shape[1]),),
+            snapshot_expect={"centers": (self.n_clusters, x.shape[1])},
             elastic=_fitloop.data_rebind(box))
 
         def init(rem):
@@ -158,12 +159,9 @@ class KMeans(BaseEstimator):
                 (jnp.asarray(rem.perturb(self._init_centers(box["x"]))),))
 
         def restore(snap, rem):
+            # snapshot compatibility (centers shape) is declared via
+            # snapshot_expect and judged by the rollback funnel
             centers = np.asarray(snap["centers"])
-            want = (self.n_clusters, x.shape[1])
-            if centers.shape != want:
-                raise ValueError(
-                    f"checkpoint centers shape {centers.shape} does not match "
-                    f"this estimator/data {want} — stale or foreign snapshot")
             # a faulted chunk's inertia must not leak into the fitted
             # attrs if the restored state exits the loop (converged
             # snapshot): None falls back to -score(x)

@@ -109,6 +109,10 @@ class MiniBatchKMeans(KMeans):
         # under the pre-shrink mesh re-lay out on device at ingest
         batch["x"] = xb if loop.info["mesh_shrinks"] == 0 \
             else _ensure_canonical(xb)
+        # re-declared per batch: THIS batch's width defines what a
+        # compatible snapshot looks like (the rollback funnel judges it)
+        loop.snapshot_expect = {"centers": (self.n_clusters, xb.shape[1]),
+                                "counts": (self.n_clusters,)}
 
         def init(rem):
             centers = jnp.asarray(
@@ -117,13 +121,9 @@ class MiniBatchKMeans(KMeans):
                 (centers, jnp.zeros((self.n_clusters,), jnp.float32)))
 
         def restore(snap, rem):
+            # centers/counts compatibility is declared via
+            # loop.snapshot_expect and judged by the rollback funnel
             centers = np.asarray(snap["centers"])
-            want = (self.n_clusters, xb.shape[1])
-            if centers.shape != want:
-                raise ValueError(
-                    f"checkpoint centers shape {centers.shape} does not "
-                    f"match this estimator/stream {want} — stale or "
-                    "foreign snapshot")
             return _fitloop.LoopState(
                 (jnp.asarray(rem.perturb(centers)),
                  jnp.asarray(rem.perturb(snap["counts"]))),

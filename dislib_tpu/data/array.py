@@ -1132,15 +1132,19 @@ def rechunk(x: Array, new_blocks=None, mesh=None, *, schedule="auto",
       current hint).
     - ``mesh``: target :class:`jax.sharding.Mesh`; ``None`` = the library
       default mesh.
-    - ``schedule``: ``"auto"`` | ``"xla"`` | ``"panels"`` |
+    - ``schedule``: ``"auto"`` | ``"xla"`` | ``"panels"`` | ``"dcn"`` |
       ``"deviceput"`` (see :mod:`dislib_tpu.ops.rechunk`;
       ``DSLIB_RECHUNK_SCHEDULE`` overrides auto).  Under auto, an
       already-canonical backing is a metadata-only no-op; a same-layout
       quantum change joins the dispatch-fusion graph (a mid-chain
       rechunk costs ZERO extra dispatches); a mesh-layout change over
       the same devices runs the explicit masked-psum panel exchange in
-      ONE jitted program with peak in-flight bytes ≈ |array| / panels;
-      a device-set change uses the runtime's device-to-device copy.
+      ONE jitted program with peak in-flight bytes ≈ |array| / panels
+      — on a MULTI-HOST device grid auto picks ``"dcn"``, the
+      hierarchical variant that coalesces each host's contribution into
+      at most ``hosts - 1`` inter-host messages per step (round-19
+      DCN data-plane PR; ``dcn_accounting`` itemizes the traffic) —
+      and a device-set change uses the runtime's device-to-device copy.
     - ``panels``: in-flight panel count for the collective schedule
       (default ``DSLIB_RECHUNK_PANELS`` = 4).
     - ``overlap``: the panel exchange's loop schedule — ``"db"``

@@ -58,6 +58,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dislib_tpu.ops import overlap as _ov
+from dislib_tpu.parallel import hosts as _hosts
 from dislib_tpu.parallel import mesh as _mesh
 from dislib_tpu.utils import profiling as _prof
 from dislib_tpu.utils.profiling import profiled_jit as _pjit
@@ -66,9 +67,14 @@ __all__ = [
     "requantize_body", "repad_axis", "panel_rechunk", "panel_grow_rechunk",
     "deviceput_rechunk", "reshard", "panel_memory_analysis",
     "panel_comm_probe", "reshard_sparse", "pick_sparse_schedule",
+    "dcn_rechunk", "dcn_supported", "dcn_accounting",
 ]
 
-SCHEDULES = ("auto", "xla", "panels", "deviceput")
+SCHEDULES = ("auto", "xla", "panels", "deviceput", "dcn")
+
+# the hierarchical schedule's outer mesh axis: whole-row blocks of the
+# source mesh grouped by owning host (parallel.hosts.host_blocks)
+_HOSTS = "hosts"
 
 
 def _padded_dim(n: int, quantum: int) -> int:
@@ -341,24 +347,50 @@ def panel_rechunk(data, logical_shape, dst_mesh, panels=None, overlap=None):
         out_pshape, NamedSharding(dst_mesh, P(*_mesh.AXIS_NAMES)), bufs)
 
 
+def _grow_assignment(src_mesh: Mesh, dst_mesh: Mesh):
+    """Which source device assembles each destination block, and in
+    which output slot: ``assign[t] = (q, i)`` maps destination flat
+    index ``t`` to slot ``q`` of source flat index ``i``.  Blocks are
+    handed out round-robin WITHIN each host — a destination device's
+    block is always assembled by a source device on ITS host, so the
+    placement put rides ICI and never DCN (the cross-host grow rung:
+    the panel collectives already moved the data between hosts).  On a
+    single host this reduces exactly to the global round-robin
+    ``t = i + q * n_src``.  Returns ``(assign, slots)``."""
+    src_flat = list(src_mesh.devices.flat)
+    dst_flat = list(dst_mesh.devices.flat)
+    src_by_host: dict[int, list[int]] = {}
+    for i, d in enumerate(src_flat):
+        src_by_host.setdefault(_hosts.host_of(d), []).append(i)
+    taken = {h: 0 for h in src_by_host}
+    assign: list[tuple[int, int]] = []
+    for d in dst_flat:
+        h = _hosts.host_of(d)
+        owners = src_by_host.get(h)
+        if owners is None:
+            # no source shard on this host (panel_grow_supported refused
+            # this layout); keep a defined mapping for robustness
+            owners = list(range(len(src_flat)))
+            h = None
+            taken.setdefault(None, 0)
+        k = taken[h]
+        taken[h] = k + 1
+        assign.append((k // len(owners), owners[k % len(owners)]))
+    slots = 1 + max(q for q, _ in assign)
+    return assign, slots
+
+
 def _grow_coord_tables(src_mesh: Mesh, dst_mesh: Mesh):
     """Per-(slot, source-linear-index) target (row, col) coordinates for
-    the GROW exchange: source device ``i`` assembles the target block of
-    destination flat index ``i + q * n_src`` in slot ``q`` (round-robin,
-    so the ``ceil(n_dst / n_src)`` extra blocks spread evenly over the
-    source devices).  An out-of-range slot duplicates block (0, 0) — the
-    rewrap drops it."""
+    the GROW exchange, from the host-aware :func:`_grow_assignment`.
+    An unused slot duplicates block (0, 0) — the rewrap drops it."""
+    assign, slots = _grow_assignment(src_mesh, dst_mesh)
     n_src = int(src_mesh.devices.size)
-    n_dst = int(dst_mesh.devices.size)
     cols_d = int(dst_mesh.devices.shape[1])
-    slots = -(-n_dst // n_src)
     tr = np.zeros((slots, n_src), np.int32)
     tc = np.zeros((slots, n_src), np.int32)
-    for q in range(slots):
-        for i in range(n_src):
-            t = i + q * n_src
-            if t < n_dst:
-                tr[q, i], tc[q, i] = divmod(t, cols_d)
+    for t, (q, i) in enumerate(assign):
+        tr[q, i], tc[q, i] = divmod(t, cols_d)
     return tr, tc
 
 
@@ -455,9 +487,12 @@ def panel_grow_supported(data, dst_mesh) -> bool:
     """True when the grow-direction panel exchange can run: the source
     backing passes the same NamedSharding/addressability/divisibility
     gates as :func:`panel_supported`, the target device set strictly
-    CONTAINS the source's (elastic grow-back), and every target device
-    is addressable from this process (the rewrap places one block per
-    new device)."""
+    CONTAINS the source's (elastic grow-back), and every target device's
+    HOST already holds a source shard — so each new device's block is
+    placed by an intra-host put (the cross-host rung: before round 19
+    this required ``dst ⊆ local_devices`` and degraded any multi-host
+    grow to per-array ``device_put``).  A host gaining devices without
+    a single surviving source shard falls back to deviceput."""
     sharding = getattr(data, "sharding", None)
     if not isinstance(sharding, NamedSharding):
         return False
@@ -473,8 +508,10 @@ def panel_grow_supported(data, dst_mesh) -> bool:
         return False
     src_devs = set(src_mesh.devices.flat)
     dst_devs = set(dst_mesh.devices.flat)
-    return src_devs < dst_devs and \
-        dst_devs <= set(jax.local_devices())
+    if not src_devs < dst_devs:
+        return False
+    src_hosts = {_hosts.host_of(d) for d in src_devs}
+    return all(_hosts.host_of(d) in src_hosts for d in dst_devs)
 
 
 def panel_grow_rechunk(data, logical_shape, dst_mesh, panels=None,
@@ -494,19 +531,18 @@ def panel_grow_rechunk(data, logical_shape, dst_mesh, panels=None,
     out_pshape = kw["out_pshape"]
     src_flat = list(kw["src_mesh"].devices.flat)
     dst_flat = list(dst_mesh.devices.flat)
-    n_src = len(src_flat)
+    assign, _slots = _grow_assignment(kw["src_mesh"], dst_mesh)
+    per_src = [{s.device: s.data for s in arr.addressable_shards}
+               for arr in outs]
     by_dev = {}
-    for q, arr in enumerate(outs):
-        per_src = {s.device: s.data for s in arr.addressable_shards}
-        for i, d_src in enumerate(src_flat):
-            t = i + q * n_src
-            if t >= len(dst_flat):
-                continue                # the duplicate (0, 0) filler slot
-            d_dst = dst_flat[t]
-            blk = per_src[d_src]
-            by_dev[d_dst] = blk if d_dst == d_src \
-                else jax.device_put(blk, d_dst)
-    bufs = [by_dev[d] for d in dst_flat]
+    for t, (q, i) in enumerate(assign):
+        d_src, d_dst = src_flat[i], dst_flat[t]
+        blk = per_src[q].get(d_src)
+        if blk is None:
+            continue                # another process's shard: it places it
+        by_dev[d_dst] = blk if d_dst == d_src \
+            else jax.device_put(blk, d_dst)
+    bufs = [by_dev[d] for d in dst_flat if d in by_dev]
     return jax.make_array_from_single_device_arrays(
         out_pshape, NamedSharding(dst_mesh, P(*_mesh.AXIS_NAMES)), bufs)
 
@@ -581,6 +617,261 @@ def panel_memory_analysis(data, logical_shape, dst_mesh, panels=None,
 
 
 # ---------------------------------------------------------------------------
+# the hierarchical DCN schedule (multi-host relayout over the same devices)
+#
+# The flat panel exchange broadcasts one panel per (source row-rank ×
+# panel) step along the FULL rows axis — on a mesh whose rows span
+# hosts, every one of those O(panels) broadcasts is an inter-host
+# message.  The ``dcn`` schedule restructures the loop hierarchically
+# (arXiv:2112.01075's few-large-collectives shape): the source mesh is
+# refactored as (hosts, local_rows, cols) and each step assembles ONE
+# panel of a DESTINATION host's row block — every source host's
+# contribution to that panel (the contiguous intersection of its row
+# interval with the panel's) coalesces into a single (src-host →
+# dst-host) message carried by one collective over the ('hosts', 'rows')
+# axes; the per-local-shard gathers and the cols broadcasts stay
+# intra-host (ICI).  Messages per step = O(hosts), never O(panels);
+# inter-host bytes = the interval intersections — exactly the bytes any
+# schedule must move (the deviceput baseline) — with both quantities
+# accounted analytically by :func:`dcn_accounting` (the
+# ``spmm_masking_work`` exposure pattern).  The assembled values are
+# pure selections of source entries, so the schedule is BIT-EQUAL to
+# ``panels``/``xla`` on any topology, including a single host (where it
+# degenerates to a pure-ICI exchange with zero DCN messages).
+# ---------------------------------------------------------------------------
+
+
+def dcn_supported(data, dst_mesh) -> bool:
+    """True when the hierarchical schedule can run: the same
+    NamedSharding/divisibility gates as :func:`panel_supported`, the SAME
+    device set on both meshes (relayout, not a device-set change), and a
+    hierarchical row axis on BOTH meshes — contiguous equal blocks of
+    whole rows per host (:func:`~dislib_tpu.parallel.hosts.host_blocks`),
+    so the cols axis and the local gathers never pay DCN."""
+    sharding = getattr(data, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return False
+    src_mesh = sharding.mesh
+    if not isinstance(src_mesh, Mesh) or \
+            tuple(src_mesh.axis_names) != _mesh.AXIS_NAMES:
+        return False
+    rows_s = src_mesh.shape[_mesh.ROWS]
+    cols_s = src_mesh.shape[_mesh.COLS]
+    if data.shape[0] % rows_s or data.shape[1] % cols_s:
+        return False
+    if set(dst_mesh.devices.flat) != set(src_mesh.devices.flat):
+        return False
+    return _hosts.host_blocks(src_mesh) is not None and \
+        _hosts.host_blocks(dst_mesh) is not None
+
+
+@partial(_pjit, static_argnames=("logical_shape", "out_pshape", "mesh3",
+                                 "dst_shape", "hblocks", "tr_key", "tc_key",
+                                 "steps", "overlap"),
+         name="rechunk_dcn")
+def _dcn_exchange(data, logical_shape, out_pshape, mesh3, dst_shape,
+                  hblocks, tr_key, tc_key, steps, overlap="db"):
+    """ONE jitted program: shard_map over the source mesh refactored as
+    ('hosts', 'rows', 'cols').  Step ``t`` assembles panel ``t % j`` of
+    destination host-block ``t // j``: every device contributes the
+    intersection of its row interval with the panel (a local gather),
+    and ONE ``psum`` over ``('hosts', 'rows')`` coalesces all
+    contributions — the batched inter-host exchange, one message per
+    (src-host, dst-host) pair per step.  The per-col-rank broadcasts and
+    the target-block gather are the flat exchange's, unchanged (and
+    intra-host by the ``dcn_supported`` row-alignment gate).  Runs
+    through ``ops/overlap.panel_pipeline`` like every panel loop."""
+    m, n = logical_shape
+    hosts_n = mesh3.shape[_HOSTS]
+    rows_l = mesh3.shape[_mesh.ROWS]        # local row-ranks per host
+    cols_s = mesh3.shape[_mesh.COLS]
+    rows_d, cols_d = dst_shape
+    m_loc1 = data.shape[0] // (hosts_n * rows_l)
+    n_loc1 = data.shape[1] // cols_s
+    m_loc2, n_loc2 = out_pshape[0] // rows_d, out_pshape[1] // cols_d
+    block_h = (rows_d // hblocks) * m_loc2  # dst host-block height (rows)
+    j = steps // hblocks                    # panels per dst host-block
+    hp = block_h // j                       # panel height (global rows)
+    tr_tab = jnp.asarray(np.asarray(tr_key, np.int32))
+    tc_tab = jnp.asarray(np.asarray(tc_key, np.int32))
+
+    def local(x_loc):
+        hh = lax.axis_index(_HOSTS)
+        rr = lax.axis_index(_mesh.ROWS)
+        my_c = lax.axis_index(_mesh.COLS)
+        my_lin = (hh * rows_l + rr) * cols_s + my_c
+        row0 = tr_tab[my_lin] * m_loc2      # my target block origin
+        col0 = tc_tab[my_lin] * n_loc2
+        ri = row0 + lax.iota(jnp.int32, m_loc2)   # global coords of my
+        ci = col0 + lax.iota(jnp.int32, n_loc2)   # target block entries
+        r0 = (hh * rows_l + rr) * m_loc1    # my SOURCE row interval start
+
+        def fetch(t, prev):
+            del prev                        # panels slice by step
+            g0 = (t // j) * block_h + (t % j) * hp
+            gi = g0 + lax.iota(jnp.int32, hp)     # panel's global rows
+            idx = jnp.clip(gi - r0, 0, m_loc1 - 1)
+            mine = x_loc[idx, :]
+            keep = (gi >= r0) & (gi < r0 + m_loc1)
+            pan = jnp.where(keep[:, None], mine, jnp.zeros((), mine.dtype))
+            # the coalesced exchange: every source host's contiguous
+            # contribution to this dst-host panel rides ONE collective
+            return lax.psum(pan, (_HOSTS, _mesh.ROWS))
+
+        def consume(t, acc, pan):
+            gr0 = (t // j) * block_h + (t % j) * hp
+            r_in = (ri >= gr0) & (ri < gr0 + hp)
+            r_idx = jnp.clip(ri - gr0, 0, hp - 1)
+            for s in range(cols_s):         # intra-host cols broadcasts
+                if cols_s > 1:
+                    blk = jnp.where(my_c == s, pan,
+                                    jnp.zeros((), pan.dtype))
+                    blk = lax.psum(blk, _mesh.COLS)
+                else:
+                    blk = pan
+                gc0 = s * n_loc1
+                c_in = (ci >= gc0) & (ci < gc0 + n_loc1)
+                c_idx = jnp.clip(ci - gc0, 0, n_loc1 - 1)
+                gathered = blk[r_idx][:, c_idx]
+                acc = jnp.where(r_in[:, None] & c_in[None, :],
+                                gathered, acc)
+            return acc
+
+        acc0 = lax.pcast(jnp.zeros((m_loc2, n_loc2), x_loc.dtype),
+                         (_HOSTS, _mesh.ROWS, _mesh.COLS), to="varying")
+        acc = _ov.panel_pipeline(steps, fetch(0, None), fetch, consume,
+                                 acc0, _ov.overlapped(overlap))
+        # re-assert the pad-and-mask invariant on the NEW canvas
+        keep = (ri < m)[:, None] & (ci < n)[None, :]
+        return jnp.where(keep, acc, jnp.zeros((), acc.dtype))
+
+    return jax.shard_map(
+        local, mesh=mesh3,
+        in_specs=P((_HOSTS, _mesh.ROWS), _mesh.COLS),
+        out_specs=P((_HOSTS, _mesh.ROWS), _mesh.COLS),
+        check_vma=True,
+    )(data)
+
+
+def _dcn_args(data, logical_shape, dst_mesh, panels, overlap=None):
+    """Static argument pack for :func:`_dcn_exchange`: the source mesh
+    refactored as ('hosts', 'rows', 'cols') from its host-block
+    structure, the destination host-block count, and the panel count
+    chosen as a divisor of the DST host-block height (panels subdivide
+    the inter-host steps; the knob is the same ``DSLIB_RECHUNK_PANELS``)."""
+    src_mesh = data.sharding.mesh
+    out_pshape = _out_pshape(logical_shape, dst_mesh)
+    h1, l1, _ = _hosts.host_blocks(src_mesh)
+    cols_s = src_mesh.shape[_mesh.COLS]
+    mesh3 = Mesh(src_mesh.devices.reshape(h1, l1, cols_s),
+                 (_HOSTS, _mesh.ROWS, _mesh.COLS))
+    h2, l2, _ = _hosts.host_blocks(dst_mesh)
+    rows_d = dst_mesh.shape[_mesh.ROWS]
+    m_loc2 = out_pshape[0] // rows_d
+    j = _panels_per_rank(l2 * m_loc2, _requested_panels(panels))
+    tr, tc = _target_coord_tables(src_mesh, dst_mesh)
+    return dict(logical_shape=tuple(int(s) for s in logical_shape),
+                out_pshape=out_pshape, mesh3=mesh3,
+                dst_shape=(rows_d, dst_mesh.shape[_mesh.COLS]),
+                hblocks=h2,
+                tr_key=tuple(int(v) for v in tr),
+                tc_key=tuple(int(v) for v in tc),
+                steps=h2 * j,
+                overlap=_ov.resolve(overlap))
+
+
+def dcn_rechunk(data, logical_shape, dst_mesh, panels=None, overlap=None):
+    """The hierarchical (DCN-aware) reshard: ONE jitted exchange over the
+    host-refactored source mesh, then the zero-copy rewrap onto
+    ``dst_mesh`` — :func:`panel_rechunk`'s contract with the collective
+    loop restructured so inter-host messages are O(hosts) per step (see
+    :func:`dcn_accounting` for the counted claim).  The rewrap places
+    this process's ADDRESSABLE shards only, so every process of a
+    multi-host job runs the same call on its view of the global array."""
+    kw = _dcn_args(data, logical_shape, dst_mesh, panels, overlap)
+    _prof.count_schedule("rechunk_dcn", kw["overlap"])
+    out = _dcn_exchange(data, **kw)
+    out_pshape = kw["out_pshape"]
+    by_dev = {s.device: s.data for s in out.addressable_shards}
+    bufs = [by_dev[d] for d in dst_mesh.devices.flat if d in by_dev]
+    return jax.make_array_from_single_device_arrays(
+        out_pshape, NamedSharding(dst_mesh, P(*_mesh.AXIS_NAMES)), bufs)
+
+
+def dcn_accounting(data, logical_shape, dst_mesh, panels=None) -> dict:
+    """Analytic inter-host traffic of the ``dcn`` schedule for this
+    relayout (host-side, no dispatch — the ``spmm_masking_work``
+    exposure pattern):
+
+    - ``dcn_messages`` / ``dcn_bytes_moved`` — coalesced (src-host →
+      dst-host) messages over the whole schedule and the bytes they
+      carry (each step's message per pair is the contiguous intersection
+      of the pair's row intervals with the step's panel);
+    - ``messages_per_step_max`` — the per-step gate: ≤ hosts − 1, never
+      a function of the panel count;
+    - ``deviceput_bytes`` — the bytes ANY schedule must move across
+      hosts (rows whose owning host changes), the bench floor;
+    - ``flat_messages`` / ``flat_bytes_moved`` — what the FLAT panel
+      exchange would cost on the same topology: every per-rank panel
+      broadcast crosses to every other host (O(panels) messages).
+    """
+    src_mesh = data.sharding.mesh
+    out_pshape = _out_pshape(logical_shape, dst_mesh)
+    h1, l1, hosts_src = _hosts.host_blocks(src_mesh)
+    h2, l2, hosts_dst = _hosts.host_blocks(dst_mesh)
+    rows_s = src_mesh.shape[_mesh.ROWS]
+    rows_d = dst_mesh.shape[_mesh.ROWS]
+    m_loc1 = data.shape[0] // rows_s
+    m_loc2 = out_pshape[0] // rows_d
+    block_h = l2 * m_loc2
+    j = _panels_per_rank(block_h, _requested_panels(panels))
+    hp = block_h // j
+    itemsize = data.dtype.itemsize
+    row_bytes = int(data.shape[1]) * itemsize
+    src_iv = [(b * l1 * m_loc1, (b + 1) * l1 * m_loc1) for b in range(h1)]
+    msgs = 0
+    bytes_moved = 0
+    per_step_max = 0
+    for d_blk in range(h2):
+        for p in range(j):
+            g0 = d_blk * block_h + p * hp
+            step_msgs = 0
+            for b in range(h1):
+                if hosts_src[b] == hosts_dst[d_blk]:
+                    continue            # intra-host: ICI, not DCN
+                ov = min(g0 + hp, src_iv[b][1]) - max(g0, src_iv[b][0])
+                if ov > 0:
+                    step_msgs += 1
+                    bytes_moved += ov * row_bytes
+            msgs += step_msgs
+            per_step_max = max(per_step_max, step_msgs)
+    # the floor: rows whose owning host changes must cross DCN once
+    # under ANY schedule (deviceput's XLA copy included)
+    dp_bytes = 0
+    for d_blk in range(h2):
+        d0, d1 = d_blk * block_h, (d_blk + 1) * block_h
+        for b in range(h1):
+            if hosts_src[b] == hosts_dst[d_blk]:
+                continue
+            ov = min(d1, src_iv[b][1]) - max(d0, src_iv[b][0])
+            if ov > 0:
+                dp_bytes += ov * row_bytes
+    all_hosts = len(set(hosts_src) | set(hosts_dst))
+    j_flat = _panels_per_rank(m_loc1, _requested_panels(panels))
+    flat_steps = rows_s * j_flat
+    in_bytes = int(data.shape[0]) * row_bytes
+    return {
+        "hosts": all_hosts, "steps": h2 * j, "panels": j,
+        "dcn_messages": msgs, "dcn_bytes_moved": bytes_moved,
+        "messages_per_step_max": per_step_max,
+        "deviceput_bytes": dp_bytes,
+        "flat_messages": flat_steps * max(0, all_hosts - 1),
+        "flat_bytes_moved": in_bytes * max(0, all_hosts - 1),
+        "in_bytes": in_bytes,
+    }
+
+
+# ---------------------------------------------------------------------------
 # device-set change: the runtime's device-to-device copy
 # ---------------------------------------------------------------------------
 
@@ -623,6 +914,9 @@ def pick_schedule(data, dst_mesh, schedule="auto") -> str:
     if isinstance(sharding, NamedSharding) and \
             sharding == _mesh.data_sharding(dst_mesh):
         return "xla"
+    if dcn_supported(data, dst_mesh) and \
+            _hosts.n_hosts(sharding.mesh) > 1:
+        return "dcn"                    # hierarchical: coalesce DCN traffic
     if panel_supported(data, dst_mesh) or panel_grow_supported(data, dst_mesh):
         return "panels"
     return "deviceput"
@@ -635,6 +929,15 @@ def reshard(data, logical_shape, dst_mesh, schedule="auto", panels=None,
     host for an on-device operand.  ``overlap`` picks the panel
     exchange's loop schedule (None → the ``DSLIB_OVERLAP`` router)."""
     sched = pick_schedule(data, dst_mesh, schedule)
+    if sched == "dcn":
+        if not dcn_supported(data, dst_mesh):
+            raise ValueError(
+                "schedule='dcn' needs same-device-set meshes whose row "
+                "axes both split into contiguous equal host blocks (the "
+                "hierarchical layout `distributed.initialize` documents); "
+                "use schedule='panels'/'deviceput' (or 'auto') otherwise")
+        return dcn_rechunk(data, logical_shape, dst_mesh, panels,
+                           overlap), sched
     if sched == "panels":
         if panel_supported(data, dst_mesh):
             return panel_rechunk(data, logical_shape, dst_mesh, panels,
@@ -710,6 +1013,10 @@ def pick_sparse_schedule(rep, dst_mesh, schedule="auto") -> str:
         if env not in SCHEDULES:
             raise ValueError(f"bad DSLIB_RECHUNK_SCHEDULE={env!r}")
         schedule = env
+    if schedule == "dcn":
+        # no hierarchical sparse tier yet: the dense coalescing story
+        # does not apply to the row-stream layout — take the panel path
+        schedule = "panels"
     if schedule != "auto":
         return schedule
     src = rep.mesh

@@ -7,9 +7,14 @@ from dislib_tpu.parallel.mesh import (
 from dislib_tpu.parallel.distributed import (
     initialize, is_initialized, process_info, shutdown,
 )
+from dislib_tpu.parallel.hosts import (
+    host_of, host_map, n_hosts, mock_hosts, row_hosts, host_blocks,
+)
 
 __all__ = [
     "ROWS", "COLS", "init", "get_mesh", "set_mesh", "mesh_shape",
     "pad_quantum", "data_sharding", "row_sharding", "replicated",
     "initialize", "is_initialized", "process_info", "shutdown",
+    "host_of", "host_map", "n_hosts", "mock_hosts", "row_hosts",
+    "host_blocks",
 ]

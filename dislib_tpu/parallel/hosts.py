@@ -1,0 +1,104 @@
+"""Host topology model — which devices share a process (ICI) and which
+pairs only reach each other over the data-center network (DCN).
+
+The paper-scale story (arXiv:2112.09017, 2048 cores) is multi-host: a
+mesh axis that spans processes pays DCN latency/bandwidth per collective
+hop, while the axis inside one host rides ICI.  Every DCN-aware schedule
+in the library (the ``dcn`` rechunk tier, the cross-host grow placement,
+the sharded-bundle mesh contract) needs the same two facts about a mesh:
+*which host owns each device* and *whether the row axis is hierarchical*
+— contiguous, equal-sized blocks of whole mesh rows per host, the layout
+``parallel.distributed`` documents (each host's local devices are
+contiguous in ``jax.devices()`` order).
+
+Real topology comes from ``device.process_index``.  Because this rig's
+tier-1 suite is single-process, ``DSLIB_MOCK_HOSTS=N`` overlays a mock
+map — the flat ``jax.devices()`` order partitioned into N contiguous
+groups — so every protocol decision (schedule routing, message
+accounting, shard placement) executes and is asserted in-process,
+exactly as it would across real processes.  The mock changes NO
+numerics: schedules stay bit-equal; only the collective structure and
+the accounting change.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["host_of", "host_map", "n_hosts", "mock_hosts", "row_hosts",
+           "host_blocks"]
+
+
+def mock_hosts() -> int | None:
+    """The ``DSLIB_MOCK_HOSTS`` overlay: partition the flat device order
+    into this many contiguous fake hosts (None = real topology)."""
+    raw = os.environ.get("DSLIB_MOCK_HOSTS")
+    if not raw:
+        return None
+    n = int(raw)
+    if n < 1:
+        raise ValueError(f"DSLIB_MOCK_HOSTS={raw!r}: need a positive count")
+    return n
+
+
+def host_of(device) -> int:
+    """The host (process) index owning ``device`` — the mock partition
+    when ``DSLIB_MOCK_HOSTS`` is set, else the device's real
+    ``process_index``."""
+    mock = mock_hosts()
+    if mock is None:
+        return int(getattr(device, "process_index", 0))
+    import jax
+    devs = jax.devices()
+    try:
+        i = devs.index(device)
+    except ValueError:
+        return int(getattr(device, "process_index", 0))
+    return i * mock // len(devs)
+
+
+def host_map(mesh) -> np.ndarray:
+    """Host index per mesh position (same shape as ``mesh.devices``)."""
+    return np.vectorize(host_of, otypes=[np.int64])(mesh.devices)
+
+
+def n_hosts(mesh) -> int:
+    """Distinct hosts under ``mesh`` (mock-aware)."""
+    return len(set(host_map(mesh).flat))
+
+
+def row_hosts(mesh):
+    """Per-mesh-row host index list when every row lives entirely on ONE
+    host, else None.  A row split across hosts means the 'cols' axis
+    would pay DCN — the hierarchical schedules refuse that layout."""
+    hm = host_map(mesh)
+    if hm.ndim != 2 or not (hm == hm[:, :1]).all():
+        return None
+    return [int(h) for h in hm[:, 0]]
+
+
+def host_blocks(mesh):
+    """``(n_blocks, rows_per_block, block_hosts)`` when the mesh's row
+    axis is HIERARCHICAL — contiguous, equal-sized blocks of whole rows,
+    one host per block (the ``distributed.initialize`` device order) —
+    else None.  ``block_hosts[b]`` is the host owning block ``b``."""
+    rh = row_hosts(mesh)
+    if rh is None:
+        return None
+    hosts: list[int] = []
+    for h in rh:
+        if not hosts or hosts[-1] != h:
+            if h in hosts:
+                return None             # host's rows are not contiguous
+            hosts.append(h)
+    n_blocks = len(hosts)
+    rows = len(rh)
+    if rows % n_blocks:
+        return None
+    per = rows // n_blocks
+    for b, h in enumerate(hosts):
+        if any(rh[b * per + k] != h for k in range(per)):
+            return None                 # unequal block sizes
+    return n_blocks, per, hosts

@@ -159,6 +159,12 @@ class ALS(BaseEstimator):
             max_iter=self.max_iter, carry_names=("users", "items"),
             carry_shapes=((x.shape[0], int(self.n_f)),
                           (x.shape[1], int(self.n_f))),
+            # snapshots carry the LOGICAL factor dims (m, n) as scalars;
+            # the stored factor ROWS may be padded for a different mesh
+            # (elastic resume re-pads), so only the factor width is pinned
+            snapshot_expect={"m": int(x.shape[0]), "n": int(x.shape[1]),
+                             "users": (None, int(self.n_f)),
+                             "items": (None, int(self.n_f))},
             elastic=rebind)
 
         def init(rem):
@@ -170,21 +176,11 @@ class ALS(BaseEstimator):
             return _fitloop.LoopState(())   # fresh: the kernel seeds itself
 
         def restore(snap, rem):
-            # snapshots carry the LOGICAL factor dims (m, n); the stored
-            # factor arrays may be padded for a different mesh — elastic
-            # resume re-pads them for THIS mesh (runtime.repad_rows)
-            if "m" not in snap or "users" not in snap:
-                raise ValueError(
-                    "checkpoint is missing the ALS factor state — stale "
-                    "or foreign snapshot")
+            # snapshot compatibility (logical dims + factor width) is
+            # declared via snapshot_expect and judged by the rollback
+            # funnel; elastic resume re-pads the factor rows for THIS
+            # mesh (runtime.repad_rows)
             sm, sn = int(snap["m"]), int(snap["n"])
-            if (sm, sn) != tuple(x.shape) or \
-                    snap["users"].shape[1:] != (int(self.n_f),):
-                raise ValueError(
-                    f"checkpoint factors (users {snap['users'].shape} "
-                    f"over ratings {(sm, sn)}) do not match this "
-                    f"estimator/data (ratings {tuple(x.shape)}, "
-                    f"n_f={self.n_f}) — stale or foreign snapshot")
             box["lam"] = float(self.lambda_) * rem.damping
             box["rmse"] = float(snap["rmse"])
             if sparse_in:

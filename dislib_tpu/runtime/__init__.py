@@ -27,6 +27,11 @@ the survival story is built from four pieces that compose (SURVEY §6
   artifact, plus the typed :class:`BundleIncompatible`
   (``bundle_io.py``; ``serving.bundle`` assembles the artifact, this
   module owns its bytes — serving code never touches them raw);
+- **coord** — the round-19 cross-process coordination seam: the named
+  ranked ``exchange`` primitive over three transports (in-memory /
+  shared directory / ``jax.distributed`` KV) behind the sharded-bundle
+  load barrier, plus the atomically-replaced :class:`CapacityLedger`
+  that makes the capacity level fleet-wide (``coord.py``);
 - **trainer** — the round-17 continuous-learning daemon:
   :class:`ContinuousTrainer` welds the quarantined stream, the chunked
   fit loop, retried bundle exports, and the router's canary/promote
@@ -43,8 +48,12 @@ from dislib_tpu.runtime import xla_flags  # noqa: F401
 from dislib_tpu.runtime import health  # noqa: F401
 from dislib_tpu.runtime.adoption import (Adoption, AdoptionRejected,
                                          adopt_latest, generation_token)
-from dislib_tpu.runtime.bundle_io import (BundleIncompatible, read_bundle,
+from dislib_tpu.runtime.bundle_io import (BundleIncompatible,
+                                          BundleShardCorrupt, read_bundle,
                                           write_bundle)
+from dislib_tpu.runtime.coord import (CapacityLedger, CoordinationTimeout,
+                                      FileCoordinator, KVCoordinator,
+                                      LocalCoordinator, get_coordinator)
 from dislib_tpu.runtime.elastic import AsyncFetch, fetch, repad_rows
 from dislib_tpu.runtime.health import (ChunkGuard, HealthPolicy,
                                        NumericalDivergence, WatchdogTimeout)
@@ -68,7 +77,10 @@ __all__ = [
     "repad_rows", "fetch", "AsyncFetch",
     "HealthPolicy", "ChunkGuard", "NumericalDivergence", "WatchdogTimeout",
     "Adoption", "AdoptionRejected", "adopt_latest", "generation_token",
-    "BundleIncompatible", "read_bundle", "write_bundle",
+    "BundleIncompatible", "BundleShardCorrupt", "read_bundle",
+    "write_bundle",
+    "CapacityLedger", "CoordinationTimeout", "get_coordinator",
+    "LocalCoordinator", "FileCoordinator", "KVCoordinator",
     "ChunkedFitLoop", "ChunkOutcome", "LoopState", "Escalation",
     "EscalationLadder",
     "ContinuousTrainer", "PromotionFailed",

@@ -33,13 +33,15 @@ from __future__ import annotations
 
 import os
 import tempfile
+import zlib
 
 import numpy as np
 
 from dislib_tpu.utils.checkpoint import (_CRC_KEY, _fsync_dir, _load_verified,
                                          _state_crc)
 
-__all__ = ["BundleIncompatible", "read_bundle", "write_bundle"]
+__all__ = ["BundleIncompatible", "BundleShardCorrupt", "read_bundle",
+           "write_bundle", "shard_path", "file_crc"]
 
 
 class BundleIncompatible(RuntimeError):
@@ -56,6 +58,39 @@ class BundleIncompatible(RuntimeError):
         super().__init__(message)
         self.expected = expected or {}
         self.found = found or {}
+
+
+class BundleShardCorrupt(RuntimeError):
+    """A SHARDED bundle failed its coordinated load barrier: some host's
+    shard is damaged, missing, or fails the manifest's per-shard
+    checksum — so NO host serves (round-19 contract: a fleet either
+    loads the whole bundle or none of it).  ``host`` is the rank whose
+    shard failed (-1 when unknown) and ``reason`` the shard-local
+    diagnosis; every participating process raises the same error."""
+
+    def __init__(self, message, host=-1, reason=""):
+        super().__init__(message)
+        self.host = int(host)
+        self.reason = str(reason)
+
+
+def shard_path(path: str, host: int) -> str:
+    """The per-host shard artifact for a sharded bundle rooted at
+    ``path`` (the manifest file): ``<path>.shard<host>``."""
+    return f"{path}.shard{int(host)}"
+
+
+def file_crc(path: str) -> int:
+    """CRC-32 over a file's raw bytes — the manifest's per-shard
+    integrity record, checked by every host at the load barrier (cheaper
+    than a full parse when deciding whether to even vote "ok")."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(chunk, crc)
 
 
 def write_bundle(path: str, arrays: dict) -> None:

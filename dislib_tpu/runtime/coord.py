@@ -1,0 +1,289 @@
+"""Cross-process coordination primitives (round-19 data plane).
+
+Two multi-host protocols in this library need hosts to AGREE on something
+small before any of them acts: the sharded-bundle load barrier (every
+host verifies its shard before ANY host serves) and the global capacity
+level (a fleet shrinks and grows coherently, not one process at a time).
+Both reduce to the same primitive — a named, ranked **exchange**: each
+participant posts one small JSON-serializable value under a name, then
+blocks until all ``n`` values are visible, and every participant returns
+the same ``{rank: value}`` dict.
+
+Three transports implement it, picked by :func:`get_coordinator`:
+
+- :class:`KVCoordinator` — the ``jax.distributed`` coordination
+  service's key-value store, when the process is part of an initialized
+  distributed runtime.  This is the production transport: the KV store
+  is platform-agnostic (it works on CPU rigs whose *collectives* are
+  unsupported — the coordination channel and the compute channel are
+  independent).
+- :class:`FileCoordinator` — a shared directory (``DSLIB_COORD_DIR``);
+  each post is an atomic tmp-write + rename, the gather polls.  The
+  transport for fleets coordinated through a shared filesystem and for
+  the two-process dryrun on rigs whose jaxlib predates multiprocess CPU.
+- :class:`LocalCoordinator` — in-memory, thread-safe; the single-process
+  default.  With the ``DSLIB_MOCK_HOSTS`` overlay, tier-1 tests drive
+  every rank of a protocol through one of these, so the barrier logic
+  itself is exercised on every run — not only on multi-host rigs.
+
+The **capacity ledger** (:class:`CapacityLedger`) rides the same atomic
+file discipline: one JSON record ``{epoch, target, writer, crc}``
+rewritten in place by atomic rename.  Readers treat ANY incoherent state
+(missing file, torn JSON, bad crc) as "no statement" — the fleet holds
+its current size rather than acting on garbage — and concurrent writers
+resolve by last-coherent-rename-wins, asserted by the ledger race test.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+import zlib
+
+__all__ = ["CoordinationTimeout", "LocalCoordinator", "FileCoordinator",
+           "KVCoordinator", "get_coordinator", "CapacityLedger"]
+
+_POLL_S = 0.02
+
+
+class CoordinationTimeout(RuntimeError):
+    """An exchange did not see all participants' values in time — a peer
+    died, hung, or never reached the barrier.  Carries the ranks that
+    were still missing for the postmortem."""
+
+    def __init__(self, message, missing=()):
+        super().__init__(message)
+        self.missing = tuple(missing)
+
+
+def _deadline(timeout: float) -> float:
+    return time.monotonic() + float(timeout)
+
+
+class LocalCoordinator:
+    """In-memory exchange — the single-process transport.  Thread-safe:
+    concurrent ranks (mock hosts on threads, or a test pre-posting peer
+    votes) rendezvous on one condition variable."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._store: dict = {}
+
+    def post(self, name: str, rank: int, value) -> None:
+        with self._lock:
+            self._store[(str(name), int(rank))] = value
+            self._lock.notify_all()
+
+    def exchange(self, name: str, rank: int, value, n: int,
+                 timeout: float = 30.0) -> dict:
+        self.post(name, rank, value)
+        end = _deadline(timeout)
+        with self._lock:
+            while True:
+                got = {r: v for (nm, r), v in self._store.items()
+                       if nm == str(name)}
+                if len(got) >= int(n):
+                    return {r: got[r] for r in sorted(got)}
+                left = end - time.monotonic()
+                if left <= 0 or not self._lock.wait(left):
+                    missing = sorted(set(range(int(n))) - set(got))
+                    raise CoordinationTimeout(
+                        f"exchange {name!r}: {len(got)}/{n} values after "
+                        f"{timeout}s — missing ranks {missing}", missing)
+
+    def clear(self, name: str) -> None:
+        with self._lock:
+            for k in [k for k in self._store if k[0] == str(name)]:
+                del self._store[k]
+
+
+class FileCoordinator:
+    """Shared-directory exchange: each post is one atomically-renamed
+    JSON file ``<dir>/<name>.<rank>.json``; the gather polls for all
+    ``n``.  Rename atomicity means a reader can never observe a torn
+    post — a file either doesn't exist yet or is complete."""
+
+    def __init__(self, directory: str):
+        self.directory = str(directory)
+
+    def _path(self, name, rank):
+        return os.path.join(self.directory, f"{name}.{int(rank)}.json")
+
+    def post(self, name: str, rank: int, value) -> None:
+        os.makedirs(self.directory, exist_ok=True)
+        payload = json.dumps(value).encode()
+        fd, tmp = tempfile.mkstemp(dir=self.directory)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(name, rank))
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+
+    def exchange(self, name: str, rank: int, value, n: int,
+                 timeout: float = 30.0) -> dict:
+        self.post(name, rank, value)
+        end = _deadline(timeout)
+        while True:
+            got = {}
+            for r in range(int(n)):
+                p = self._path(name, r)
+                try:
+                    with open(p, "rb") as f:
+                        got[r] = json.loads(f.read().decode())
+                except (OSError, ValueError):
+                    continue            # not posted yet (or mid-rename)
+            if len(got) >= int(n):
+                return got
+            if time.monotonic() >= end:
+                missing = sorted(set(range(int(n))) - set(got))
+                raise CoordinationTimeout(
+                    f"exchange {name!r} in {self.directory}: {len(got)}/"
+                    f"{n} values after {timeout}s — missing ranks "
+                    f"{missing}", missing)
+            time.sleep(_POLL_S)
+
+    def clear(self, name: str) -> None:
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for fn in names:
+            if fn.startswith(f"{name}.") and fn.endswith(".json"):
+                try:
+                    os.remove(os.path.join(self.directory, fn))
+                except OSError:
+                    pass
+
+
+class KVCoordinator:
+    """Exchange over the ``jax.distributed`` coordination service's KV
+    store — available whenever ``parallel.initialize()`` ran, on every
+    platform (the KV channel does not require collective support)."""
+
+    def __init__(self, client=None):
+        if client is None:
+            from jax._src import distributed as _jd
+            client = _jd.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "KVCoordinator needs an initialized jax.distributed "
+                "runtime (dislib_tpu.parallel.initialize())")
+        self._client = client
+
+    def post(self, name: str, rank: int, value) -> None:
+        self._client.key_value_set(f"dslib/{name}/{int(rank)}",
+                                   json.dumps(value))
+
+    def exchange(self, name: str, rank: int, value, n: int,
+                 timeout: float = 30.0) -> dict:
+        self.post(name, rank, value)
+        got = {}
+        ms = max(1, int(float(timeout) * 1000))
+        for r in range(int(n)):
+            try:
+                raw = self._client.blocking_key_value_get(
+                    f"dslib/{name}/{r}", ms)
+            except Exception as e:      # noqa: BLE001 — timeout is typed
+                raise CoordinationTimeout(
+                    f"exchange {name!r}: rank {r} never posted within "
+                    f"{timeout}s ({e})", [r]) from e
+            got[r] = json.loads(raw)
+        return got
+
+    def clear(self, name: str) -> None:
+        pass                            # KV keys are epoch-named by callers
+
+
+_LOCAL = LocalCoordinator()
+
+
+def get_coordinator():
+    """The transport for this process, by precedence: ``DSLIB_COORD_DIR``
+    (shared filesystem — explicit wins, it also serves rigs whose jaxlib
+    lacks multiprocess CPU), then the ``jax.distributed`` KV store when
+    initialized, else the in-process :class:`LocalCoordinator` singleton
+    (single-process deployments and the mock-host tier-1 tests)."""
+    d = os.environ.get("DSLIB_COORD_DIR")
+    if d:
+        return FileCoordinator(d)
+    try:
+        from dislib_tpu.parallel import distributed as _dist
+        if _dist.is_initialized():
+            return KVCoordinator()
+    except Exception:                   # noqa: BLE001 — fall to local
+        pass
+    return _LOCAL
+
+
+# ---------------------------------------------------------------------------
+# the global capacity ledger
+# ---------------------------------------------------------------------------
+
+def _ledger_crc(epoch: int, target, writer: str) -> int:
+    return zlib.crc32(f"{epoch}:{target}:{writer}".encode()) & 0xFFFFFFFF
+
+
+class CapacityLedger:
+    """The fleet-wide capacity level as ONE shared, atomically-replaced
+    JSON record: ``{"epoch", "target", "writer", "crc"}``.
+
+    - :meth:`read` returns ``(target, epoch)``; a missing file, torn
+      JSON, or crc mismatch is "no statement" — ``(None, 0)`` — so an
+      incoherent ledger can never shrink a fleet.
+    - :meth:`publish` stamps ``epoch = read_epoch + 1`` and replaces the
+      record atomically.  Two racing writers both rename complete
+      records; whichever rename lands LAST wins and the loser's record
+      simply vanishes — last-coherent-wins, no torn state possible.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    def read(self):
+        """``(target_devices | None, epoch)`` — the current coherent
+        statement, or ``(None, 0)`` when there is none."""
+        try:
+            with open(self.path, "rb") as f:
+                rec = json.loads(f.read().decode())
+            epoch = int(rec["epoch"])
+            target = rec["target"]
+            if target is not None:
+                target = int(target)
+            if int(rec["crc"]) != _ledger_crc(epoch, target,
+                                              str(rec["writer"])):
+                return None, 0          # foreign or damaged record
+            return target, epoch
+        except (OSError, ValueError, KeyError, TypeError):
+            return None, 0
+
+    def publish(self, target, writer: str = "") -> int:
+        """Publish a new capacity ``target`` (None = capacity unmanaged);
+        returns the epoch stamped on the record."""
+        _, epoch = self.read()
+        epoch += 1
+        if target is not None:
+            target = int(target)
+        rec = {"epoch": epoch, "target": target, "writer": str(writer),
+               "crc": _ledger_crc(epoch, target, str(writer))}
+        d = os.path.dirname(os.path.abspath(self.path)) or "."
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(json.dumps(rec).encode())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+        return epoch

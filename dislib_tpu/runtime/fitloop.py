@@ -252,6 +252,11 @@ class ChunkedFitLoop:
         (the forest's growth loop snapshots only resumable mid-points).
     carry_names / carry_shapes / increasing — forwarded to
         ``guard.check`` for diagnostics and the monotone direction.
+    snapshot_expect : dict | None — the snapshot compatibility contract
+        (key -> required shape tuple with ``None`` wildcards, or required
+        scalar); validated by ``health.check_snapshot`` inside the one
+        rollback funnel before any ``restore`` callback runs, raising the
+        shared "stale or foreign snapshot" ``ValueError`` on mismatch.
     elastic : callable(mesh) | None — rebind hook for the elastic tier
         AND the capacity-driven resizes: called after the driver changes
         the mesh; re-lay out the fit's data for the new topology
@@ -267,7 +272,7 @@ class ChunkedFitLoop:
     def __init__(self, name, *, checkpoint=None, health=None, max_iter=None,
                  chunk_iters=None, save_every=1, check_on="chunk",
                  save_final=True, carry_names=(), carry_shapes=(),
-                 increasing=False, elastic=None):
+                 increasing=False, elastic=None, snapshot_expect=None):
         self.name = name
         self.checkpoint = checkpoint
         self.guard = _health.guard(name, health, checkpoint)
@@ -280,6 +285,12 @@ class ChunkedFitLoop:
         self.carry_shapes = tuple(carry_shapes)
         self.increasing = bool(increasing)
         self.elastic = elastic
+        # snapshot compatibility contract, validated by the ONE rollback
+        # funnel (guard.rollback -> health.check_snapshot) before any
+        # restore callback sees the snapshot; streaming estimators may
+        # reassign it per call (the stream's width can change the want)
+        self.snapshot_expect = dict(snapshot_expect) if snapshot_expect \
+            else None
         self.ladder = EscalationLadder(self.guard,
                                        elastic_ok=elastic is not None)
         self.history: list = []
@@ -307,7 +318,8 @@ class ChunkedFitLoop:
 
     def _load_state(self, init, restore, rem=NO_REMEDIATION) -> LoopState:
         st = self.guard.rollback(restore, init, rem,
-                                 checkpoint=self.checkpoint)
+                                 checkpoint=self.checkpoint,
+                                 expect=self.snapshot_expect)
         if self._it0 is None:
             self._it0 = st.it           # this-run history starts here
         del self.history[max(0, st.it - self._it0):]

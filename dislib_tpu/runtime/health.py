@@ -55,7 +55,7 @@ import numpy as np
 
 __all__ = ["NumericalDivergence", "WatchdogTimeout", "HealthPolicy",
            "ChunkGuard", "Verdict", "Remediation", "NO_REMEDIATION",
-           "guard", "health_vec", "HEALTH_BASE_LEN"]
+           "guard", "health_vec", "check_snapshot", "HEALTH_BASE_LEN"]
 
 # fixed slots of a health vector; per-carry (count, first_flat_index)
 # pairs follow, one pair per guarded carry
@@ -498,7 +498,8 @@ class ChunkGuard:
         return Remediation(self.restarts, self.policy.action,
                            self.policy.seed + self.restarts)
 
-    def rollback(self, restore, scratch, remediation=None, checkpoint=None):
+    def rollback(self, restore, scratch, remediation=None, checkpoint=None,
+                 expect=None):
         """Load the newest good snapshot and hand it to
         ``restore(snap, remediation)``; fall back to
         ``scratch(remediation)`` when no snapshot exists (or there is no
@@ -507,11 +508,59 @@ class ChunkGuard:
         through — so the snapshot-vs-scratch dispatch and the remediation
         threading cannot drift between call sites.  ``checkpoint``
         overrides the guard's own (the fit-loop driver passes its sink:
-        an injected guard may carry none)."""
+        an injected guard may carry none).
+
+        ``expect`` declares what a compatible snapshot must contain (see
+        :func:`check_snapshot`); a mismatch raises the shared
+        "stale or foreign snapshot" ``ValueError`` BEFORE ``restore``
+        runs — the estimators' five copy-pasted validation blocks
+        collapsed here (round 19), and the health-guard lint keeps them
+        from growing back."""
         rem = NO_REMEDIATION if remediation is None else remediation
         ck = self.checkpoint if checkpoint is None else checkpoint
         snap = ck.load() if ck is not None else None
+        if snap is not None and expect:
+            check_snapshot(self.name, snap, expect)
         return restore(snap, rem) if snap is not None else scratch(rem)
+
+
+def check_snapshot(name, snap, expect):
+    """Validate a loaded snapshot against the estimator's declared
+    expectations — the one place the "stale or foreign snapshot" raise
+    lives.  ``expect`` maps snapshot key -> spec:
+
+    - a tuple is a required shape; ``None`` dims are wildcards (elastic
+      factor rows repadded per mesh, e.g. ALS's ``(None, n_f)``);
+    - an int is a required scalar value (logical dims like ALS's
+      ``m``/``n``, which outlive any padding).
+
+    A missing key or a mismatch raises ``ValueError`` mentioning
+    "stale or foreign snapshot" (tests and callers match on the phrase).
+    Estimators declare this via ``ChunkedFitLoop(snapshot_expect=...)``
+    rather than hand-checking in their ``restore`` callbacks.
+    """
+    for key, spec in expect.items():
+        if key not in snap:
+            raise ValueError(
+                f"{name}: checkpoint is missing {key!r} — stale or "
+                "foreign snapshot")
+        if isinstance(spec, tuple):
+            got = tuple(np.asarray(snap[key]).shape)
+            want = tuple(spec)
+            if len(got) != len(want) or any(
+                    w is not None and g != w for g, w in zip(got, want)):
+                shown = tuple("*" if w is None else w for w in want)
+                raise ValueError(
+                    f"{name}: checkpoint {key!r} shape {got} does not "
+                    f"match this estimator/data {shown} — stale or "
+                    "foreign snapshot")
+        else:
+            got = int(np.asarray(snap[key]))
+            if got != int(spec):
+                raise ValueError(
+                    f"{name}: checkpoint {key!r} = {got} does not match "
+                    f"this estimator/data ({int(spec)}) — stale or "
+                    "foreign snapshot")
 
 
 def health_vec(carries=(), inputs=(), hist=None, n_done=None,

@@ -102,30 +102,53 @@ def capacity_target() -> int | None:
 
     Sources, in precedence order: a :func:`request_capacity` process
     override (tests, embedded schedulers), then the integer contents of
-    the file named by ``DSLIB_CAPACITY_FILE``.  An absent, empty, or
-    unparseable file means "no statement" — None, never a shrink."""
+    the file named by ``DSLIB_CAPACITY_FILE``, then the fleet-wide
+    ledger named by ``DSLIB_CAPACITY_LEDGER`` (round 19: one coherent
+    level shared by every process — see ``runtime.coord``).  An absent,
+    empty, unparseable, or checksum-failing source means "no statement"
+    — None, never a shrink."""
     if _CAP["target"] is not None:
         return int(_CAP["target"])
     path = os.environ.get("DSLIB_CAPACITY_FILE")
-    if not path:
-        return None
-    try:
-        with open(path) as f:
-            return int(f.read().strip())
-    except (OSError, ValueError):
-        return None
+    if path:
+        try:
+            with open(path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+    ledger = os.environ.get("DSLIB_CAPACITY_LEDGER")
+    if ledger:
+        from dislib_tpu.runtime.coord import CapacityLedger
+        target, _epoch = CapacityLedger(ledger).read()
+        return target
+    return None
 
 
 def request_capacity(n_devices: int) -> None:
     """Set the process-level capacity target directly (tests, manual
-    drills, embedded schedulers).  Overrides the capacity file."""
+    drills, embedded schedulers).  Overrides the capacity file.  When
+    ``DSLIB_CAPACITY_LEDGER`` names the fleet ledger, the level is ALSO
+    published there — one process's chaos policy (``CapacityAtSave``
+    oscillation) or scheduler steers the whole fleet coherently."""
     _CAP["target"] = int(n_devices)
+    _publish_to_ledger(int(n_devices))
 
 
 def clear_capacity() -> None:
     """Drop the process-level capacity override — the file (if any)
-    becomes the source again, else capacity is unmanaged."""
+    becomes the source again, else capacity is unmanaged.  Published to
+    the ``DSLIB_CAPACITY_LEDGER`` fleet ledger too, when configured."""
     _CAP["target"] = None
+    _publish_to_ledger(None)
+
+
+def _publish_to_ledger(target) -> None:
+    path = os.environ.get("DSLIB_CAPACITY_LEDGER")
+    if not path:
+        return
+    from dislib_tpu.runtime.coord import CapacityLedger
+    writer = os.environ.get("DSLIB_PROC_ID", "0")
+    CapacityLedger(path).publish(target, writer=f"proc{writer}")
 
 
 def raise_if_preempted(checkpoint=None) -> None:

@@ -39,7 +39,9 @@ import warnings
 import numpy as np
 
 from dislib_tpu.runtime import adopt_latest, fetch as _fetch
-from dislib_tpu.runtime.bundle_io import (BundleIncompatible, read_bundle,
+from dislib_tpu.runtime.bundle_io import (BundleIncompatible,
+                                          BundleShardCorrupt, file_crc,
+                                          read_bundle, shard_path,
                                           write_bundle)
 from dislib_tpu.serving.buckets import BucketTemplate, bucket_ladder
 from dislib_tpu.utils import profiling as _prof
@@ -55,6 +57,13 @@ _STATE_PREFIX = "state__"
 # anything else in the fingerprint is informational (statics provenance)
 _HARD_KEYS = ("format", "jax", "jaxlib", "platform", "device_kind",
               "n_devices", "mesh_shape", "pad_quantum")
+
+# a SHARDED bundle replaces the global-shape pins (device count, mesh
+# shape) with the manifest's mesh CONTRACT — hosts × devices-per-host —
+# so a bundle exported on one fleet layout loads on any fleet honoring
+# the contract, not only a bit-identical process (round 19)
+_SHARD_HARD_KEYS = tuple(k for k in _HARD_KEYS
+                         if k not in ("n_devices", "mesh_shape"))
 
 
 def runtime_fingerprint() -> dict:
@@ -137,8 +146,52 @@ def _capture_bucket(pipeline, bucket: int):
     }
 
 
+def _resolve_state(checkpoint, state):
+    if checkpoint is not None and state is not None:
+        raise ValueError("pass at most one of checkpoint= or state=")
+    if checkpoint is not None:
+        adoption = adopt_latest(checkpoint, build=lambda s: s,
+                                name="bundle-export")
+        if adoption is None:
+            raise ValueError(
+                "checkpoint has no generation to embed — save one before "
+                "exporting a bundle")
+        state = adoption.state
+    return state
+
+
+def _capture_entries(pipeline, buckets):
+    """Run the per-bucket AOT capture loop once: the payload/leaf entry
+    dict plus the manifest's ``per_bucket`` metadata."""
+    entries: dict = {}
+    per_bucket: dict = {}
+    for b in buckets:
+        # capture protocol (round 18): pipelines whose predict program is
+        # not a fusion-chain lazy array (the retrieval tier's shard_map
+        # search, the sparse fold-in) AOT-capture their own kernel via a
+        # ``capture_bucket`` method returning the same dict shape; the
+        # fusion-chain linearizer stays the default
+        if hasattr(pipeline, "capture_bucket"):
+            cap = pipeline.capture_bucket(b)
+        else:
+            cap = _capture_bucket(pipeline, b)
+        entries[f"exec_{b}"] = cap["payload"]
+        for i, leaf in enumerate(cap["leaves"]):
+            # one device→host sync per leaf at EXPORT time (offline by
+            # definition); the serving hot path never comes through here
+            entries[f"leaf_{b}_{i}"] = np.asarray(leaf)
+        per_bucket[str(b)] = {
+            "input_slot": cap["input_slot"],
+            "n_leaves": len(cap["leaves"]),
+            "n_outs": cap["n_outs"],
+            "out_cols": cap["out_cols"],
+            "pshape": cap["pshape"],
+        }
+    return entries, per_bucket
+
+
 def export_bundle(pipeline, path: str, buckets=None, checkpoint=None,
-                  state=None) -> dict:
+                  state=None, hosts=None) -> dict:
     """Serialize ``pipeline``'s compiled predict executables for every
     ladder bucket into ONE versioned artifact at ``path``.
 
@@ -157,53 +210,106 @@ def export_bundle(pipeline, path: str, buckets=None, checkpoint=None,
     state : dict, optional — embed an explicit state dict instead (the
         caller already holds verified state).  Mutually exclusive with
         ``checkpoint``.
+    hosts : int, optional — write a SHARDED bundle for an N-host fleet
+        instead: one ``<path>.shard<r>`` artifact per host plus the
+        manifest at ``path`` (per-shard checksums, runtime fingerprint,
+        mesh contract).  ``load_bundle`` on such a manifest runs the
+        coordinated load barrier — every host verifies its shard before
+        ANY host serves.  In a multi-process job each process writes its
+        own shard (``hosts`` must equal the process count, rank 0 writes
+        the manifest); a single process writes all N shards — the mock
+        fleet used by tier-1 and by offline export-for-a-fleet.
 
     Returns the manifest dict (also embedded in the artifact).
     """
-    if checkpoint is not None and state is not None:
-        raise ValueError("pass at most one of checkpoint= or state=")
+    state = _resolve_state(checkpoint, state)
     buckets = bucket_ladder(buckets)
-    if checkpoint is not None:
-        adoption = adopt_latest(checkpoint, build=lambda s: s,
-                                name="bundle-export")
-        if adoption is None:
-            raise ValueError(
-                "checkpoint has no generation to embed — save one before "
-                "exporting a bundle")
-        state = adoption.state
-    entries: dict = {}
+    if hosts is not None:
+        return _export_sharded(pipeline, path, buckets, state, int(hosts))
+    entries, per_bucket = _capture_entries(pipeline, buckets)
     manifest: dict = {"format": BUNDLE_FORMAT,
                       "fingerprint": runtime_fingerprint(),
                       "buckets": list(buckets),
                       "n_features": int(pipeline.n_features),
-                      "per_bucket": {}}
-    for b in buckets:
-        # capture protocol (round 18): pipelines whose predict program is
-        # not a fusion-chain lazy array (the retrieval tier's shard_map
-        # search, the sparse fold-in) AOT-capture their own kernel via a
-        # ``capture_bucket`` method returning the same dict shape; the
-        # fusion-chain linearizer stays the default
-        if hasattr(pipeline, "capture_bucket"):
-            cap = pipeline.capture_bucket(b)
-        else:
-            cap = _capture_bucket(pipeline, b)
-        entries[f"exec_{b}"] = cap["payload"]
-        for i, leaf in enumerate(cap["leaves"]):
-            # one device→host sync per leaf at EXPORT time (offline by
-            # definition); the serving hot path never comes through here
-            entries[f"leaf_{b}_{i}"] = np.asarray(leaf)
-        manifest["per_bucket"][str(b)] = {
-            "input_slot": cap["input_slot"],
-            "n_leaves": len(cap["leaves"]),
-            "n_outs": cap["n_outs"],
-            "out_cols": cap["out_cols"],
-            "pshape": cap["pshape"],
-        }
+                      "per_bucket": per_bucket}
     if state is not None:
         for k, v in state.items():
             entries[_STATE_PREFIX + k] = np.asarray(v)
     entries[_META_KEY] = np.asarray(json.dumps(manifest))
     write_bundle(path, entries)
+    return manifest
+
+
+def _mesh_contract(hosts: int) -> dict:
+    """What a loading fleet must LOOK like for the shards to serve: the
+    host count, each host's device count, and the padded-layout facts
+    (mesh shape, pad quantum) the executables were compiled against.
+    This replaces the flat bundle's exact ``n_devices`` pin — any fleet
+    honoring the contract can load, not only the exporting process."""
+    import jax
+
+    from dislib_tpu.parallel import mesh as _mesh
+    n = len(jax.devices())
+    if n % hosts:
+        raise ValueError(
+            f"export_bundle(hosts={hosts}): {n} devices do not split "
+            f"evenly across {hosts} hosts — the mesh contract needs a "
+            "uniform per-host device count")
+    return {"hosts": int(hosts), "devices_per_host": n // hosts,
+            "mesh_shape": list(_mesh.mesh_shape(None)),
+            "pad_quantum": int(_mesh.pad_quantum())}
+
+
+def _export_sharded(pipeline, path, buckets, state, hosts: int) -> dict:
+    import jax
+
+    from dislib_tpu.runtime.coord import get_coordinator
+    if hosts < 1:
+        raise ValueError(f"export_bundle(hosts={hosts}): need >= 1")
+    pc = jax.process_count()
+    if pc > 1 and hosts != pc:
+        raise ValueError(
+            f"export_bundle(hosts={hosts}) in a {pc}-process job: each "
+            "process writes exactly its own shard, so hosts must equal "
+            "the process count")
+    contract = _mesh_contract(hosts)
+    entries, per_bucket = _capture_entries(pipeline, buckets)
+    if state is not None:
+        for k, v in state.items():
+            entries[_STATE_PREFIX + k] = np.asarray(v)
+    common = {"format": BUNDLE_FORMAT, "sharded": True,
+              "hosts": int(hosts),
+              "fingerprint": runtime_fingerprint(),
+              "buckets": list(buckets),
+              "n_features": int(pipeline.n_features),
+              "per_bucket": per_bucket,
+              "mesh_contract": contract}
+    my_ranks = [jax.process_index()] if pc > 1 else range(hosts)
+    for r in my_ranks:
+        shard_meta = dict(common, host=int(r), hosts=int(hosts))
+        shard_entries = dict(entries)
+        shard_entries[_META_KEY] = np.asarray(json.dumps(shard_meta))
+        write_bundle(shard_path(path, r), shard_entries)
+    # gather every shard's file checksum, then rank 0 publishes the
+    # manifest; the exchange doubles as the export barrier (no manifest
+    # can name a shard that is not fully on disk)
+    base = os.path.basename(path)
+    if pc > 1:
+        coord = get_coordinator()
+        mine = file_crc(shard_path(path, jax.process_index()))
+        crcs = coord.exchange(f"bundle-export:{base}", jax.process_index(),
+                              mine, n=hosts)
+        shard_crcs = [int(crcs[r]) for r in range(hosts)]
+    else:
+        shard_crcs = [file_crc(shard_path(path, r)) for r in range(hosts)]
+    manifest = dict(common, shard_crcs=shard_crcs)
+    if pc <= 1 or jax.process_index() == 0:
+        write_bundle(path, {_META_KEY: np.asarray(json.dumps(manifest))})
+    if pc > 1:
+        # all ranks block until the manifest is on disk (rank 0 posts
+        # after its atomic write) — export returns only when loadable
+        get_coordinator().exchange(f"bundle-manifest:{base}",
+                                   jax.process_index(), True, n=hosts)
     return manifest
 
 
@@ -278,20 +384,30 @@ class LoadedBundle:
     ``fallback`` is True), the embedded checksum-verified ``state``, the
     ``buckets`` ladder, the exporting process's ``fingerprint``, and
     ``fallback`` — True when the executables were unusable here and the
-    pipeline will pay a fresh trace+compile per bucket instead."""
+    pipeline will pay a fresh trace+compile per bucket instead.
 
-    __slots__ = ("pipeline", "state", "buckets", "fingerprint", "fallback")
+    For a SHARDED bundle, ``hosts`` is the fleet size the bundle was
+    exported for and ``host`` the shard this process serves; both are
+    None for a flat bundle."""
 
-    def __init__(self, pipeline, state, buckets, fingerprint, fallback):
+    __slots__ = ("pipeline", "state", "buckets", "fingerprint", "fallback",
+                 "hosts", "host")
+
+    def __init__(self, pipeline, state, buckets, fingerprint, fallback,
+                 hosts=None, host=None):
         self.pipeline = pipeline
         self.state = state
         self.buckets = tuple(buckets)
         self.fingerprint = fingerprint
         self.fallback = fallback
+        self.hosts = hosts
+        self.host = host
 
     def __repr__(self):
+        shard = f", host={self.host}/{self.hosts}" \
+            if self.hosts is not None else ""
         return (f"LoadedBundle(buckets={self.buckets}, "
-                f"fallback={self.fallback})")
+                f"fallback={self.fallback}{shard})")
 
 
 def _fallback(build, state, meta, err):
@@ -314,7 +430,8 @@ def _fallback(build, state, meta, err):
                         meta["fingerprint"], fallback=True)
 
 
-def load_bundle(path: str, build=None) -> LoadedBundle:
+def load_bundle(path: str, build=None, timeout: float = 30.0) \
+        -> LoadedBundle:
     """Rehydrate a deployment bundle into a ``PredictServer``-ready
     pipeline with zero retraces.
 
@@ -325,16 +442,24 @@ def load_bundle(path: str, build=None) -> LoadedBundle:
     fails — raises :class:`~dislib_tpu.runtime.BundleIncompatible`;
     pass ``build`` (``state_dict -> ServePipeline``) to instead fall
     back loudly to a fresh compile from the embedded state.
-    """
-    import jax.tree_util as jtu
-    from jax.experimental.serialize_executable import deserialize_and_load
 
+    A SHARDED bundle (``export_bundle(hosts=N)``; ``path`` names the
+    manifest) instead runs the coordinated load barrier first: this
+    process verifies its own shard (manifest checksum + artifact CRC),
+    exchanges the verdict with every peer through ``runtime.coord``,
+    and only when ALL hosts verified does anyone deserialize — one
+    corrupt shard raises the same typed
+    :class:`~dislib_tpu.runtime.BundleShardCorrupt` on every host, and
+    zero hosts serve.  ``timeout`` bounds the barrier wait.
+    """
     raw = read_bundle(path)
     if _META_KEY not in raw:
         raise BundleIncompatible(
             f"{path} verifies but carries no bundle manifest — not a "
             "deployment bundle")
     meta = json.loads(str(raw[_META_KEY][()]))
+    if meta.get("sharded"):
+        return _load_sharded(path, meta, build, timeout)
     state = {k[len(_STATE_PREFIX):]: v for k, v in raw.items()
              if k.startswith(_STATE_PREFIX)}
     here = runtime_fingerprint()
@@ -347,28 +472,8 @@ def load_bundle(path: str, build=None) -> LoadedBundle:
             f"bundle {path} was exported under a different runtime "
             f"({diff}) — its compiled executables cannot run here",
             expected=theirs, found=here))
-    execs = {}
     try:
-        for b in meta["buckets"]:
-            pb = meta["per_bucket"][str(b)]
-            payload = raw[f"exec_{b}"].tobytes()
-            in_tree = jtu.tree_structure(
-                (tuple(range(pb["n_leaves"])), {}))
-            out_tree = jtu.tree_structure(tuple(range(pb["n_outs"])))
-            loaded = deserialize_and_load(payload, in_tree, out_tree)
-            shardings = getattr(loaded, "input_shardings", None)
-            shardings = shardings[0] if shardings else None
-            args = []
-            import jax
-            for i in range(pb["n_leaves"]):
-                leaf = raw[f"leaf_{b}_{i}"]
-                args.append(jax.device_put(leaf, shardings[i])
-                            if shardings is not None else leaf)
-            execs[int(b)] = _BucketExec(
-                loaded, args, pb["input_slot"],
-                shardings[pb["input_slot"]] if shardings is not None
-                else None,
-                pb["out_cols"], pb["pshape"])
+        execs = _build_execs(raw, meta)
     except BundleIncompatible:
         raise
     except Exception as e:  # noqa: BLE001 — deserialize failure is typed
@@ -379,3 +484,138 @@ def load_bundle(path: str, build=None) -> LoadedBundle:
     pipe = BundlePipeline(meta["buckets"], meta["n_features"], execs)
     return LoadedBundle(pipe, state, meta["buckets"], theirs,
                         fallback=False)
+
+
+def _build_execs(raw, meta) -> dict:
+    """Rehydrate every bucket's compiled executable from a verified raw
+    entry dict (the flat artifact, or this host's shard)."""
+    import jax
+    import jax.tree_util as jtu
+    from jax.experimental.serialize_executable import deserialize_and_load
+
+    execs = {}
+    for b in meta["buckets"]:
+        pb = meta["per_bucket"][str(b)]
+        payload = raw[f"exec_{b}"].tobytes()
+        in_tree = jtu.tree_structure(
+            (tuple(range(pb["n_leaves"])), {}))
+        out_tree = jtu.tree_structure(tuple(range(pb["n_outs"])))
+        loaded = deserialize_and_load(payload, in_tree, out_tree)
+        shardings = getattr(loaded, "input_shardings", None)
+        shardings = shardings[0] if shardings else None
+        args = []
+        for i in range(pb["n_leaves"]):
+            leaf = raw[f"leaf_{b}_{i}"]
+            args.append(jax.device_put(leaf, shardings[i])
+                        if shardings is not None else leaf)
+        execs[int(b)] = _BucketExec(
+            loaded, args, pb["input_slot"],
+            shardings[pb["input_slot"]] if shardings is not None
+            else None,
+            pb["out_cols"], pb["pshape"])
+    return execs
+
+
+def _verify_shard(path, manifest, r):
+    """One host's shard verification: manifest CRC over the artifact
+    bytes, then the checksum-verified read.  Returns ``(vote, raw)`` —
+    the vote is what goes through the barrier exchange."""
+    from dislib_tpu.utils.checkpoint import SnapshotCorrupt
+    sp = shard_path(path, r)
+    try:
+        crc = file_crc(sp)
+    except OSError as e:
+        return {"ok": False, "reason": f"shard unreadable: {e}"}, None
+    want = int(manifest["shard_crcs"][r])
+    if crc != want:
+        return {"ok": False,
+                "reason": f"shard CRC {crc:#010x} != manifest "
+                          f"{want:#010x} — damaged or replaced"}, None
+    try:
+        raw = read_bundle(sp)
+    except SnapshotCorrupt as e:
+        return {"ok": False, "reason": f"shard fails its embedded "
+                                       f"checksum: {e}"}, None
+    return {"ok": True}, raw
+
+
+def _load_sharded(path, manifest, build, timeout) -> LoadedBundle:
+    import jax
+
+    from dislib_tpu.runtime.coord import get_coordinator
+
+    hosts = int(manifest["hosts"])
+    contract = manifest.get("mesh_contract", {})
+    here = runtime_fingerprint()
+    theirs = manifest.get("fingerprint", {})
+    pc = jax.process_count()
+    if pc > 1:
+        if pc != hosts:
+            raise BundleIncompatible(
+                f"sharded bundle {path} carries {hosts} shards but this "
+                f"fleet has {pc} processes — the mesh contract "
+                f"{contract} is not honored", expected=contract,
+                found={"hosts": pc})
+        if contract.get("devices_per_host") is not None and \
+                int(contract["devices_per_host"]) != len(jax.local_devices()):
+            raise BundleIncompatible(
+                f"sharded bundle {path} expects "
+                f"{contract['devices_per_host']} devices per host, this "
+                f"process has {len(jax.local_devices())}",
+                expected=contract,
+                found={"devices_per_host": len(jax.local_devices())})
+        my_host = jax.process_index()
+        votes_needed = hosts
+        vote, raw_mine = _verify_shard(path, manifest, my_host)
+        coord = get_coordinator()
+        base = os.path.basename(path)
+        votes = coord.exchange(f"bundle-load:{base}", my_host, vote,
+                               n=votes_needed, timeout=timeout)
+    else:
+        # single process standing in for the fleet (mock hosts, offline
+        # validation): verify EVERY shard and run the same barrier
+        # exchange over the local transport — the protocol decision is
+        # identical, only the transport is in-memory
+        my_host = 0
+        coord = get_coordinator()
+        base = os.path.basename(path)
+        coord.clear(f"bundle-load:{base}")
+        raws, votes0 = {}, {}
+        for r in range(hosts):
+            votes0[r], raws[r] = _verify_shard(path, manifest, r)
+            coord.post(f"bundle-load:{base}", r, votes0[r])
+        raw_mine = raws[0]
+        votes = coord.exchange(f"bundle-load:{base}", 0, votes0[0],
+                               n=hosts, timeout=timeout)
+    bad = sorted(r for r, v in votes.items() if not v.get("ok"))
+    if bad:
+        _prof.count_resilience("bundle_barrier_abort")
+        r0 = bad[0]
+        reason = votes[r0].get("reason", "unknown")
+        raise BundleShardCorrupt(
+            f"sharded bundle {path}: host {r0} failed shard "
+            f"verification ({reason}) — load barrier ABORTS, zero hosts "
+            f"serve (failed hosts: {bad})", host=r0, reason=reason)
+    _prof.count_resilience("bundle_barrier_ok")
+    state = {k[len(_STATE_PREFIX):]: v for k, v in raw_mine.items()
+             if k.startswith(_STATE_PREFIX)}
+    mismatched = [k for k in _SHARD_HARD_KEYS
+                  if theirs.get(k) != here.get(k)]
+    if mismatched:
+        diff = {k: {"bundle": theirs.get(k), "here": here.get(k)}
+                for k in mismatched}
+        return _fallback(build, state, manifest, BundleIncompatible(
+            f"sharded bundle {path} was exported under a different "
+            f"runtime ({diff}) — its compiled executables cannot run "
+            "here", expected=theirs, found=here))
+    try:
+        execs = _build_execs(raw_mine, manifest)
+    except Exception as e:  # noqa: BLE001 — deserialize failure is typed
+        return _fallback(build, state, manifest, BundleIncompatible(
+            f"sharded bundle {path} passed its load barrier but "
+            f"executable deserialization failed "
+            f"({type(e).__name__}: {e})", expected=theirs, found=here))
+    pipe = BundlePipeline(manifest["buckets"], manifest["n_features"],
+                          execs)
+    return LoadedBundle(pipe, state, manifest["buckets"], theirs,
+                        fallback=False, hosts=hosts, host=my_host)

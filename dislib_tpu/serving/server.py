@@ -92,11 +92,40 @@ class PredictServer:
     """
 
     def __init__(self, pipeline=None, pool=None, buckets=None,
-                 deadline_ms=None, max_queue_rows=65536, name="serve"):
+                 deadline_ms=None, max_queue_rows=65536, name="serve",
+                 elastic=None, capacity_poll_s=0.25, grow_attempts=8):
         if (pipeline is None) == (pool is None):
             raise ValueError("pass exactly one of pipeline= or pool=")
+        if elastic is not None and not callable(elastic):
+            elastic = (lambda mesh: None) if elastic else None
+        if elastic is not None and pipeline is None:
+            raise ValueError(
+                "elastic= serving needs pipeline mode — a ModelPool's "
+                "generations re-warm through adoption, not a rebind hook")
         self._pipeline = pipeline
         self._pool = pool
+        # elastic capacity re-layout (round 19, ROADMAP 3(c)): between
+        # batches the worker polls the capacity level (process override /
+        # DSLIB_CAPACITY_FILE / the fleet-wide DSLIB_CAPACITY_LEDGER) and
+        # re-forms the serving mesh over the home-device prefix exactly
+        # as the fit loop's elastic tier does — hook(None) pre-switch,
+        # mesh re-init, cache drop, hook(new_mesh) post-switch.  The hook
+        # may return a REPLACEMENT pipeline (its model re-laid-out for
+        # the new mesh via the rechunk schedules); the server re-warms
+        # the bucket ladder before the next batch so the request hot
+        # path never compiles.  The hook is optional: ``elastic=True``
+        # (normalized above, before the pool-mode check) enables the
+        # re-layout with the default rebind — re-warm the same pipeline
+        # on the new mesh; a non-callable must never reach the worker
+        # thread, where a TypeError would kill serving and strand every
+        # queued future.
+        self._elastic = elastic
+        self.capacity_poll_s = float(capacity_poll_s)
+        self._grows_left = int(grow_attempts)
+        self._home_shape = None
+        self._home_devices = None
+        self._last_cap_poll = None
+        self._mesh_resizes = 0
         if pool is not None:
             # the served ladder must be ⊆ the pool's warmed+health-gated
             # ladder: routing a request to a bucket adoption never warmed
@@ -150,6 +179,11 @@ class PredictServer:
     def start(self) -> "PredictServer":
         if self._running:
             return self
+        if self._elastic is not None:
+            from dislib_tpu.parallel import mesh as _mesh
+            m = _mesh.get_mesh()
+            self._home_shape = _mesh.mesh_shape(m)
+            self._home_devices = list(m.devices.flat)
         if self._pipeline is not None:
             # static pipeline: AOT-warm every bucket up front so the
             # request path never compiles (a ModelPool warms at adoption)
@@ -225,9 +259,12 @@ class PredictServer:
     def _worker(self):
         top = self.buckets[-1]
         while True:
+            self._maybe_resize()        # between batches, never mid-batch
             with self._cv:
                 while self._running and not self._queue:
                     self._cv.wait(timeout=0.1)
+                    if self._elastic is not None:
+                        break           # idle: re-poll the capacity level
                 if not self._queue:
                     if not self._running:
                         return
@@ -250,6 +287,76 @@ class PredictServer:
                     batch.append(p)
                 self._queued_rows -= total
             self._execute(batch, total)
+
+    def _capacity_plan(self):
+        """The fit loop's ``_capacity_plan`` rule, applied to the serving
+        mesh: compare the published capacity level against the current
+        rows and return ``("shrink"|"grow", new_rows)`` or None.  The
+        mesh stays a halving-reachable row prefix of the HOME mesh;
+        shrinks always honour the target, grows spend ``grow_attempts``
+        budget so a flapping source cannot thrash resizes forever."""
+        from dislib_tpu.parallel import mesh as _mesh
+        from dislib_tpu.runtime.preemption import capacity_target
+        cap = capacity_target()
+        if cap is None:
+            return None
+        r, c = _mesh.mesh_shape(_mesh.get_mesh())
+        home_r, home_c = self._home_shape
+        cap = max(c, min(int(cap), home_r * home_c))
+        want = cap // c                 # usable full rows at this level
+        if want < r:
+            new_r = r
+            while new_r > 1 and new_r > want:
+                new_r //= 2
+            return ("shrink", new_r) if new_r < r else None
+        if want > r and r < home_r and self._grows_left > 0:
+            new_r = r
+            while new_r * 2 <= min(want, home_r):
+                new_r *= 2
+            if new_r > r:
+                return ("grow", new_r)
+        return None
+
+    def _maybe_resize(self):
+        """Worker-side capacity poll (throttled): re-form the serving
+        mesh when the level moved, at a BATCH BOUNDARY — a response is
+        always computed entirely on one mesh, never torn across two.
+        Mirrors ``ChunkedFitLoop._resize_mesh``: hook(None) forces
+        anything pending under the old mesh, the mesh re-forms over the
+        home-device prefix, jit caches drop (stale sharding constraints
+        must not replay), and the hook sees the new mesh — returning a
+        replacement pipeline re-laid-out for it, which is re-warmed so
+        the hot path stays compile-free."""
+        if self._elastic is None:
+            return
+        now = time.perf_counter()
+        if self._last_cap_poll is not None and \
+                now - self._last_cap_poll < self.capacity_poll_s:
+            return
+        self._last_cap_poll = now
+        plan = self._capacity_plan()
+        if plan is None:
+            return
+        kind, new_r = plan
+        import jax
+
+        from dislib_tpu.parallel import mesh as _mesh
+        if kind == "grow":
+            self._grows_left -= 1
+        self._elastic(None)             # pre-switch: force pending chains
+        _, c = self._home_shape
+        _mesh.init((new_r, c), devices=self._home_devices[: new_r * c])
+        jax.clear_caches()
+        _prof.count_resilience("serve_mesh_shrinks" if kind == "shrink"
+                               else "serve_mesh_grows")
+        new_pipe = self._elastic(_mesh.get_mesh())
+        if new_pipe is not None:
+            self._pipeline = new_pipe
+        # caches were dropped with the old mesh: re-warm the ladder so
+        # the next batch is a cached dispatch, not a compile
+        self.cache.warm(self._pipeline, None, self.buckets)
+        with self._cv:
+            self._mesh_resizes += 1
 
     def _serving(self):
         """(generation, pipeline) for the next batch — polls the pool so
@@ -456,6 +563,7 @@ class PredictServer:
             "queue_depth": depth,
             "queued_rows": queued_rows,
             "shed": shed,
+            "mesh_resizes": self._mesh_resizes,
             "bucket_cost_ms": {b: round(1e3 * c, 4)
                                for b, c in self.bucket_cost().items()},
             "tenants": tenants,

@@ -167,6 +167,37 @@ def test_no_hand_rolled_resilience_protocol_in_estimator_code():
         "remove them so they can't bless future hand-rolled loops")
 
 
+def test_snapshot_validation_owned_by_the_rollback_funnel():
+    """Round 19 collapsed the five copy-pasted snapshot-compatibility
+    blocks (kmeans/minibatch/gm centers-vs-data, ALS's two factor-state
+    raises) into ``ChunkGuard.rollback(expect=...)`` →
+    ``health.check_snapshot`` — estimators now DECLARE the contract via
+    ``ChunkedFitLoop(snapshot_expect=...)``.  An estimator spelling the
+    "stale or foreign" message itself has grown a private validation
+    block back; the funnel owns that raise."""
+    offenders = []
+    for d in ESTIMATOR_DIRS:
+        full_dir = os.path.join(REPO, d)
+        for fn in sorted(os.listdir(full_dir)):
+            if not fn.endswith(".py"):
+                continue
+            rel = f"{d}/{fn}"
+            tree = ast.parse(
+                open(os.path.join(full_dir, fn), encoding="utf-8").read())
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and "stale or foreign" in node.value:
+                    offenders.append(
+                        f"{rel}:{node.lineno}: inline 'stale or foreign' "
+                        "message — declare snapshot_expect and let "
+                        "ChunkGuard.rollback raise it")
+    assert not offenders, (
+        "hand-rolled snapshot validation in estimator code (declare it "
+        "via ChunkedFitLoop(snapshot_expect=...)):\n  "
+        + "\n  ".join(offenders))
+
+
 def test_registry_entries_still_exist():
     """A refactor that renames a registered loop must update the registry
     — dead entries would quietly bless future unguarded loops."""

@@ -87,6 +87,12 @@ ALLOWLIST = {
     # definition; the bundle's serve path (BundlePipeline.predict_bucket)
     # syncs only through the blessed runtime.fetch
     ("dislib_tpu/serving/bundle.py", "export_bundle"),
+    # round-19 split export_bundle into the shared AOT-capture loop and
+    # the sharded-fleet writer — the SAME offline packaging boundary as
+    # the export_bundle entry above, one sync per leaf/state value at
+    # export time, never on the serve path
+    ("dislib_tpu/serving/bundle.py", "_capture_entries"),
+    ("dislib_tpu/serving/bundle.py", "_export_sharded"),
 }
 
 _RAW_SYNC_ATTRS = ("device_get", "collect", "block_until_ready")

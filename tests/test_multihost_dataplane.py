@@ -1,0 +1,392 @@
+"""Round-19 DCN data plane, single-process coverage: the hierarchical
+``dcn`` rechunk schedule under mocked host maps (bit-equality grid +
+analytic accounting), the sharded-bundle load barrier with a poisoned
+shard, the coordination primitives (ranked exchange over the local and
+file transports, typed timeout), the capacity ledger's last-coherent-wins
+race, and the serving mesh's elastic shrink/grow between batches.
+
+The ``DSLIB_MOCK_HOSTS=N`` overlay partitions this process's flat device
+order into N contiguous fake hosts, so every protocol decision (host
+blocks, coalesced message accounting, shard ownership) runs for real
+without a second process; ``tools/run_multihost.sh`` is the two-REAL-
+process proof of the same paths under ``jax.distributed``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+import dislib_tpu as ds
+from dislib_tpu.ops import rechunk as rc
+from dislib_tpu.parallel import mesh as _mesh
+from dislib_tpu.parallel import hosts as _hosts
+from dislib_tpu.utils import profiling as _prof
+
+
+@pytest.fixture
+def mock_hosts(request):
+    """Set DSLIB_MOCK_HOSTS for one test and restore it after."""
+    def _set(n):
+        os.environ["DSLIB_MOCK_HOSTS"] = str(n)
+    prev = os.environ.get("DSLIB_MOCK_HOSTS")
+    yield _set
+    if prev is None:
+        os.environ.pop("DSLIB_MOCK_HOSTS", None)
+    else:
+        os.environ["DSLIB_MOCK_HOSTS"] = prev
+
+
+def _hier_data(src_shape, m, n):
+    """A deterministic (m, n) array staged canonically on a src mesh."""
+    _mesh.init(src_shape)
+    src = _mesh.get_mesh()
+    x = np.arange(m * n, dtype=np.float32).reshape(m, n) * 0.25 - 3.0
+    pr, pc = src.shape[_mesh.ROWS], src.shape[_mesh.COLS]
+    xp = np.zeros((-(-m // pr) * pr, -(-n // pc) * pc), np.float32)
+    xp[:m, :n] = x
+    return jax.device_put(xp, _mesh.data_sharding(src)), src
+
+
+def _dst(src, shape):
+    return Mesh(np.asarray(list(src.devices.flat)).reshape(shape),
+                _mesh.AXIS_NAMES)
+
+
+# --------------------------------------------------------------------------
+# hierarchical rechunk: schedule x mesh bit-equality grid + accounting
+# --------------------------------------------------------------------------
+
+GRID = [
+    # (mock hosts, src shape, dst shape) — every pair hierarchical under
+    # the mock map: contiguous equal host blocks of whole rows both sides
+    (2, (8, 1), (4, 2)),
+    (2, (4, 2), (2, 4)),
+    (2, (2, 4), (8, 1)),
+    (4, (8, 1), (4, 2)),
+    (4, (4, 2), (8, 1)),
+]
+
+
+@pytest.mark.parametrize("mock,src_shape,dst_shape", GRID)
+@pytest.mark.parametrize("overlap", ["seq", "db"])
+def test_dcn_bit_equal_grid(mock_hosts, mock, src_shape, dst_shape,
+                            overlap):
+    """dcn == panels bit-for-bit across host counts, mesh pairs, and both
+    overlap variants — a reshard is pure data movement."""
+    mock_hosts(mock)
+    m, n = 50, 21                     # pads misalign between the shapes
+    data, src = _hier_data(src_shape, m, n)
+    dst = _dst(src, dst_shape)
+    assert rc.dcn_supported(data, dst)
+    out = rc.dcn_rechunk(data, (m, n), dst, overlap=overlap)
+    ref, sched = rc.reshard(data, (m, n), dst, schedule="panels")
+    assert sched == "panels"
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+@pytest.mark.parametrize("mock,src_shape,dst_shape", GRID)
+def test_dcn_accounting_invariants(mock_hosts, mock, src_shape,
+                                   dst_shape):
+    """The analytic claims behind the schedule: coalesced messages are
+    O(hosts) per step, bytes match the rows-that-change-host floor
+    exactly (no write amplification), and the hierarchical total never
+    exceeds the flat exchange's O(panels) message count."""
+    mock_hosts(mock)
+    data, src = _hier_data(src_shape, 50, 21)
+    dst = _dst(src, dst_shape)
+    acct = rc.dcn_accounting(data, (50, 21), dst)
+    assert acct["hosts"] == mock
+    assert acct["messages_per_step_max"] <= acct["hosts"] - 1
+    assert acct["dcn_bytes_moved"] == acct["deviceput_bytes"]
+    assert acct["dcn_messages"] <= acct["flat_messages"]
+
+
+def test_dcn_routing_and_counter(mock_hosts):
+    """Auto-routing picks dcn exactly when the mesh is multi-host (and
+    the run is counted); a single-host mesh keeps the flat exchange, and
+    the sparse router downgrades dcn to panels (no hierarchical sparse
+    tier yet)."""
+    mock_hosts(4)
+    data, src = _hier_data((8, 1), 50, 21)
+    dst = _dst(src, (4, 2))
+    assert rc.pick_schedule(data, dst) == "dcn"
+    _prof.reset_counters()
+    out, sched = rc.reshard(data, (50, 21), dst, schedule="auto")
+    assert sched == "dcn"
+    assert sum(v for k, v in _prof.schedule_counters().items()
+               if k.startswith("rechunk_dcn:")) == 1
+    ref, _ = rc.reshard(data, (50, 21), dst, schedule="panels")
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # explicit dcn on sparse downgrades before any layout inspection
+    assert rc.pick_sparse_schedule(None, None, "dcn") == "panels"
+
+    os.environ["DSLIB_MOCK_HOSTS"] = "1"
+    data1, src1 = _hier_data((8, 1), 50, 21)
+    assert rc.pick_schedule(data1, _dst(src1, (4, 2))) == "panels"
+
+
+def test_dcn_explicit_on_unsupported_layout_raises(mock_hosts):
+    """schedule='dcn' on a mesh whose rows span hosts is a loud error,
+    not a silent downgrade."""
+    mock_hosts(4)
+    data, src = _hier_data((8, 1), 50, 21)
+    bad = _dst(src, (2, 4))           # 4 devices/row over 4 hosts
+    with pytest.raises(ValueError, match="contiguous equal host blocks"):
+        rc.reshard(data, (50, 21), bad, schedule="dcn")
+
+
+def test_host_map_helpers(mock_hosts):
+    """The mock overlay partitions flat device order contiguously; the
+    block decomposition feeds the dcn schedule."""
+    mock_hosts(4)
+    _mesh.init((8, 1))
+    mesh = _mesh.get_mesh()
+    assert _hosts.n_hosts(mesh) == 4
+    blocks = _hosts.host_blocks(mesh)
+    assert blocks is not None
+    n_blocks, rows_per_block, block_hosts = blocks
+    assert (n_blocks, rows_per_block) == (4, 2)
+    assert list(block_hosts) == [0, 1, 2, 3]
+    mock_hosts(3)                     # 3 does not divide 8 evenly
+    assert _hosts.host_blocks(_mesh.get_mesh()) is None
+
+
+# --------------------------------------------------------------------------
+# sharded bundles: coordinated load barrier, poisoned-shard regression
+# --------------------------------------------------------------------------
+
+NF = 8
+
+
+def _linreg_pipe():
+    from dislib_tpu.serving import ServePipeline
+    lr = ds.LinearRegression()
+    lr.coef_ = np.ones((NF, 1), np.float32)
+    lr.intercept_ = np.full(1, 5.0, np.float32)
+    state = {"coef": lr.coef_, "intercept": lr.intercept_}
+    return ServePipeline(lr, n_features=NF), state
+
+
+def test_sharded_bundle_round_trip(tmp_path):
+    """export_bundle(hosts=N) writes one executable shard per host plus
+    a manifest; the barrier-gated load serves bit-correct predictions."""
+    from dislib_tpu.serving import export_bundle, load_bundle
+    pipe, state = _linreg_pipe()
+    path = str(tmp_path / "model.dsb.npz")
+    man = export_bundle(pipe, path, buckets=(1, 8), state=state, hosts=4)
+    assert man["sharded"] and man["hosts"] == 4
+    assert len(man["shard_crcs"]) == 4
+    for r in range(4):
+        assert os.path.exists(f"{path}.shard{r}")
+    _prof.reset_counters()
+    lb = load_bundle(path)
+    assert lb.hosts == 4 and lb.host == 0 and not lb.fallback
+    x = np.random.RandomState(0).rand(5, NF).astype(np.float32)
+    np.testing.assert_allclose(lb.pipeline.predict_bucket(x, 8),
+                               x @ state["coef"] + 5.0, atol=1e-5)
+    assert _prof.resilience_counters().get("bundle_barrier_ok") == 1
+
+
+def test_poisoned_shard_aborts_every_host(tmp_path):
+    """One corrupt per-host shard -> the SAME typed abort everywhere
+    (zero hosts serve), naming the bad host; the abort is counted."""
+    from dislib_tpu.runtime import BundleShardCorrupt
+    from dislib_tpu.runtime.bundle_io import shard_path
+    from dislib_tpu.serving import export_bundle, load_bundle
+    pipe, state = _linreg_pipe()
+    path = str(tmp_path / "model.dsb.npz")
+    export_bundle(pipe, path, buckets=(1,), state=state, hosts=4)
+    with open(shard_path(path, 2), "r+b") as f:
+        f.seek(100)
+        f.write(b"\xde\xad\xbe\xef")
+    _prof.reset_counters()
+    with pytest.raises(BundleShardCorrupt) as ei:
+        load_bundle(path)
+    assert ei.value.host == 2
+    assert _prof.resilience_counters().get("bundle_barrier_abort") == 1
+    assert not _prof.resilience_counters().get("bundle_barrier_ok")
+
+
+def test_sharded_bundle_mesh_contract_mismatch(tmp_path):
+    """A manifest whose mesh contract disagrees with THIS runtime's
+    device split refuses to serve executables (state-only fallback path
+    stays available through build=)."""
+    from dislib_tpu.runtime import BundleIncompatible
+    from dislib_tpu.serving import export_bundle, load_bundle
+    pipe, state = _linreg_pipe()
+    path = str(tmp_path / "model.dsb.npz")
+    with pytest.raises((BundleIncompatible, ValueError)):
+        export_bundle(pipe, path, buckets=(1,), state=state, hosts=3)
+
+
+# --------------------------------------------------------------------------
+# coordination: ranked exchange, typed timeout, capacity-ledger race
+# --------------------------------------------------------------------------
+
+def test_local_exchange_across_threads():
+    from dislib_tpu.runtime.coord import LocalCoordinator
+    co = LocalCoordinator()
+    out = {}
+
+    def worker(r):
+        out[r] = co.exchange("grid", r, {"rank": r}, n=3, timeout=10.0)
+
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(3)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    for r in range(3):
+        assert out[r] == {0: {"rank": 0}, 1: {"rank": 1}, 2: {"rank": 2}}
+
+
+def test_file_exchange_and_typed_timeout(tmp_path):
+    from dislib_tpu.runtime.coord import (CoordinationTimeout,
+                                          FileCoordinator)
+    co = FileCoordinator(str(tmp_path))
+    co.post("ex", 0, [1, 2])
+    co.post("ex", 1, [3])
+    got = co.exchange("ex", 0, [1, 2], n=2, timeout=5.0)
+    assert got == {0: [1, 2], 1: [3]}
+    with pytest.raises(CoordinationTimeout) as ei:
+        co.exchange("lonely", 0, "x", n=3, timeout=0.1)
+    assert set(ei.value.missing) == {1, 2}
+
+
+def test_capacity_ledger_last_coherent_wins(tmp_path):
+    """Two racing writers, one reader: every read is either a coherent
+    published record or an explicit no-statement (None) — never a torn
+    mix; the final state is the last coherent publish."""
+    from dislib_tpu.runtime.coord import CapacityLedger
+    path = str(tmp_path / "cap.ledger")
+    ledger = CapacityLedger(path)
+    stop = threading.Event()
+    bad_reads = []
+
+    def reader():
+        while not stop.is_set():
+            target, epoch = ledger.read()
+            if target is not None and target not in (2, 4, 8):
+                bad_reads.append((target, epoch))
+
+    def writer(vals):
+        for v in vals:
+            ledger.publish(v, writer=f"w{v}")
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+    w1 = threading.Thread(target=writer, args=([2, 4] * 25,))
+    w2 = threading.Thread(target=writer, args=([8, 4] * 25,))
+    w1.start(); w2.start(); w1.join(); w2.join()
+    stop.set(); rt.join()
+    assert not bad_reads
+    target, epoch = ledger.read()
+    assert target in (2, 4, 8) and epoch >= 1
+
+    # a torn/garbage file is an explicit no-statement, not a crash
+    with open(path, "w") as f:
+        f.write('{"epoch": 3, "target":')
+    assert ledger.read() == (None, 0)
+
+
+def test_capacity_env_precedence(tmp_path, monkeypatch):
+    """request_capacity (process override) wins over the ledger; with no
+    override the ledger speaks; clear_capacity republishes None."""
+    from dislib_tpu.runtime import (capacity_target, clear_capacity,
+                                    request_capacity)
+    from dislib_tpu.runtime.coord import CapacityLedger
+    path = str(tmp_path / "cap.ledger")
+    monkeypatch.setenv("DSLIB_CAPACITY_LEDGER", path)
+    try:
+        request_capacity(4)
+        assert capacity_target() == 4
+        # the override also published, so a ledger-only consumer agrees
+        assert CapacityLedger(path).read()[0] == 4
+    finally:
+        clear_capacity()
+    assert capacity_target() is None
+
+
+# --------------------------------------------------------------------------
+# serving: elastic capacity re-layout between batches (ROADMAP 3(c))
+# --------------------------------------------------------------------------
+
+def test_predict_server_elastic_shrink_grow():
+    from dislib_tpu.serving import PredictServer, ServePipeline
+    from dislib_tpu.runtime import clear_capacity, request_capacity
+    pipe, state = _linreg_pipe()
+    calls = []
+
+    def hook(m):
+        calls.append(None if m is None else _mesh.mesh_shape(m))
+        return None
+
+    x = np.random.RandomState(0).rand(4, NF).astype(np.float32)
+    exp = x @ state["coef"] + 5.0
+    _prof.reset_counters()
+    srv = PredictServer(pipeline=pipe, buckets=(1, 8), elastic=hook,
+                        capacity_poll_s=0.01)
+    try:
+        with srv:
+            np.testing.assert_allclose(srv.predict(x), exp, atol=1e-5)
+            request_capacity(4)
+            t0 = time.time()
+            while srv.stats()["mesh_resizes"] < 1 and time.time() - t0 < 30:
+                time.sleep(0.02)
+            assert srv.stats()["mesh_resizes"] == 1
+            assert _mesh.mesh_shape(_mesh.get_mesh()) == (4, 1)
+            np.testing.assert_allclose(srv.predict(x), exp, atol=1e-5)
+            request_capacity(8)
+            t0 = time.time()
+            while srv.stats()["mesh_resizes"] < 2 and time.time() - t0 < 30:
+                time.sleep(0.02)
+            assert srv.stats()["mesh_resizes"] == 2
+            assert _mesh.mesh_shape(_mesh.get_mesh()) == (8, 1)
+            np.testing.assert_allclose(srv.predict(x), exp, atol=1e-5)
+    finally:
+        clear_capacity()
+    # hook saw: pre-switch drain, new mesh, pre-switch drain, new mesh
+    assert calls == [None, (4, 1), None, (8, 1)]
+    res = _prof.resilience_counters()
+    assert res.get("serve_mesh_shrinks") == 1
+    assert res.get("serve_mesh_grows") == 1
+
+
+def test_predict_server_elastic_excludes_pool():
+    from dislib_tpu.serving import PredictServer
+    with pytest.raises(ValueError, match="elastic"):
+        PredictServer(pool=object(), buckets=(1,),
+                      elastic=lambda m: None)
+
+
+def test_predict_server_elastic_true_is_the_hookless_spelling():
+    """``elastic=True`` (no rebind hook) must serve AND resize — a
+    non-callable leaking into the worker thread would raise TypeError
+    there, killing serving and stranding every queued future (found
+    driving the surface, round 19)."""
+    from dislib_tpu.serving import PredictServer, ServePipeline  # noqa: F401
+    from dislib_tpu.runtime import clear_capacity, request_capacity
+    pipe, state = _linreg_pipe()
+    x = np.random.RandomState(1).rand(4, NF).astype(np.float32)
+    exp = x @ state["coef"] + 5.0
+    srv = PredictServer(pipeline=pipe, buckets=(1, 8), elastic=True,
+                        capacity_poll_s=0.01)
+    try:
+        with srv:
+            np.testing.assert_allclose(srv.predict(x), exp, atol=1e-5)
+            request_capacity(4)
+            t0 = time.time()
+            while srv.stats()["mesh_resizes"] < 1 and time.time() - t0 < 30:
+                time.sleep(0.02)
+            assert srv.stats()["mesh_resizes"] == 1
+            assert _mesh.mesh_shape(_mesh.get_mesh()) == (4, 1)
+            np.testing.assert_allclose(srv.predict(x), exp, atol=1e-5)
+    finally:
+        clear_capacity()
+    # elastic=False is plain disabled — legal even in pool mode
+    assert PredictServer(pool=None, pipeline=pipe, buckets=(1,),
+                         elastic=False)._elastic is None

@@ -36,6 +36,10 @@ MUTATION_ALLOWLIST = {
     # virtual host devices (the conftest bootstrap, applied pre-import
     # in the per-config subprocess); device-count flag only
     "bench.py",
+    # round-19 two-process dryrun worker: each rank bootstraps 2 virtual
+    # CPU devices pre-import (the mp_worker precedent); device-count
+    # flag only
+    "tools/mh_dryrun.py",
 }
 
 _MUTATION = re.compile(

@@ -11,6 +11,14 @@
 # zero transfers counter-asserted (DSLIB_ANN_RECALL_MIN /
 # DSLIB_ANN_SPEEDUP_MIN override the floors).
 #
+# Round 19 adds the dcn tier (bench_dcn): the hierarchical DCN-aware
+# rechunk under the DSLIB_MOCK_HOSTS overlay (the function sets it
+# itself, scoped) — inter-host messages per step <= hosts-1 (coalesced,
+# O(hosts) not O(panels)), dcn_bytes_moved == the deviceput floor,
+# bit-equal to the flat panel exchange, rechunk_dcn schedule-counted.
+# On a multi-PROCESS rig the same code path runs real host maps; see
+# tools/run_multihost.sh for the two-process dryrun.
+#
 # Usage:  tools/bench_chip.sh [OUT_JSON] [ROUND_N]
 #         OUT_JSON defaults to BENCH_r06.json, ROUND_N to the digits in
 #         OUT_JSON's name.

@@ -25,6 +25,11 @@ SECTIONS = [
       "apply_along_axis", "concat_rows", "concat_cols"]),
     ("Rechunk / redistribution", "dislib_tpu",
      ["rechunk", "ensure_canonical"]),
+    ("DCN-aware hierarchical rechunk (multi-host)", "dislib_tpu.ops.rechunk",
+     ["dcn_accounting", "dcn_supported", "pick_schedule"]),
+    ("Host topology (real map or DSLIB_MOCK_HOSTS overlay)",
+     "dislib_tpu.parallel.hosts",
+     ["host_of", "host_map", "n_hosts", "mock_hosts", "host_blocks"]),
     ("I/O", "dislib_tpu",
      ["load_txt_file", "load_svmlight_file", "load_npy_file",
       "load_mdcrd_file", "save_txt"]),
@@ -71,7 +76,8 @@ SECTIONS = [
       "AsyncFetch"]),
     ("Health runtime (self-healing fits)", "dislib_tpu.runtime.health",
      ["HealthPolicy", "ChunkGuard", "Verdict", "Remediation",
-      "NumericalDivergence", "WatchdogTimeout", "guard", "health_vec"]),
+      "NumericalDivergence", "WatchdogTimeout", "guard", "health_vec",
+      "check_snapshot"]),
     ("Chunked fit-loop driver (resilient-by-construction estimators)",
      "dislib_tpu.runtime",
      ["ChunkedFitLoop", "LoopState", "ChunkOutcome", "EscalationLadder",
@@ -87,7 +93,11 @@ SECTIONS = [
      ["export_bundle", "load_bundle", "runtime_fingerprint",
       "BundlePipeline", "LoadedBundle"]),
     ("Bundle I/O (checksummed artifact seam)", "dislib_tpu.runtime",
-     ["write_bundle", "read_bundle", "BundleIncompatible"]),
+     ["write_bundle", "read_bundle", "BundleIncompatible",
+      "BundleShardCorrupt"]),
+    ("Coordination service (multi-host control plane)", "dislib_tpu.runtime",
+     ["get_coordinator", "LocalCoordinator", "FileCoordinator",
+      "KVCoordinator", "CoordinationTimeout", "CapacityLedger"]),
     ("Multi-tenant routing", "dislib_tpu.serving",
      ["ModelRouter", "TenantQuotaExceeded", "DeadlineShed"]),
     ("Vector retrieval (IVF-ANN search tier)", "dislib_tpu.retrieval",

@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Two-process DCN data-plane dryrun (round 19): two REAL JAX CPU
+# processes under jax.distributed — rechunk parity on the hierarchical
+# `dcn` schedule, the sharded-bundle load barrier (including the
+# poisoned-shard typed abort), and a coherent cross-process
+# shrink→grow capacity episode.  See tools/mh_dryrun.py for the phases.
+#
+# The coordination service (jax.distributed KV) is platform-independent,
+# so the bundle-barrier and capacity phases run for real everywhere.
+# Only the rechunk COLLECTIVE phase needs multiprocess CPU support
+# (jaxlib >= 0.6); on older rigs the worker skips that one phase loudly
+# — its bit-equality is still proven on every tier-1 run through the
+# single-process DSLIB_MOCK_HOSTS overlay
+# (tests/test_multihost_dataplane.py).  DSLIB_FORCE_MP_TESTS=1 forces
+# the collective phase regardless.
+#
+#   tools/run_multihost.sh
+cd "$(dirname "$0")/.." || exit 1
+
+WORK=$(mktemp -d)
+trap 'rm -rf "$WORK"' EXIT
+PORT=$(python - <<'EOF'
+import socket
+s = socket.socket(); s.bind(("127.0.0.1", 0))
+print(s.getsockname()[1]); s.close()
+EOF
+)
+
+echo "-- launching 2 ranks (coordinator 127.0.0.1:$PORT, work $WORK) --"
+pids=()
+for r in 0 1; do
+  env -u XLA_FLAGS -u JAX_PLATFORMS \
+      timeout -k 10 300 \
+      python tools/mh_dryrun.py "$r" 2 "$PORT" "$WORK" \
+      > "$WORK/rank$r.log" 2>&1 &
+  pids+=($!)
+done
+
+rc=0
+for i in 0 1; do
+  if ! wait "${pids[$i]}"; then rc=1; fi
+done
+for r in 0 1; do
+  echo "-- rank $r --"
+  cat "$WORK/rank$r.log"
+done
+if [ $rc -eq 0 ] && grep -q "ALL PHASES GREEN" "$WORK/rank0.log" \
+   && grep -q "ALL PHASES GREEN" "$WORK/rank1.log"; then
+  echo "MULTIHOST DRYRUN: PASS"
+else
+  echo "MULTIHOST DRYRUN: FAIL"
+  exit 1
+fi

@@ -9,4 +9,15 @@ cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 1200 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -rs -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo "-- env-gated skips (reasons) --"
 grep -a "^SKIPPED" /tmp/_t1.log || echo "(none)"
+# the multihost tier rots silently unless someone runs it: when this rig
+# CAN host two jax.distributed CPU processes but the dryrun wasn't part
+# of this invocation, say so in one line (round-19 satellite)
+if [ -z "${DSLIB_MULTIHOST_TIER:-}" ] && python - <<'EOF' >/dev/null 2>&1
+import jax.distributed  # the coordination service import, cheap
+EOF
+then
+  echo "hint: jax.distributed is importable here — the two-process" \
+       "multihost dryrun (rechunk parity, bundle load barrier, capacity" \
+       "ledger) was NOT run; try: tools/run_multihost.sh"
+fi
 exit $rc
