@@ -1711,6 +1711,74 @@ def bench_resilience(m, n, k, iters, tag, every=2):
             "healed_equals_unfaulted": True}
 
 
+def bench_mh_resilience(tag, max_wall_s=480.0, recovery_max_s=30.0):
+    """Round-20 multi-host survival tier: the REAL process-killing chaos
+    drill (``tools/mh_dryrun.py --chaos``) as a gated bench row.  Two
+    coordinated CPU processes; one is SIGKILLed mid-fit, restarted,
+    heartbeat-delayed, fed torn coordination/ledger writes, and killed
+    again at the sharded-bundle load barrier.  Gates, all hard:
+
+    - the drill PASSES — typed attributed ``RankDead``, the survivor's
+      resumed model equals the shrunk-fleet oracle, the restart rejoins
+      under a bumped epoch (stale writes fenced) and grows back, torn
+      files heal as TRANSIENT, and BOTH barrier-abort modes are typed;
+    - zero hangs — the whole episode is bounded by ``max_wall_s`` (the
+      drill additionally hard-bounds every internal wait);
+    - recovery wall — death → published shrunk capacity under
+      ``recovery_max_s``;
+    - the rank_deaths / rank_rejoins / mesh_shrinks / mesh_grows /
+      bundle_barrier_abort counters all actually recorded.
+
+    ``value`` is the full-episode wall — informational; the gates are
+    the point (the ``bench_resilience`` precedent)."""
+    import shutil
+    import tempfile
+    here = os.path.dirname(os.path.abspath(__file__))
+    driver = os.path.join(here, "tools", "mh_dryrun.py")
+    workdir = tempfile.mkdtemp(prefix="dslib-bench-mh-")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.perf_counter()
+    try:
+        try:
+            proc = subprocess.run(
+                [sys.executable, driver, "--chaos", workdir],
+                env=env, capture_output=True, text=True,
+                timeout=max_wall_s)
+        except subprocess.TimeoutExpired:
+            raise AssertionError(
+                f"HANG: the chaos drill exceeded {max_wall_s}s")
+        wall = time.perf_counter() - t0
+        out = proc.stdout + proc.stderr
+        if proc.returncode != 0 or "MULTIHOST CHAOS: PASS" not in out:
+            raise AssertionError(
+                f"chaos drill failed (rc={proc.returncode}): "
+                f"{out[-2000:]}")
+        with open(os.path.join(workdir, "chaos_result.json")) as f:
+            result = json.load(f)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    c, t = result["counters"], result["timings"]
+    for key, want in (("rank_deaths", 3), ("rank_rejoins", 2),
+                      ("mesh_shrinks", 2), ("mesh_grows", 1),
+                      ("bundle_barrier_abort", 2)):
+        if c.get(key, 0) < want:
+            raise AssertionError(
+                f"counter {key}={c.get(key, 0)} < {want}: {c}")
+    if t["death_to_capacity_s"] > recovery_max_s:
+        raise AssertionError(
+            f"recovery wall {t['death_to_capacity_s']:.2f}s exceeds "
+            f"{recovery_max_s}s")
+    return {"metric": f"mh_resilience_{tag}_episode_wall_s",
+            "value": round(wall, 2), "unit": "s", "vs_baseline": None,
+            "death_to_capacity_s": round(t["death_to_capacity_s"], 2),
+            "barrier_abort_attributed_s":
+                round(t["barrier_abort_attributed_s"], 2),
+            "barrier_abort_deadline_s":
+                round(t["barrier_abort_deadline_s"], 2),
+            "counters": c, "healed_equals_shrunk_oracle": True,
+            "rejoin_epoch_fenced": True, "hangs": 0}
+
+
 def bench_rtt(repeats=21):
     """Fixed per-dispatch round-trip floor of this backend (informational).
 
@@ -2898,6 +2966,10 @@ def _configs():
             # round-12 fit-loop driver: heal == unfaulted, +1 dispatch only
             ("resilience_smoke",
              lambda: bench_resilience(1000, 20, 4, 8, "smoke")),
+            # round-20 multi-host survival: the real SIGKILL chaos drill,
+            # all counters + recovery wall + zero-hang gated
+            ("mh_resilience_smoke",
+             lambda: bench_mh_resilience("smoke")),
             ("fused_chain_smoke",
              lambda: bench_fused_chain(256, 32, "smoke")),
             ("tsqr_smoke", lambda: bench_tsqr(2048, 64)),
@@ -3025,6 +3097,11 @@ def _configs():
         ("resilience_100000x50_k8_heal_wall_s",
          lambda: bench_resilience(100_000, 50, 8, 20,
                                   "100000x50_k8")),
+        # round-20 multi-host survival: the process-killing chaos drill
+        # (always CPU-coordinated — the jax.distributed CPU service is
+        # platform-independent; see tools/mh_dryrun.py)
+        ("mh_resilience_episode_wall_s",
+         lambda: bench_mh_resilience("full")),
         ("dbscan_200000x10_wall_s",
          lambda: bench_dbscan(200_000, 10, "200000x10", proxy_m=20_000)),
         ("daura_50000x15_wall_s",

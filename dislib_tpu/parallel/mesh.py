@@ -64,6 +64,17 @@ def init(mesh_shape: tuple[int, int] | None = None, devices=None) -> Mesh:
     if r * c > n:
         raise ValueError(f"mesh_shape {mesh_shape} needs {r * c} devices, have {n}")
     dev_grid = np.asarray(devices[: r * c]).reshape(r, c)
+    # changing the DEVICE SET (not just the grid shape) invalidates every
+    # cached trace whose sharding constraints were baked for the old set:
+    # jit replays such a trace against arrays on the new set and dies with
+    # "incompatible devices" (the round-6 stale-constraint failure mode —
+    # fitloop._resize_mesh clears for the same reason).  Same-set re-inits
+    # (the overwhelmingly common case: reshaping the grid over all
+    # devices) keep their caches — re-layouts already retrace.
+    if _default_mesh is not None and \
+            set(d.id for d in _default_mesh.devices.reshape(-1)) != \
+            set(d.id for d in dev_grid.reshape(-1)):
+        jax.clear_caches()
     _default_mesh = Mesh(dev_grid, AXIS_NAMES)
     return _default_mesh
 

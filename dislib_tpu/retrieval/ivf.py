@@ -355,6 +355,14 @@ class IVFIndex:
         self.n_lists_ = int(nlist)
         self._fitted_mesh = (p, c)
         self._fitted_quantum = int(mq_quant)
+        # elastic rebind seam (round 20): the striped buffers above are
+        # mesh-SHAPED, so a capacity resize invalidates them — keep the
+        # host-side layout inputs (they were already materialized to
+        # build from; no extra peak) and rebind_mesh() re-stripes onto
+        # whatever mesh the elastic rung lands on
+        self._items_h = items_h
+        self._labels_h = labels_h
+        self._centers_h = centers_h
         list_pad = int(pad_ls.sum() - counts_l.sum())
         self.pad_waste = {
             "entries": int(n),
@@ -368,6 +376,30 @@ class IVFIndex:
         }
         return self
 
+    def rebind_mesh(self, mesh) -> bool:
+        """The elastic rebind hook (``fitloop.data_rebind`` delegates
+        here): re-stripe the inverted lists onto the CURRENT mesh from
+        the retained host layout inputs.  ``mesh=None`` (the driver's
+        pre-switch "force pending work" phase) is a no-op — the index
+        buffers are committed arrays, nothing is pending.  Returns True
+        when a re-layout actually happened (counted
+        ``retrieval_rebinds``)."""
+        if mesh is None or getattr(self, "n_items", None) is None:
+            return False
+        now = _mesh.mesh_shape(_mesh.get_mesh())
+        if now == self._fitted_mesh and \
+                _mesh.pad_quantum(_mesh.get_mesh()) == self._fitted_quantum:
+            return False
+        if getattr(self, "_items_h", None) is None:
+            raise RuntimeError(
+                f"IVFIndex was built on mesh {self._fitted_mesh} but the "
+                f"current mesh is {now}, and the host layout inputs were "
+                "dropped — refit (or rebuild via _build) on the new mesh")
+        self._build(self._items_h, self._labels_h, self._centers_h)
+        from dislib_tpu.utils.profiling import count_resilience
+        count_resilience("retrieval_rebinds")
+        return True
+
     def _check_fitted(self):
         if getattr(self, "n_items", None) is None:
             raise RuntimeError("IVFIndex is not fitted — call fit() first")
@@ -375,6 +407,13 @@ class IVFIndex:
         now = _mesh.mesh_shape(mesh)
         if now != self._fitted_mesh \
                 or _mesh.pad_quantum(mesh) != self._fitted_quantum:
+            # a capacity resize moved the mesh under us: the striped
+            # list buffers are mesh-shaped, so re-stripe from the host
+            # layout inputs (round 20 — heals like every other
+            # estimator) rather than refusing to serve
+            if getattr(self, "_items_h", None) is not None:
+                self.rebind_mesh(mesh)
+                return
             raise RuntimeError(
                 f"IVFIndex was built on mesh {self._fitted_mesh} (quantum "
                 f"{self._fitted_quantum}) but the current mesh is {now} "

@@ -92,6 +92,7 @@ class RetrievalPipeline:
         self.n_features = int(index.d)
         self.out_cols = 2 * self.k
         self._templates: dict[int, BucketTemplate] = {}
+        self._templates_key = None      # (mesh shape, quantum) they fit
 
     def _pshape(self, bucket: int):
         from dislib_tpu.data.array import _padded_shape
@@ -99,11 +100,31 @@ class RetrievalPipeline:
                              _mesh.pad_quantum())
 
     def _template(self, bucket: int) -> BucketTemplate:
+        # canvases are PAD-QUANTUM-shaped, and the quantum follows the
+        # mesh: when the mesh moved under us (the index auto-rebinds in
+        # ``_check_fitted`` — round 20's capacity heal), a cached canvas
+        # would stage queries into the OLD pad and every request would
+        # tear on a shape mismatch.  Key the cache on the mesh epoch.
+        key = (_mesh.mesh_shape(_mesh.get_mesh()), _mesh.pad_quantum())
+        if key != self._templates_key:
+            self._templates.clear()
+            self._templates_key = key
         tmpl = self._templates.get(bucket)
         if tmpl is None:
             tmpl = self._templates[bucket] = BucketTemplate(
                 self._pshape(bucket))
         return tmpl
+
+    def rebind_mesh(self, mesh):
+        """Elastic rebind (round 20): delegate the index's re-stripe,
+        then drop the bucket canvases — their padded shapes follow the
+        mesh quantum, so a stale template would stage queries into the
+        wrong pad.  This is what ``PredictServer(elastic=...)`` wraps,
+        and what ``fitloop.data_rebind`` finds on a retrieval holder."""
+        rebound = self.index.rebind_mesh(mesh)
+        if mesh is not None and rebound:
+            self._templates.clear()
+        return rebound
 
     def _kernel_args(self, dev):
         ix = self.index

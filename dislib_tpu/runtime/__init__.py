@@ -31,7 +31,9 @@ the survival story is built from four pieces that compose (SURVEY §6
   ranked ``exchange`` primitive over three transports (in-memory /
   shared directory / ``jax.distributed`` KV) behind the sharded-bundle
   load barrier, plus the atomically-replaced :class:`CapacityLedger`
-  that makes the capacity level fleet-wide (``coord.py``);
+  that makes the capacity level fleet-wide; round 20 adds lease-based
+  :class:`Membership` (heartbeats, epoch fencing, the typed attributed
+  :class:`RankDead`) and the death→capacity→heal flow (``coord.py``);
 - **trainer** — the round-17 continuous-learning daemon:
   :class:`ContinuousTrainer` welds the quarantined stream, the chunked
   fit loop, retried bundle exports, and the router's canary/promote
@@ -53,7 +55,11 @@ from dislib_tpu.runtime.bundle_io import (BundleIncompatible,
                                           write_bundle)
 from dislib_tpu.runtime.coord import (CapacityLedger, CoordinationTimeout,
                                       FileCoordinator, KVCoordinator,
-                                      LocalCoordinator, get_coordinator)
+                                      LeaseKeeper, LocalCoordinator,
+                                      Membership, RankDead, TornCoordFile,
+                                      barrier_timeout, current_membership,
+                                      get_coordinator, lease_seconds,
+                                      resilient_exchange, set_membership)
 from dislib_tpu.runtime.elastic import AsyncFetch, fetch, repad_rows
 from dislib_tpu.runtime.health import (ChunkGuard, HealthPolicy,
                                        NumericalDivergence, WatchdogTimeout)
@@ -81,6 +87,9 @@ __all__ = [
     "write_bundle",
     "CapacityLedger", "CoordinationTimeout", "get_coordinator",
     "LocalCoordinator", "FileCoordinator", "KVCoordinator",
+    "Membership", "LeaseKeeper", "RankDead", "TornCoordFile",
+    "set_membership", "current_membership", "resilient_exchange",
+    "lease_seconds", "barrier_timeout",
     "ChunkedFitLoop", "ChunkOutcome", "LoopState", "Escalation",
     "EscalationLadder",
     "ContinuousTrainer", "PromotionFailed",

@@ -32,6 +32,18 @@ rewritten in place by atomic rename.  Readers treat ANY incoherent state
 (missing file, torn JSON, bad crc) as "no statement" — the fleet holds
 its current size rather than acting on garbage — and concurrent writers
 resolve by last-coherent-rename-wins, asserted by the ledger race test.
+
+Round 20 adds **membership**: every rank renews a heartbeat *lease*
+(:class:`Membership` / :class:`LeaseKeeper`, period from
+``DSLIB_COORD_LEASE_MS``); an exchange whose missing peer holds an
+EXPIRED lease raises the typed, attributed :class:`RankDead` instead of
+a generic timeout, so survivors know *who* died and *when*.  A restarted
+rank rejoins under a bumped **epoch** and values it posted under the old
+epoch are fenced out of every gather — last-coherent-wins extended to
+membership.  On a confirmed death the detecting survivor publishes a
+shrunk target to the capacity ledger (``death:rank<r>``) and the
+existing elastic rungs heal every surviving fit; a rejoin clears it
+(``rejoin:rank<r>``) and the fleet grows back.
 """
 
 from __future__ import annotations
@@ -43,10 +55,35 @@ import threading
 import time
 import zlib
 
-__all__ = ["CoordinationTimeout", "LocalCoordinator", "FileCoordinator",
-           "KVCoordinator", "get_coordinator", "CapacityLedger"]
+__all__ = ["CoordinationTimeout", "RankDead", "TornCoordFile",
+           "LocalCoordinator", "FileCoordinator", "KVCoordinator",
+           "get_coordinator", "CapacityLedger", "Membership",
+           "LeaseKeeper", "set_membership", "current_membership",
+           "resilient_exchange", "lease_seconds", "barrier_timeout"]
 
 _POLL_S = 0.02
+
+#: reserved exchange name under which leases are posted; never clear()ed
+_LEASE_NAME = "__lease__"
+
+
+def lease_seconds() -> float:
+    """The lease TTL in seconds — ``DSLIB_COORD_LEASE_MS`` (default
+    2000 ms).  A rank whose lease is older than this is presumed dead."""
+    try:
+        return max(1.0, float(os.environ.get("DSLIB_COORD_LEASE_MS",
+                                             "2000"))) / 1000.0
+    except ValueError:
+        return 2.0
+
+
+def barrier_timeout(default: float = 30.0) -> float:
+    """Fleet-barrier deadline in seconds — ``DSLIB_BARRIER_TIMEOUT``.
+    One dead host must abort ALL hosts typed within this budget."""
+    try:
+        return float(os.environ.get("DSLIB_BARRIER_TIMEOUT", default))
+    except ValueError:
+        return float(default)
 
 
 class CoordinationTimeout(RuntimeError):
@@ -59,8 +96,51 @@ class CoordinationTimeout(RuntimeError):
         self.missing = tuple(missing)
 
 
+class RankDead(CoordinationTimeout):
+    """A peer's heartbeat lease EXPIRED — not "slow", confirmed missing.
+    Subclasses :class:`CoordinationTimeout` so existing barrier handlers
+    still catch it, but classified FATAL by ``runtime.retry`` (retrying
+    cannot resurrect a dead process; healing goes through the capacity
+    ledger instead).  Attributed: carries ``rank``, ``last_seen`` (wall
+    clock of the final heartbeat) and the lease ``epoch``."""
+
+    def __init__(self, rank: int, last_seen: float, epoch: int = 0,
+                 message: str | None = None):
+        if message is None:
+            message = (f"rank {int(rank)} is dead — lease (epoch "
+                       f"{int(epoch)}) expired, last heartbeat at "
+                       f"{float(last_seen):.3f}")
+        super().__init__(message, missing=(int(rank),))
+        self.rank = int(rank)
+        self.last_seen = float(last_seen)
+        self.epoch = int(epoch)
+
+
+class TornCoordFile(CoordinationTimeout):
+    """A coordination file existed but failed its CRC / JSON parse — a
+    reader raced a (possibly killed) non-atomic writer.  TRANSIENT: the
+    writer re-posting heals it, so readers retry through
+    ``runtime.Retry`` rather than killing a healthy fleet."""
+
+    def __init__(self, path: str, reason: str = "bad crc"):
+        super().__init__(f"torn coordination file {path!r} ({reason})")
+        self.path = str(path)
+        self.reason = str(reason)
+
+
 def _deadline(timeout: float) -> float:
     return time.monotonic() + float(timeout)
+
+
+def _check_membership(missing) -> None:
+    """Poll-loop hook shared by every transport's exchange: when a
+    process-global :class:`Membership` is registered and one of the
+    still-missing ranks holds an EXPIRED lease, abort the wait with the
+    attributed :class:`RankDead` now — don't burn the rest of the
+    timeout waiting for a process that cannot arrive."""
+    m = _MEMBERSHIP
+    if m is not None:
+        m.raise_if_dead(missing)
 
 
 class LocalCoordinator:
@@ -77,6 +157,12 @@ class LocalCoordinator:
             self._store[(str(name), int(rank))] = value
             self._lock.notify_all()
 
+    def peek(self, name: str, rank: int):
+        """The value posted under ``(name, rank)``, or None — never
+        blocks (lease reads and fenced gathers poll through this)."""
+        with self._lock:
+            return self._store.get((str(name), int(rank)))
+
     def exchange(self, name: str, rank: int, value, n: int,
                  timeout: float = 30.0) -> dict:
         self.post(name, rank, value)
@@ -87,9 +173,15 @@ class LocalCoordinator:
                        if nm == str(name)}
                 if len(got) >= int(n):
                     return {r: got[r] for r in sorted(got)}
+                missing = sorted(set(range(int(n))) - set(got))
+                _check_membership(missing)
                 left = end - time.monotonic()
-                if left <= 0 or not self._lock.wait(left):
-                    missing = sorted(set(range(int(n))) - set(got))
+                # wait in lease-sized slices when membership is live so
+                # an expiring peer is noticed mid-wait, not post-timeout
+                slice_ = left if _MEMBERSHIP is None else min(left, 0.05)
+                if left <= 0 or not self._lock.wait(slice_):
+                    if time.monotonic() < end:
+                        continue
                     raise CoordinationTimeout(
                         f"exchange {name!r}: {len(got)}/{n} values after "
                         f"{timeout}s — missing ranks {missing}", missing)
@@ -100,11 +192,23 @@ class LocalCoordinator:
                 del self._store[k]
 
 
+def _post_crc(value) -> str:
+    payload = json.dumps(value)
+    return f"{zlib.crc32(payload.encode()) & 0xFFFFFFFF:08x}"
+
+
 class FileCoordinator:
     """Shared-directory exchange: each post is one atomically-renamed
     JSON file ``<dir>/<name>.<rank>.json``; the gather polls for all
-    ``n``.  Rename atomicity means a reader can never observe a torn
-    post — a file either doesn't exist yet or is complete."""
+    ``n``.  Rename atomicity means a healthy writer can never expose a
+    torn post — but a chaos-injected or crashed NON-atomic writer can,
+    so payloads carry a CRC (like the capacity ledger) and a file that
+    exists-but-fails-verification is classified TRANSIENT
+    (:class:`TornCoordFile`) and retried through ``runtime.Retry``: the
+    writer re-posting heals it, and a reader racing a writer never
+    kills a healthy fleet."""
+
+    _MISSING = object()                 # peek sentinel: no file at all
 
     def __init__(self, directory: str):
         self.directory = str(directory)
@@ -114,7 +218,7 @@ class FileCoordinator:
 
     def post(self, name: str, rank: int, value) -> None:
         os.makedirs(self.directory, exist_ok=True)
-        payload = json.dumps(value).encode()
+        payload = json.dumps({"crc": _post_crc(value), "v": value}).encode()
         fd, tmp = tempfile.mkstemp(dir=self.directory)
         try:
             with os.fdopen(fd, "wb") as f:
@@ -127,6 +231,47 @@ class FileCoordinator:
                 os.remove(tmp)
             raise
 
+    def _read_once(self, path: str):
+        """One verification attempt: ``_MISSING`` when the file does not
+        exist, the payload when coherent, :class:`TornCoordFile` when it
+        exists but fails parse/CRC."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return self._MISSING
+        try:
+            rec = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError) as e:
+            raise TornCoordFile(path, f"unparseable ({e})") from e
+        if isinstance(rec, dict) and set(rec) == {"crc", "v"}:
+            if rec["crc"] != _post_crc(rec["v"]):
+                raise TornCoordFile(path, "crc mismatch")
+            return rec["v"]
+        return rec                      # pre-round-20 bare payload
+
+    def _read(self, path: str):
+        """Read one post, retrying a torn file through ``runtime.Retry``
+        (``DSLIB_COORD_READ_RETRIES``, default 3 — a racing writer's
+        re-post heals it within a poll or two).  Still torn after the
+        budget → ``_MISSING``: the outer gather keeps polling and its
+        eventual timeout names the rank, so a permanently-torn file
+        degrades to "never posted", not a fleet kill."""
+        from dislib_tpu.runtime.retry import Retry
+        from dislib_tpu.utils.profiling import count_resilience
+        attempts = int(os.environ.get("DSLIB_COORD_READ_RETRIES", "3"))
+        try:
+            return Retry(attempts=max(1, attempts), backoff=_POLL_S,
+                         max_backoff=0.25, jitter=0.0).call(
+                self._read_once, path)
+        except TornCoordFile:
+            count_resilience("coord_torn_reads")
+            return self._MISSING
+
+    def peek(self, name: str, rank: int):
+        v = self._read(self._path(name, rank))
+        return None if v is self._MISSING else v
+
     def exchange(self, name: str, rank: int, value, n: int,
                  timeout: float = 30.0) -> dict:
         self.post(name, rank, value)
@@ -134,16 +279,14 @@ class FileCoordinator:
         while True:
             got = {}
             for r in range(int(n)):
-                p = self._path(name, r)
-                try:
-                    with open(p, "rb") as f:
-                        got[r] = json.loads(f.read().decode())
-                except (OSError, ValueError):
-                    continue            # not posted yet (or mid-rename)
+                v = self._read(self._path(name, r))
+                if v is not self._MISSING:
+                    got[r] = v
             if len(got) >= int(n):
                 return got
+            missing = sorted(set(range(int(n))) - set(got))
+            _check_membership(missing)
             if time.monotonic() >= end:
-                missing = sorted(set(range(int(n))) - set(got))
                 raise CoordinationTimeout(
                     f"exchange {name!r} in {self.directory}: {len(got)}/"
                     f"{n} values after {timeout}s — missing ranks "
@@ -179,23 +322,61 @@ class KVCoordinator:
         self._client = client
 
     def post(self, name: str, rank: int, value) -> None:
-        self._client.key_value_set(f"dslib/{name}/{int(rank)}",
-                                   json.dumps(value))
+        key = f"dslib/{name}/{int(rank)}"
+        payload = json.dumps(value)
+        try:
+            # overwrite: lease renewals rewrite their key every beat,
+            # and a retried exchange must be able to re-post its vote
+            self._client.key_value_set(key, payload, True)
+        except TypeError:               # jaxlib without allow_overwrite
+            self._client.key_value_set(key, payload)
+
+    def peek(self, name: str, rank: int):
+        """Non-blocking single read via the directory listing — the KV
+        store has no try-get, but ``key_value_dir_get`` returns only
+        keys that exist."""
+        try:
+            entries = self._client.key_value_dir_get(f"dslib/{name}/")
+        except Exception:               # noqa: BLE001 — absent prefix
+            return None
+        suffix = f"/{int(rank)}"
+        for key, raw in entries:
+            if key.endswith(suffix):
+                return json.loads(raw)
+        return None
 
     def exchange(self, name: str, rank: int, value, n: int,
                  timeout: float = 30.0) -> dict:
         self.post(name, rank, value)
         got = {}
-        ms = max(1, int(float(timeout) * 1000))
+        end = _deadline(timeout)
+        # blocking gets run in lease-sized slices so an expired peer is
+        # reported as RankDead mid-wait instead of a generic timeout
+        slice_ms = 250 if _MEMBERSHIP is not None else None
         for r in range(int(n)):
-            try:
-                raw = self._client.blocking_key_value_get(
-                    f"dslib/{name}/{r}", ms)
-            except Exception as e:      # noqa: BLE001 — timeout is typed
-                raise CoordinationTimeout(
-                    f"exchange {name!r}: rank {r} never posted within "
-                    f"{timeout}s ({e})", [r]) from e
-            got[r] = json.loads(raw)
+            while True:
+                left = end - time.monotonic()
+                if left <= 0:
+                    raise CoordinationTimeout(
+                        f"exchange {name!r}: rank {r} never posted "
+                        f"within {timeout}s", [r])
+                ms = max(1, int(left * 1000))
+                if slice_ms is not None:
+                    ms = min(ms, slice_ms)
+                try:
+                    raw = self._client.blocking_key_value_get(
+                        f"dslib/{name}/{r}", ms)
+                    got[r] = json.loads(raw)
+                    break
+                except Exception as e:  # noqa: BLE001 — timeout is typed
+                    _check_membership([r])
+                    left = end - time.monotonic()
+                    if left > 0:
+                        time.sleep(min(_POLL_S, left))  # service-error pace
+                        continue
+                    raise CoordinationTimeout(
+                        f"exchange {name!r}: rank {r} never posted "
+                        f"within {timeout}s ({e})", [r]) from e
         return got
 
     def clear(self, name: str) -> None:
@@ -221,6 +402,293 @@ def get_coordinator():
     except Exception:                   # noqa: BLE001 — fall to local
         pass
     return _LOCAL
+
+
+# ---------------------------------------------------------------------------
+# membership: heartbeat leases, epoch fencing, death → capacity
+# ---------------------------------------------------------------------------
+
+_MEMBERSHIP = None                     # process-global, set_membership()
+
+
+def set_membership(membership) -> None:
+    """Register (or clear, with None) the process-global membership.
+    Once registered, EVERY coordinator exchange in this process becomes
+    death-aware: a missing peer whose lease expired aborts the wait with
+    :class:`RankDead` instead of burning the timeout."""
+    global _MEMBERSHIP
+    _MEMBERSHIP = membership
+
+
+def current_membership():
+    return _MEMBERSHIP
+
+
+class Membership:
+    """Lease-based fleet membership over any coordinator transport.
+
+    Each live rank posts a lease record ``{"epoch", "t"}`` under the
+    reserved exchange name ``__lease__`` and renews it every
+    ``lease/3`` seconds (:class:`LeaseKeeper`).  A lease older than
+    ``DSLIB_COORD_LEASE_MS`` is an expired peer: :meth:`raise_if_dead`
+    raises the attributed :class:`RankDead` and :meth:`poll` converts
+    the observation into fleet healing —
+
+    - **death** → ``rank_deaths`` counted, and (``heal_capacity=True``)
+      the shrunk per-host device target ``max(1, devices·live//n)`` is
+      published through ``runtime.preemption.request_capacity`` with
+      writer ``death:rank<r>`` — every surviving fit's elastic rungs
+      take it from there;
+    - **rejoin** (a dead rank's lease reappears — a restart under a
+      bumped epoch, or a delayed heartbeat resuming) → ``rank_rejoins``
+      counted and the capacity statement is recomputed (cleared when
+      the whole fleet is back).
+
+    **Epoch fencing**: :meth:`join` bumps the epoch found in any prior
+    lease, :meth:`post`/:meth:`gather`/:meth:`exchange` stamp values
+    with the writer's epoch, and a gather drops values whose epoch is
+    older than the writer's CURRENT lease — a restarted rank's stale
+    pre-crash posts can never satisfy a post-restart barrier
+    (last-coherent-wins, extended to membership).
+
+    ``clock``/``sleep`` are injectable so tier-1 tests drive expiry with
+    a mocked clock — no real waits.  ``devices`` is the per-host device
+    count used for shrunk targets (defaults to
+    ``jax.local_device_count()`` at first use).
+    """
+
+    def __init__(self, rank: int, n: int, coord=None, lease_ms=None,
+                 clock=time.time, sleep=time.sleep, devices=None,
+                 heal_capacity: bool = True):
+        self.rank = int(rank)
+        self.n = int(n)
+        self.coord = coord if coord is not None else get_coordinator()
+        self.lease_s = (float(lease_ms) / 1000.0 if lease_ms is not None
+                        else lease_seconds())
+        self._clock = clock
+        self._sleep = sleep
+        self._devices = devices
+        self.heal_capacity = bool(heal_capacity)
+        self.epoch = 0
+        self._dead: dict = {}           # rank -> epoch at death report
+        self._lock = threading.Lock()   # poll() runs on the keeper thread
+
+    # -- leases ------------------------------------------------------------
+
+    def join(self) -> int:
+        """Enter (or re-enter) the fleet: bump past any prior lease's
+        epoch — a restart rejoins under a NEW epoch so its old posts are
+        fenced — and publish the first heartbeat.  Returns the epoch."""
+        prior = self.coord.peek(_LEASE_NAME, self.rank)
+        prior_epoch = int(prior["epoch"]) if prior else 0
+        self.epoch = prior_epoch + 1
+        self.heartbeat()
+        return self.epoch
+
+    def heartbeat(self) -> None:
+        """Renew this rank's lease (LeaseKeeper calls this every
+        ``lease/3`` seconds; call it manually at natural boundaries in
+        keeper-less deployments)."""
+        self.coord.post(_LEASE_NAME, self.rank,
+                        {"epoch": self.epoch, "t": float(self._clock())})
+
+    def lease_of(self, rank: int):
+        """``{"epoch", "t"}`` for a rank, or None when it never joined."""
+        rec = self.coord.peek(_LEASE_NAME, int(rank))
+        if isinstance(rec, dict) and "epoch" in rec and "t" in rec:
+            return {"epoch": int(rec["epoch"]), "t": float(rec["t"])}
+        return None
+
+    def dead(self, ranks=None):
+        """Expired peers among ``ranks`` (default: all peers) as
+        ``[(rank, last_seen, epoch), ...]``.  A rank with NO lease is
+        merely missing, not dead — only a lease that stopped renewing
+        is evidence of death."""
+        now = float(self._clock())
+        if ranks is None:
+            ranks = range(self.n)
+        out = []
+        for r in ranks:
+            r = int(r)
+            if r == self.rank:
+                continue
+            lease = self.lease_of(r)
+            if lease is not None and now - lease["t"] > self.lease_s:
+                out.append((r, lease["t"], lease["epoch"]))
+        return out
+
+    def raise_if_dead(self, ranks=None) -> None:
+        """Raise :class:`RankDead` for the first expired peer among
+        ``ranks`` (default all peers); no-op when everyone's fresh."""
+        for r, last_seen, epoch in self.dead(ranks):
+            raise RankDead(r, last_seen, epoch)
+
+    # -- death / rejoin → capacity ------------------------------------------
+
+    def _local_devices(self) -> int:
+        if self._devices is None:
+            import jax
+            self._devices = int(jax.local_device_count())
+        return int(self._devices)
+
+    def _publish_capacity(self, writer: str) -> None:
+        if not self.heal_capacity:
+            return
+        from dislib_tpu.runtime import preemption
+        live = self.n - len(self._dead)
+        if live >= self.n:
+            preemption.clear_capacity(writer=writer)
+        else:
+            target = max(1, self._local_devices() * live // self.n)
+            preemption.request_capacity(target, writer=writer)
+
+    def poll(self):
+        """One membership sweep: detect new deaths and rejoins, count
+        them (``rank_deaths`` / ``rank_rejoins``), steer the capacity
+        level, and return the events as
+        ``[("death", rank, last_seen) | ("rejoin", rank, epoch), ...]``
+        (idempotent — a death is reported once per lease epoch)."""
+        from dislib_tpu.utils.profiling import count_resilience
+        events = []
+        now = float(self._clock())
+        with self._lock:
+            for r in range(self.n):
+                if r == self.rank:
+                    continue
+                lease = self.lease_of(r)
+                if lease is None:
+                    continue
+                expired = now - lease["t"] > self.lease_s
+                if expired and r not in self._dead:
+                    self._dead[r] = lease["epoch"]
+                    count_resilience("rank_deaths")
+                    self._publish_capacity(f"death:rank{r}")
+                    events.append(("death", r, lease["t"]))
+                elif not expired and r in self._dead:
+                    del self._dead[r]
+                    count_resilience("rank_rejoins")
+                    self._publish_capacity(f"rejoin:rank{r}")
+                    events.append(("rejoin", r, lease["epoch"]))
+        return events
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"rank": self.rank, "n": self.n, "epoch": self.epoch,
+                    "lease_s": self.lease_s,
+                    "dead_ranks": sorted(self._dead)}
+
+    # -- epoch-fenced posts ---------------------------------------------------
+
+    def post(self, name: str, value) -> None:
+        """Post a value stamped with this rank's epoch."""
+        self.coord.post(name, self.rank,
+                        {"__epoch__": self.epoch, "v": value})
+
+    def _fenced(self, rank: int, rec):
+        """Unwrap an epoch-stamped value; STALE (epoch older than the
+        rank's current lease) → fenced out, returns the ``_FENCED``
+        sentinel.  Bare (pre-round-20) values pass through."""
+        if not (isinstance(rec, dict) and "__epoch__" in rec):
+            return rec
+        lease = self.lease_of(rank)
+        if lease is not None and int(rec["__epoch__"]) < lease["epoch"]:
+            return _FENCED
+        return rec.get("v")
+
+    def gather(self, name: str, n=None) -> dict:
+        """Non-blocking fenced gather: every currently-visible,
+        non-stale value under ``name`` as ``{rank: value}``."""
+        got = {}
+        for r in range(int(n) if n is not None else self.n):
+            rec = self.coord.peek(name, r)
+            if rec is None:
+                continue
+            v = self._fenced(r, rec)
+            if v is not _FENCED:
+                got[r] = v
+        return got
+
+    def exchange(self, name: str, value, n=None, timeout: float = 30.0):
+        """The ranked exchange, membership-hardened: posts are
+        epoch-stamped, stale peers' values are fenced out, and a missing
+        peer whose lease expired raises :class:`RankDead` immediately.
+        Polls through the injected clock/sleep (mock-clock testable)."""
+        n = int(n) if n is not None else self.n
+        self.post(name, value)
+        start = float(self._clock())
+        while True:
+            got = self.gather(name, n)
+            if len(got) >= n:
+                return {r: got[r] for r in sorted(got)}
+            missing = sorted(set(range(n)) - set(got))
+            self.raise_if_dead(missing)
+            if float(self._clock()) - start >= float(timeout):
+                raise CoordinationTimeout(
+                    f"exchange {name!r}: {len(got)}/{n} values after "
+                    f"{timeout}s — missing ranks {missing}", missing)
+            self._sleep(_POLL_S)
+
+
+_FENCED = object()
+
+
+class LeaseKeeper(threading.Thread):
+    """Daemon thread that renews this rank's lease and (``watch=True``)
+    polls membership so deaths and rejoins are detected — and converted
+    into capacity statements — while the main thread is deep inside a
+    fit step.  ``gate`` is the fault-injection seam: a callable polled
+    before each renewal; returning False SKIPS that beat (see
+    ``utils.faults.LeaseExpiry``).  :meth:`step` runs one iteration
+    synchronously for thread-free tests."""
+
+    def __init__(self, membership: Membership, interval_s=None,
+                 watch: bool = True, gate=None):
+        super().__init__(daemon=True, name="dslib-lease-keeper")
+        self.membership = membership
+        self.interval_s = (float(interval_s) if interval_s is not None
+                           else membership.lease_s / 3.0)
+        self.watch = bool(watch)
+        self.gate = gate
+        # NOT self._stop: threading.Thread.join() calls a private
+        # _stop() internally — shadowing it with an Event breaks join
+        self._halt = threading.Event()
+
+    def step(self) -> list:
+        """One keeper iteration: renew (unless gated), then poll."""
+        if self.gate is None or self.gate():
+            self.membership.heartbeat()
+        return self.membership.poll() if self.watch else []
+
+    def run(self) -> None:
+        while not self._halt.is_set():
+            try:
+                self.step()
+            except Exception:           # noqa: BLE001 — keeper never dies
+                pass
+            self._halt.wait(self.interval_s)
+
+    def stop(self, join: bool = True) -> None:
+        self._halt.set()
+        if join and self.is_alive():
+            self.join(timeout=5.0)
+
+
+def resilient_exchange(coord, name: str, rank: int, value, n: int,
+                       timeout: float = 30.0, retry=None) -> dict:
+    """Exchange with the round-20 degradation policy: transient
+    :class:`CoordinationTimeout` s are retried through ``runtime.Retry``
+    (a slow peer gets more chances), :class:`RankDead` escalates
+    IMMEDIATELY (retrying cannot resurrect a process — healing belongs
+    to the capacity ledger).  The total wall budget stays ≈ ``timeout``:
+    each attempt gets ``timeout/attempts``, so barrier deadlines hold."""
+    from dislib_tpu.runtime.retry import Retry
+    if retry is None:
+        attempts = max(1, int(os.environ.get("DSLIB_COORD_RETRIES", "2")))
+        retry = Retry(attempts=attempts, backoff=min(0.05, _POLL_S * 2),
+                      max_backoff=0.5, jitter=0.0)
+    per_attempt = float(timeout) / retry.attempts
+    return retry.call(coord.exchange, name, rank, value, n,
+                      timeout=per_attempt)
 
 
 # ---------------------------------------------------------------------------

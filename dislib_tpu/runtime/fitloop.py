@@ -112,12 +112,17 @@ def data_rebind(holder, key="x"):
     mesh after.  SPARSE holders (``SparseArray``) re-land their sharded
     buffers through the sparse rechunk schedules instead (no op chains
     to force, still never the host) — the round-14 sparse elastic rung.
-    Estimators with extra rebinding (ALS's padded test matrix) wrap or
-    replace it."""
+    Objects exposing ``rebind_mesh(mesh)`` (round 20: an ``IVFIndex``'s
+    mesh-pinned inverted-list layout) own their re-layout and are
+    delegated to.  Estimators with extra rebinding (ALS's padded test
+    matrix) wrap or replace it."""
     def hook(mesh):
         from dislib_tpu.data.array import ensure_canonical
         from dislib_tpu.data.sparse import SparseArray
         x = holder[key]
+        if hasattr(x, "rebind_mesh"):
+            x.rebind_mesh(mesh)         # the object owns its re-layout
+            return
         if isinstance(x, SparseArray):
             if mesh is not None:
                 x.sharded(mesh)         # on-device reshard of the backing
@@ -303,6 +308,7 @@ class ChunkedFitLoop:
         self._cadence = 0
         self._preempt = False
         self._cap_plan = None
+        self._cap_shrunk = False
         self._grows_left = max(0, int(getattr(self.guard.policy,
                                               "grow_attempts", 0)))
         # the mesh this fit STARTED on is "home": capacity shrinks keep a
@@ -407,7 +413,15 @@ class ChunkedFitLoop:
             return None
         cap = capacity_target()
         if cap is None:
-            return None
+            # No target published.  If a CAPACITY shrink brought us below
+            # home, a cleared target means the pressure LIFTED (round-20
+            # rejoin heal clears rather than publishing a bigger level) —
+            # head home through the same grow rungs, same budget.  An
+            # elastic-tier remediation shrink never sets the flag: nothing
+            # says the bad device came back, so it stays sticky.
+            if not self._cap_shrunk:
+                return None
+            cap = self._home_shape[0] * self._home_shape[1]
         from dislib_tpu.parallel import mesh as _mesh
         r, c = _mesh.mesh_shape(_mesh.get_mesh())
         home_r, home_c = self._home_shape
@@ -483,6 +497,7 @@ class ChunkedFitLoop:
         if kind == "grow":
             self._grows_left -= 1
         self._resize_mesh(new_r, kind)
+        self._cap_shrunk = new_r < self._home_shape[0]
         return self._load_state(init, restore)
 
     # -- entry points ----------------------------------------------------

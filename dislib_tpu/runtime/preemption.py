@@ -124,31 +124,35 @@ def capacity_target() -> int | None:
     return None
 
 
-def request_capacity(n_devices: int) -> None:
+def request_capacity(n_devices: int, writer: str | None = None) -> None:
     """Set the process-level capacity target directly (tests, manual
     drills, embedded schedulers).  Overrides the capacity file.  When
     ``DSLIB_CAPACITY_LEDGER`` names the fleet ledger, the level is ALSO
     published there — one process's chaos policy (``CapacityAtSave``
-    oscillation) or scheduler steers the whole fleet coherently."""
+    oscillation) or scheduler steers the whole fleet coherently.
+    ``writer`` attributes the ledger record (round 20 stamps rank-death
+    shrinks ``death:rank<r>`` and rejoin grow-backs ``rejoin:rank<r>``
+    so a postmortem can read WHY the fleet resized)."""
     _CAP["target"] = int(n_devices)
-    _publish_to_ledger(int(n_devices))
+    _publish_to_ledger(int(n_devices), writer)
 
 
-def clear_capacity() -> None:
+def clear_capacity(writer: str | None = None) -> None:
     """Drop the process-level capacity override — the file (if any)
     becomes the source again, else capacity is unmanaged.  Published to
     the ``DSLIB_CAPACITY_LEDGER`` fleet ledger too, when configured."""
     _CAP["target"] = None
-    _publish_to_ledger(None)
+    _publish_to_ledger(None, writer)
 
 
-def _publish_to_ledger(target) -> None:
+def _publish_to_ledger(target, writer: str | None = None) -> None:
     path = os.environ.get("DSLIB_CAPACITY_LEDGER")
     if not path:
         return
     from dislib_tpu.runtime.coord import CapacityLedger
-    writer = os.environ.get("DSLIB_PROC_ID", "0")
-    CapacityLedger(path).publish(target, writer=f"proc{writer}")
+    if writer is None:
+        writer = f"proc{os.environ.get('DSLIB_PROC_ID', '0')}"
+    CapacityLedger(path).publish(target, writer=writer)
 
 
 def raise_if_preempted(checkpoint=None) -> None:

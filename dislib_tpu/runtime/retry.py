@@ -41,8 +41,14 @@ _FATAL_OSERRORS = (FileNotFoundError, IsADirectoryError, NotADirectoryError,
 def is_transient_error(exc: BaseException) -> bool:
     """Default transient-vs-fatal classification (see module docstring)."""
     from dislib_tpu.runtime.preemption import Preempted
+    from dislib_tpu.runtime.coord import CoordinationTimeout, RankDead
     if isinstance(exc, (Preempted, KeyboardInterrupt, SystemExit)):
         return False                      # control flow, not a failure
+    if isinstance(exc, RankDead):
+        return False                      # confirmed death: retrying cannot
+        #                                   resurrect it — heal via capacity
+    if isinstance(exc, CoordinationTimeout):
+        return True                       # slow peer / torn file: retry
     if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError,
                         BlockingIOError)):
         return True

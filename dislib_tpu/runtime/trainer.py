@@ -170,7 +170,8 @@ class ContinuousTrainer:
                  batches_per_generation=None, canary_fraction=None,
                  promote_budget=None, retry=None, health=None,
                  health_gate=None, probe=None, quota_rows=None,
-                 deadline_ms=None, quarantine=None, name="trainer"):
+                 deadline_ms=None, quarantine=None, name="trainer",
+                 membership=None):
         self.estimator = estimator
         self._stream = iter(stream)
         self.checkpoint = checkpoint
@@ -198,6 +199,13 @@ class ContinuousTrainer:
         self.deadline_ms = deadline_ms
         self.quarantine = quarantine
         self.name = name
+        # fleet membership (round 20): when this trainer is one rank of
+        # a multi-host fleet, run() keeps its lease renewed and watches
+        # the peers' — a confirmed peer death publishes the shrunk
+        # capacity statement and the NEXT partial_fit heals through the
+        # fit loop's elastic rungs; a rejoin grows the fleet back
+        self.membership = membership
+        self._keeper = None
 
         self.generation = 0             # last trained generation
         self.served_generation = None   # what the tenant's primary serves
@@ -483,13 +491,28 @@ class ContinuousTrainer:
         cadences complete (None = forever).  ``Preempted`` and
         :class:`PromotionFailed` propagate typed — the orchestrator
         decides restart vs page; a re-instantiated trainer resumes the
-        stream from the checkpoint.  Returns :meth:`stats`."""
-        done = 0
-        while generations is None or done < generations:
-            if self.step() is None:
-                break
-            done += 1
-        return self.stats()
+        stream from the checkpoint.  Returns :meth:`stats`.  With
+        ``membership=`` this rank's lease is kept renewed for the whole
+        run (a :class:`~dislib_tpu.runtime.LeaseKeeper`), and peer
+        deaths/rejoins are converted into capacity statements the
+        training loop heals through between batches."""
+        from dislib_tpu.runtime.coord import LeaseKeeper, set_membership
+        if self.membership is not None and self._keeper is None:
+            set_membership(self.membership)
+            self._keeper = LeaseKeeper(self.membership, watch=True)
+            self._keeper.start()
+        try:
+            done = 0
+            while generations is None or done < generations:
+                if self.step() is None:
+                    break
+                done += 1
+            return self.stats()
+        finally:
+            if self._keeper is not None:
+                self._keeper.stop()
+                self._keeper = None
+                set_membership(None)
 
     def close(self) -> None:
         """Stop the primary server this trainer installed (canary
@@ -530,4 +553,15 @@ class ContinuousTrainer:
                        "mesh_shrinks": info.get("mesh_shrinks", 0),
                        "mesh_grows": info.get("mesh_grows", 0)},
         })
+        # fleet view (round 20): who died, who came back, what this
+        # rank's lease says — stats()-visible whether or not the
+        # orchestrator reads the process-wide resilience counters
+        from dislib_tpu.utils.profiling import resilience_counters
+        res = resilience_counters()
+        out["fleet"] = {
+            "rank_deaths": res.get("rank_deaths", 0),
+            "rank_rejoins": res.get("rank_rejoins", 0),
+            **(self.membership.stats() if self.membership is not None
+               else {}),
+        }
         return out

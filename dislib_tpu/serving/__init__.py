@@ -65,14 +65,14 @@ from dislib_tpu.serving.pipeline import ServePipeline
 from dislib_tpu.serving.router import (DeadlineShed, ModelRouter,
                                        TenantQuotaExceeded)
 from dislib_tpu.serving.server import (PredictServer, QueueFull,
-                                       ServeResponse)
+                                       ServeResponse, ShardDrained)
 from dislib_tpu.serving.sparse import SparseFoldInPipeline, pack_sparse_rows
 
 __all__ = [
     "DEFAULT_BUCKETS", "BucketLadderError", "bucket_ladder", "bucket_for",
     "split_rows",
     "ProgramCache", "ServePipeline", "PredictServer", "ServeResponse",
-    "QueueFull", "ModelPool",
+    "QueueFull", "ShardDrained", "ModelPool",
     "SparseFoldInPipeline", "pack_sparse_rows",
     "export_bundle", "load_bundle", "BundlePipeline", "LoadedBundle",
     "runtime_fingerprint",

@@ -295,10 +295,11 @@ def _export_sharded(pipeline, path, buckets, state, hosts: int) -> dict:
     # can name a shard that is not fully on disk)
     base = os.path.basename(path)
     if pc > 1:
+        from dislib_tpu.runtime.coord import resilient_exchange
         coord = get_coordinator()
         mine = file_crc(shard_path(path, jax.process_index()))
-        crcs = coord.exchange(f"bundle-export:{base}", jax.process_index(),
-                              mine, n=hosts)
+        crcs = resilient_exchange(coord, f"bundle-export:{base}",
+                                  jax.process_index(), mine, hosts)
         shard_crcs = [int(crcs[r]) for r in range(hosts)]
     else:
         shard_crcs = [file_crc(shard_path(path, r)) for r in range(hosts)]
@@ -430,7 +431,7 @@ def _fallback(build, state, meta, err):
                         meta["fingerprint"], fallback=True)
 
 
-def load_bundle(path: str, build=None, timeout: float = 30.0) \
+def load_bundle(path: str, build=None, timeout: float | None = None) \
         -> LoadedBundle:
     """Rehydrate a deployment bundle into a ``PredictServer``-ready
     pipeline with zero retraces.
@@ -450,8 +451,16 @@ def load_bundle(path: str, build=None, timeout: float = 30.0) \
     and only when ALL hosts verified does anyone deserialize — one
     corrupt shard raises the same typed
     :class:`~dislib_tpu.runtime.BundleShardCorrupt` on every host, and
-    zero hosts serve.  ``timeout`` bounds the barrier wait.
+    zero hosts serve.  ``timeout`` bounds the barrier wait — default
+    ``DSLIB_BARRIER_TIMEOUT`` (30 s): one DEAD host aborts ALL hosts
+    within this budget with the typed
+    :class:`~dislib_tpu.runtime.RankDead` (when membership leases have
+    confirmed who died) or :class:`~dislib_tpu.runtime.CoordinationTimeout`
+    — never a hung fleet.
     """
+    if timeout is None:
+        from dislib_tpu.runtime.coord import barrier_timeout
+        timeout = barrier_timeout()
     raw = read_bundle(path)
     if _META_KEY not in raw:
         raise BundleIncompatible(
@@ -539,6 +548,27 @@ def _verify_shard(path, manifest, r):
     return {"ok": True}, raw
 
 
+def _barrier_exchange(coord, name, rank, vote, n, timeout, path):
+    """The load-barrier exchange under the round-20 degradation policy:
+    transient ``CoordinationTimeout`` s retry through ``runtime.Retry``
+    inside the ``DSLIB_BARRIER_TIMEOUT`` budget (``resilient_exchange``
+    splits it); a confirmed ``RankDead`` — or the budget running dry —
+    ABORTS typed, counted ``bundle_barrier_abort``, on every surviving
+    host.  A dead fleet member can delay a load by at most ``timeout``;
+    it can never hang it."""
+    from dislib_tpu.runtime.coord import (CoordinationTimeout,
+                                          resilient_exchange)
+    try:
+        return resilient_exchange(coord, name, rank, vote, n,
+                                  timeout=timeout)
+    except CoordinationTimeout as e:    # includes the attributed RankDead
+        _prof.count_resilience("bundle_barrier_abort")
+        e.args = (f"sharded bundle {path}: load barrier ABORTED "
+                  f"({e.args[0] if e.args else e}) — zero hosts serve",
+                  *e.args[1:])
+        raise
+
+
 def _load_sharded(path, manifest, build, timeout) -> LoadedBundle:
     import jax
 
@@ -569,8 +599,8 @@ def _load_sharded(path, manifest, build, timeout) -> LoadedBundle:
         vote, raw_mine = _verify_shard(path, manifest, my_host)
         coord = get_coordinator()
         base = os.path.basename(path)
-        votes = coord.exchange(f"bundle-load:{base}", my_host, vote,
-                               n=votes_needed, timeout=timeout)
+        votes = _barrier_exchange(coord, f"bundle-load:{base}", my_host,
+                                  vote, votes_needed, timeout, path)
     else:
         # single process standing in for the fleet (mock hosts, offline
         # validation): verify EVERY shard and run the same barrier
@@ -585,8 +615,8 @@ def _load_sharded(path, manifest, build, timeout) -> LoadedBundle:
             votes0[r], raws[r] = _verify_shard(path, manifest, r)
             coord.post(f"bundle-load:{base}", r, votes0[r])
         raw_mine = raws[0]
-        votes = coord.exchange(f"bundle-load:{base}", 0, votes0[0],
-                               n=hosts, timeout=timeout)
+        votes = _barrier_exchange(coord, f"bundle-load:{base}", 0,
+                                  votes0[0], hosts, timeout, path)
     bad = sorted(r for r, v in votes.items() if not v.get("ok"))
     if bad:
         _prof.count_resilience("bundle_barrier_abort")

@@ -53,6 +53,21 @@ class QueueFull(RuntimeError):
         self.tenant = tenant
 
 
+class ShardDrained(RuntimeError):
+    """This server is part of a sharded fleet and a peer host's lease
+    EXPIRED (round 20): until the fleet heals, responses assembled here
+    would silently miss the dead host's shard of the model — torn
+    results.  The server DRAINS instead: queued and new requests fail
+    with this typed error (carrying the dead ``rank`` and ``last_seen``)
+    so the caller's load balancer re-routes, and serving resumes
+    automatically when the peer's lease is renewed or a restart rejoins."""
+
+    def __init__(self, message, rank=None, last_seen=None):
+        super().__init__(message)
+        self.rank = rank
+        self.last_seen = last_seen
+
+
 class ServeResponse:
     """One request's result: ``values`` (n_rows, out_cols ndarray), the
     ``generation`` token that computed it (None for a static pipeline),
@@ -93,11 +108,20 @@ class PredictServer:
 
     def __init__(self, pipeline=None, pool=None, buckets=None,
                  deadline_ms=None, max_queue_rows=65536, name="serve",
-                 elastic=None, capacity_poll_s=0.25, grow_attempts=8):
+                 elastic=None, capacity_poll_s=0.25, grow_attempts=8,
+                 membership=None):
         if (pipeline is None) == (pool is None):
             raise ValueError("pass exactly one of pipeline= or pool=")
         if elastic is not None and not callable(elastic):
-            elastic = (lambda mesh: None) if elastic else None
+            if elastic and pipeline is not None and \
+                    hasattr(pipeline, "rebind_mesh"):
+                # elastic=True on a pipeline that owns its re-layout
+                # (round 20: RetrievalPipeline/IVFIndex): the default
+                # hook delegates to it — same pipeline object, re-laid
+                elastic = (lambda mesh, _p=pipeline:
+                           (_p.rebind_mesh(mesh), None)[1])
+            else:
+                elastic = (lambda mesh: None) if elastic else None
         if elastic is not None and pipeline is None:
             raise ValueError(
                 "elastic= serving needs pipeline mode — a ModelPool's "
@@ -122,10 +146,20 @@ class PredictServer:
         self._elastic = elastic
         self.capacity_poll_s = float(capacity_poll_s)
         self._grows_left = int(grow_attempts)
+        self._cap_shrunk = False        # a CAPACITY shrink is below home
         self._home_shape = None
         self._home_devices = None
         self._last_cap_poll = None
         self._mesh_resizes = 0
+        # dead-shard drain (round 20): when this server fronts one shard
+        # of a fleet, `membership=` (a runtime.coord.Membership) makes
+        # the worker poll the peers' leases on the same cadence as
+        # capacity — a confirmed-dead peer DRAINS this server (queued +
+        # new requests fail typed ShardDrained, never torn fleet
+        # results), a renewed lease or a rejoin resumes it
+        self._membership = membership
+        self._drained_rank = None       # (rank, last_seen) while draining
+        self._shard_drains = 0
         if pool is not None:
             # the served ladder must be ⊆ the pool's warmed+health-gated
             # ladder: routing a request to a bucket adoption never warmed
@@ -236,6 +270,13 @@ class PredictServer:
             if not self._running:
                 raise RuntimeError("PredictServer is not running — use "
                                    "start() or a with-block")
+            if self._drained_rank is not None:
+                r, seen = self._drained_rank
+                raise ShardDrained(
+                    f"{self.name}: draining — fleet peer rank {r} is "
+                    f"dead (lease expired, last heartbeat {seen:.3f}); "
+                    "a response computed now would be missing its shard",
+                    rank=r, last_seen=seen)
             if self._queued_rows + rows.shape[0] > self.max_queue_rows:
                 self._shed += 1
                 if tenant is not None:
@@ -256,15 +297,51 @@ class PredictServer:
 
     # -- worker side ---------------------------------------------------------
 
+    def _poll_membership(self):
+        """Between batches: convert peer-lease state into the drain
+        level.  ``membership.poll()`` also publishes the death→capacity
+        statement, so a dead peer both drains THIS shard and shrinks the
+        fleet's fit capacity through one observation."""
+        if self._membership is None:
+            return
+        try:
+            self._membership.poll()
+            dead = self._membership.dead()
+        except Exception:               # noqa: BLE001 — poll never kills serving
+            return
+        if dead and self._drained_rank is None:
+            r, last_seen, _epoch = dead[0]
+            stranded = []
+            with self._cv:
+                self._drained_rank = (r, last_seen)
+                self._shard_drains += 1
+                stranded = list(self._queue)
+                self._queue.clear()
+                self._queued_rows = 0
+            _prof.count_resilience("serve_shard_drains")
+            err = ShardDrained(
+                f"{self.name}: fleet peer rank {r} died mid-serve "
+                f"(lease expired, last heartbeat {last_seen:.3f}) — "
+                "draining this shard instead of serving torn results",
+                rank=r, last_seen=last_seen)
+            for p in stranded:
+                if p.future.set_running_or_notify_cancel():
+                    p.future.set_exception(err)
+        elif not dead and self._drained_rank is not None:
+            with self._cv:
+                self._drained_rank = None
+
     def _worker(self):
         top = self.buckets[-1]
         while True:
             self._maybe_resize()        # between batches, never mid-batch
+            self._poll_membership()
             with self._cv:
                 while self._running and not self._queue:
                     self._cv.wait(timeout=0.1)
-                    if self._elastic is not None:
-                        break           # idle: re-poll the capacity level
+                    if self._elastic is not None or \
+                            self._membership is not None:
+                        break   # idle: re-poll capacity / peer leases
                 if not self._queue:
                     if not self._running:
                         return
@@ -299,7 +376,12 @@ class PredictServer:
         from dislib_tpu.runtime.preemption import capacity_target
         cap = capacity_target()
         if cap is None:
-            return None
+            # pressure lifted (the round-20 rejoin heal CLEARS the target
+            # rather than publishing a bigger level): a capacity-shrunk
+            # server heads home through the same grow rungs, same budget
+            if not self._cap_shrunk:
+                return None
+            cap = self._home_shape[0] * self._home_shape[1]
         r, c = _mesh.mesh_shape(_mesh.get_mesh())
         home_r, home_c = self._home_shape
         cap = max(c, min(int(cap), home_r * home_c))
@@ -347,6 +429,7 @@ class PredictServer:
         _, c = self._home_shape
         _mesh.init((new_r, c), devices=self._home_devices[: new_r * c])
         jax.clear_caches()
+        self._cap_shrunk = new_r < self._home_shape[0]
         _prof.count_resilience("serve_mesh_shrinks" if kind == "shrink"
                                else "serve_mesh_grows")
         new_pipe = self._elastic(_mesh.get_mesh())
@@ -564,6 +647,8 @@ class PredictServer:
             "queued_rows": queued_rows,
             "shed": shed,
             "mesh_resizes": self._mesh_resizes,
+            "shard_drains": self._shard_drains,
+            "draining": self._drained_rank is not None,
             "bucket_cost_ms": {b: round(1e3 * c, 4)
                                for b, c in self.bucket_cost().items()},
             "tenants": tenants,

@@ -23,6 +23,15 @@ Three fault families:
   :class:`~dislib_tpu.runtime.health.HealthPolicy` subclasses: pass them
   as ``fit(..., health=...)`` and the estimator's own guard becomes the
   injector — the production code path is exercised unchanged.
+- **multi-host membership faults** (round-20 survival PR) —
+  :class:`KillRankAt` delivers a real SIGKILL at an exact call count (the
+  rank death), :class:`LeaseExpiry` gates a
+  :class:`~dislib_tpu.runtime.coord.LeaseKeeper` to skip an exact window
+  of heartbeats (the delayed/flapping host), and :class:`TornCoordWrite`
+  writes one coordination post torn and NON-atomically onto its final
+  path (the crashed writer rename atomicity normally makes impossible).
+  All three are call-count driven, so the chaos matrix reproduces
+  bit-identically.
 """
 
 from __future__ import annotations
@@ -41,7 +50,8 @@ __all__ = ["CallbackCheckpoint", "SigtermAtNthSave", "sigterm_self",
            "corrupt_snapshot", "FlakyCall", "FlakyOpen",
            "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk",
            "FaultAtTier", "CapacityAtSave", "oscillation_schedule",
-           "TornBundleWrite", "CanaryGateTrip"]
+           "TornBundleWrite", "CanaryGateTrip",
+           "KillRankAt", "LeaseExpiry", "TornCoordWrite"]
 
 
 class CallbackCheckpoint(FitCheckpoint):
@@ -425,6 +435,100 @@ class CanaryGateTrip:
         if self.then is not None:
             return bool(self.then(loaded, generation))
         return True
+
+
+# ---------------------------------------------------------------------------
+# multi-host membership fault injection (round-20 survival PR)
+# ---------------------------------------------------------------------------
+
+class KillRankAt:
+    """Callable seam injector that delivers ``sig`` (default SIGKILL — no
+    handlers, no cleanup, the real rank death) to ``pid`` (default: this
+    process) at exactly the ``at_call``-th invocation.  Plant it wherever
+    the harness needs the death to land — a chunk callback, a
+    ``CallbackCheckpoint(callback=...)``, a heartbeat gate — and the kill
+    fires at a deterministic point in the work stream, never on a timer.
+
+    ``kill=`` is injectable so tier-1 unit tests pin the schedule without
+    killing the test runner; ``calls``/``fired`` count invocations and
+    deliveries for assertions."""
+
+    def __init__(self, at_call: int = 1, pid=None, sig=_signal.SIGKILL,
+                 kill=os.kill):
+        self.at_call = int(at_call)
+        self.pid = pid
+        self.sig = sig
+        self._kill = kill
+        self.calls = 0
+        self.fired = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.calls == self.at_call:
+            self.fired += 1
+            pid = os.getpid() if self.pid is None else int(self.pid)
+            self._kill(pid, self.sig)
+
+
+class LeaseExpiry:
+    """A :class:`~dislib_tpu.runtime.coord.LeaseKeeper` ``gate=`` that
+    SKIPS heartbeats ``after+1 .. after+beats`` (1-based beat count) and
+    heartbeats normally otherwise — the deterministic stand-in for a
+    stalled or network-partitioned host whose lease expires while the
+    process is still alive.  With ``beats`` long enough to outlive the
+    lease, peers observe a death (``RankDead``) followed by a REJOIN when
+    beating resumes — the flap scenario; ``beats`` large keeps the rank
+    dead forever.  ``calls`` counts every gate evaluation."""
+
+    def __init__(self, after: int = 1, beats: int = 2):
+        self.after = int(after)
+        self.beats = int(beats)
+        self.calls = 0
+
+    def __call__(self) -> bool:
+        self.calls += 1
+        return not (self.after < self.calls <= self.after + self.beats)
+
+
+class TornCoordWrite:
+    """Coordinator drop-in whose first ``failures`` matching posts are
+    written TORN (first half of the JSON payload) and NON-atomically onto
+    the final ``<name>.<rank>.json`` path — the partial write a killed or
+    crashing writer leaves when it bypasses the tmp-write + rename
+    discipline.  Readers must classify it :class:`TornCoordFile`
+    (transient), retry through ``runtime.Retry``, and degrade to
+    "missing" — never a fleet kill.  Later posts delegate to the real
+    atomic write, which is also the healing story: the writer's clean
+    re-post replaces the torn file.  All other coordinator methods
+    (``peek``/``exchange``/…) pass through untouched.  ``name=`` narrows
+    the tear to one exchange name; ``calls``/``fails`` pin the schedule.
+    Wraps a :class:`~dislib_tpu.runtime.coord.FileCoordinator` (the only
+    transport with an on-disk surface to tear)."""
+
+    def __init__(self, coord, failures: int = 1, name=None):
+        self._coord = coord
+        self.failures = int(failures)
+        self.name = name
+        self.calls = 0
+        self.fails = 0
+
+    def __getattr__(self, attr):
+        return getattr(self._coord, attr)
+
+    def post(self, name, rank, value):
+        import json
+        from dislib_tpu.runtime.coord import _post_crc
+        self.calls += 1
+        if (self.name is None or name == self.name) \
+                and self.fails < self.failures:
+            self.fails += 1
+            os.makedirs(self._coord.directory, exist_ok=True)
+            payload = json.dumps(
+                {"crc": _post_crc(value), "v": value}).encode()
+            with open(self._coord._path(name, rank), "wb") as f:
+                f.write(payload[: max(1, len(payload) // 2)])
+            return
+        return self._coord.post(name, rank, value)
 
 
 class FaultAtTier(HealthPolicy):

@@ -165,7 +165,13 @@ def count_resilience(key: str, n: int = 1) -> None:
     ``rollbacks``, ``chunk_retries``, ``escalations_<tier>``,
     ``mesh_shrinks`` / ``mesh_grows`` (the fit-loop driver's elastic
     resizes, escalation- or capacity-driven), ``watchdog_trips`` (the
-    chunk guard), and ``quarantined_rows`` (ingest)."""
+    chunk guard), ``quarantined_rows`` (ingest), and the round-20
+    membership tallies: ``rank_deaths`` / ``rank_rejoins`` (lease
+    expiries confirmed and healed by ``runtime.coord.Membership``),
+    ``coord_torn_reads`` (torn coordination files survived),
+    ``serve_shard_drains`` (a ``PredictServer`` refusing torn fleet
+    results while a peer shard is dead), and ``retrieval_rebinds``
+    (an ``IVFIndex`` re-laying its device layout after a mesh change)."""
     with _COUNTERS_LOCK:
         _COUNTERS.resilience[key] = _COUNTERS.resilience.get(key, 0) + n
 

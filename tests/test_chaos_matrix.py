@@ -111,6 +111,31 @@ def _estimators():
                     x, y, checkpoint=ck, health=pol),
                 lambda e: np.asarray(e.predict(x).collect()))
 
+    def ivf(rng):
+        # round-20 satellite: the retrieval tier rides the matrix — the
+        # coarse-quantizer build is a chunked KMeans fit (so every
+        # injector lands mid-BUILD), and the model readout is a SEARCH,
+        # which must auto-rebind onto whatever mesh the elastic rung
+        # left behind (capacity shrink mid-fit/mid-search heals)
+        from dislib_tpu.retrieval import IVFIndex
+        x_np = _blobs(rng)
+
+        def fit(ck, pol):
+            ix = IVFIndex(n_lists=3, nprobe=3, kmeans_max_iter=12,
+                          random_state=0)
+            return ix.fit(ds.array(x_np), checkpoint=ck, health=pol)
+
+        def readout(e):
+            # restore the full mesh FIRST: when the elastic rung shrank
+            # the build, this search runs on a mesh the striped buffers
+            # were not laid out for — it must transparently re-stripe
+            # (never refuse, never tear)
+            ds.init()
+            dist, _ = e.search(x_np[:8], k=3)
+            return np.asarray(dist.collect())
+
+        return fit, readout
+
     def dbscan(rng):
         x = ds.array(rng.rand(60, 3).astype(np.float32))
         return (lambda ck, pol: DBSCAN(eps=0.5, min_samples=3).fit(
@@ -137,6 +162,7 @@ def _estimators():
         "forest": forest,
         "dbscan": dbscan,
         "daura": daura,
+        "ivf": ivf,
     }
 
 

@@ -464,3 +464,161 @@ def test_chaos_oscillation_soak(tmp_path, monkeypatch):
     assert sum(tally.values()) == len(names)
     assert shrinks >= len(names), "every run must shrink at least once"
     assert grows >= 1, "the sweep never exercised grow-back"
+
+
+@pytest.mark.slow
+def test_chaos_mh_soak(tmp_path, monkeypatch):
+    """Round-20 multi-host survival soak (``tools/chaos_soak.sh
+    --multihost``): repeated kill → resume → rejoin → grow-back episodes
+    under live client traffic.  Two membership ranks share a
+    FileCoordinator; each episode stops rank 1's heartbeats (the process
+    is gone), waits for the survivor's lease watcher to publish the
+    shrunk capacity level, then runs a checkpointed KMeans fit that must
+    shrink onto the survivor device set, absorb rank 1's RESTART
+    mid-fit (rejoin → pressure lifts → the head-home rung grows back),
+    and land on the unfaulted oracle.  Throughout, a client thread
+    hammers a membership-aware retrieval ``PredictServer``: while the
+    peer is dead every request fails TYPED (``ShardDrained``) — never a
+    torn result — and serving resumes after the rejoin.
+
+    ``DSLIB_SOAK_EPISODES`` (default 2) and ``DSLIB_SOAK_SEED``
+    parameterize; the summary line is ``CHAOS_MH_SUMMARY``.
+    """
+    import threading
+    import time
+
+    from dislib_tpu.parallel import mesh as _mesh
+    from dislib_tpu.retrieval import IVFIndex, RetrievalPipeline
+    from dislib_tpu.runtime.coord import LeaseKeeper, Membership
+    from dislib_tpu.runtime.preemption import capacity_target, clear_capacity
+    from dislib_tpu.serving import PredictServer, ShardDrained
+    from dislib_tpu.utils import profiling as prof
+
+    episodes = int(os.environ.get("DSLIB_SOAK_EPISODES", "2"))
+    seed = int(os.environ.get("DSLIB_SOAK_SEED", "0"))
+    monkeypatch.setenv("DSLIB_COORD_DIR", str(tmp_path / "coord"))
+    monkeypatch.setenv("DSLIB_CAPACITY_LEDGER", str(tmp_path / "cap.ledger"))
+    monkeypatch.setenv("DSLIB_COORD_LEASE_MS", "500")
+    monkeypatch.setenv("DSLIB_RETRY_BACKOFF", "0")
+
+    def wait_for(pred, deadline_s, what):
+        t0 = time.monotonic()
+        while not pred():
+            assert time.monotonic() - t0 < deadline_s, f"{what}: hang"
+            time.sleep(0.02)
+        return time.monotonic() - t0
+
+    ds.init((8, 1))
+    rng = np.random.RandomState(seed)
+    centers = rng.rand(3, 4) * 10
+    x_np = np.vstack([centers[i] + 0.3 * rng.randn(66, 4)
+                      for i in range(3)]).astype(np.float32)
+    kw = dict(n_clusters=3, init=np.ascontiguousarray(x_np[[0, 70, 140]]),
+              max_iter=12, tol=0.0)
+    oracle = KMeans(**kw).fit(
+        ds.array(x_np),
+        checkpoint=FitCheckpoint(str(tmp_path / "oracle.npz"),
+                                 every=2)).centers_
+
+    ix = IVFIndex(n_lists=3, nprobe=3, kmeans_max_iter=8, random_state=0)
+    ix.fit(ds.array(x_np))
+    pipe = RetrievalPipeline(ix, k=3)
+
+    prof.reset_counters()
+    m0 = Membership(0, 2, devices=8, heal_capacity=True)
+    m1 = Membership(1, 2, devices=8, heal_capacity=False)
+    m0.join(), m1.join()
+    k0 = LeaseKeeper(m0, watch=True)
+    k0.start()
+    k1 = LeaseKeeper(m1, watch=False)
+    k1.start()
+
+    stop = threading.Event()
+    client = {"ok": 0, "drained": 0, "other": 0}
+    q = x_np[:8]
+
+    def traffic():
+        while not stop.is_set():
+            for attempt in (0, 1):
+                try:
+                    srv.predict(q)
+                    client["ok"] += 1
+                except ShardDrained:
+                    client["drained"] += 1
+                except Exception as e:      # noqa: BLE001 — torn = fail
+                    # one retry: a request can land on the very instant
+                    # the fit thread flips the global mesh — that race
+                    # heals by the next batch.  A PERSISTENT failure
+                    # (e.g. a stale bucket canvas after the index
+                    # re-stripes) fails the retry too and fails the soak.
+                    if attempt == 0:
+                        time.sleep(0.1)
+                        continue
+                    client["other"] += 1
+                    client.setdefault("errs", []).append(
+                        f"{type(e).__name__}: {e}"[:160])
+                break
+            time.sleep(0.03)
+
+    recovery = []
+    srv = PredictServer(pipeline=pipe, buckets=(1, 8), membership=m0,
+                        name="mh-soak")
+    srv.start()
+    thr = threading.Thread(target=traffic, daemon=True)
+    thr.start()
+    try:
+        for ep in range(episodes):
+            base = dict(prof.resilience_counters())
+            k1.stop()                       # the KILL: heartbeats stop
+            recovery.append(round(wait_for(
+                lambda: capacity_target() == 4, 30.0,
+                f"ep{ep}: death -> shrunk capacity"), 2))
+
+            restarted = threading.Event()
+
+            def resume():
+                # the RESTART, delivered mid-fit: heartbeats come back,
+                # the watcher counts the rejoin and clears the pressure
+                nonlocal k1
+                k1 = LeaseKeeper(m1, watch=False)
+                k1.start()
+                wait_for(lambda: capacity_target() is None, 30.0,
+                         "rejoin heal")
+                restarted.set()
+
+            ck = faults.CallbackCheckpoint(
+                str(tmp_path / f"ep{ep}.npz"), every=2, after=2,
+                callback=resume)
+            est = KMeans(**kw).fit(ds.array(x_np), checkpoint=ck)
+            info = est.fit_info_
+            assert restarted.is_set()
+            assert info["mesh_shrinks"] >= 1, (ep, info)
+            assert info["mesh_grows"] >= 1, (ep, info)
+            assert _mesh.mesh_shape(_mesh.get_mesh()) == (8, 1)
+            np.testing.assert_allclose(est.centers_, oracle,
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"ep{ep} healed != oracle")
+            now = prof.resilience_counters()
+            assert now.get("rank_deaths", 0) - base.get("rank_deaths", 0) \
+                == 1, (ep, now)
+            assert now.get("rank_rejoins", 0) \
+                - base.get("rank_rejoins", 0) == 1, (ep, now)
+            wait_for(lambda: not srv.stats()["draining"], 30.0,
+                     f"ep{ep}: serving resume")
+    finally:
+        stop.set()
+        thr.join(10.0)
+        srv.stop()
+        k1.stop(), k0.stop()
+        clear_capacity()
+    counters = prof.resilience_counters()
+    summary = {"metric": "chaos_mh", "seed": seed, "episodes": episodes,
+               "oracle_match": True, "recovery_s": recovery,
+               "client": dict(client),
+               "resilience": {k: counters[k] for k in sorted(counters)}}
+    print("CHAOS_MH_SUMMARY " + json.dumps(summary))
+    assert client["ok"] > 0, "client traffic never served"
+    assert client["drained"] >= 1, \
+        "no request ever failed typed during a dead window"
+    assert client["other"] == 0, f"untyped client failure: {client}"
+    assert counters.get("serve_shard_drains", 0) >= 1

@@ -1,0 +1,539 @@
+"""Round-20 multi-host survival, tier-1 coverage: heartbeat leases and
+the attributed ``RankDead``, epoch fencing of a stale rejoiner, the
+death → capacity → rejoin healing flow (counters asserted), the barrier
+deadline's typed abort on EVERY surviving rank, the retry-then-escalate
+classification (``CoordinationTimeout`` transient, ``RankDead`` fatal),
+torn coordination files surviving as TRANSIENT, the serving fleet's
+shard drain, and the round-20 fault injectors themselves.
+
+Everything lease-related runs on a MOCKED clock (``Membership`` takes
+injectable ``clock``/``sleep``), so expiry scenarios are instant and
+bit-reproducible — the real-process, real-SIGKILL versions of these
+scenarios live in ``tools/mh_dryrun.py --chaos``.
+"""
+
+import json
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dislib_tpu.runtime.coord import (CoordinationTimeout, FileCoordinator,
+                                      LeaseKeeper, LocalCoordinator,
+                                      Membership, RankDead, TornCoordFile,
+                                      barrier_timeout, lease_seconds,
+                                      resilient_exchange, set_membership)
+from dislib_tpu.runtime.retry import is_transient_error
+from dislib_tpu.utils import profiling as _prof
+from dislib_tpu.utils.faults import KillRankAt, LeaseExpiry, TornCoordWrite
+
+LEASE_MS = 2000
+
+
+class FakeClock:
+    """Injectable wall clock: ``sleep`` advances it, nothing waits."""
+
+    def __init__(self, t=1000.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+    def sleep(self, dt):
+        self.t += float(dt)
+
+
+def _member(rank, n, co, clock, **kw):
+    kw.setdefault("lease_ms", LEASE_MS)
+    kw.setdefault("devices", 2)
+    kw.setdefault("heal_capacity", False)
+    return Membership(rank, n, coord=co, clock=clock, sleep=clock.sleep,
+                      **kw)
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def co():
+    return LocalCoordinator()
+
+
+# ---------------------------------------------------------------------------
+# leases: expiry → attributed RankDead
+# ---------------------------------------------------------------------------
+
+class TestLeases:
+    def test_expiry_is_attributed(self, clock, co):
+        m0 = _member(0, 3, co, clock)
+        m1 = _member(1, 3, co, clock)
+        assert m0.join() == 1
+        assert m1.join() == 1
+        assert m0.dead() == []          # fresh fleet
+        last = clock.t
+        clock.advance(LEASE_MS / 1000.0 + 0.5)
+        m0.heartbeat()                  # self stays fresh
+        # rank 2 NEVER joined: missing, not dead — only a lease that
+        # stopped renewing is evidence of death
+        assert m0.dead() == [(1, last, 1)]
+        with pytest.raises(RankDead) as ei:
+            m0.raise_if_dead()
+        e = ei.value
+        assert (e.rank, e.last_seen, e.epoch) == (1, last, 1)
+        assert e.missing == (1,)
+        assert isinstance(e, CoordinationTimeout)   # old handlers catch
+        assert "rank 1 is dead" in str(e)
+
+    def test_heartbeat_keeps_the_lease_alive(self, clock, co):
+        m0, m1 = _member(0, 2, co, clock), _member(1, 2, co, clock)
+        m0.join(), m1.join()
+        for _ in range(5):
+            clock.advance(LEASE_MS / 1000.0 * 0.6)
+            m1.heartbeat()
+            assert m0.dead() == []
+
+    def test_env_knobs_parse(self, monkeypatch):
+        assert lease_seconds() == 2.0
+        monkeypatch.setenv("DSLIB_COORD_LEASE_MS", "500")
+        assert lease_seconds() == 0.5
+        monkeypatch.setenv("DSLIB_COORD_LEASE_MS", "junk")
+        assert lease_seconds() == 2.0   # never a crash
+        assert barrier_timeout() == 30.0
+        monkeypatch.setenv("DSLIB_BARRIER_TIMEOUT", "1.5")
+        assert barrier_timeout() == 1.5
+
+
+# ---------------------------------------------------------------------------
+# epoch fencing: a restarted rank's stale posts can never satisfy a
+# post-restart barrier
+# ---------------------------------------------------------------------------
+
+class TestEpochFencing:
+    def test_stale_rejoiner_is_fenced(self, clock, co):
+        m0, m1 = _member(0, 2, co, clock), _member(1, 2, co, clock)
+        m0.join()
+        assert m1.join() == 1
+        m1.post("result", "pre-crash")
+        assert m0.gather("result") == {1: "pre-crash"}
+        # rank 1 dies and restarts: join() bumps PAST the prior lease's
+        # epoch, so the pre-crash post is fenced out of every gather
+        m1b = _member(1, 2, co, clock)
+        assert m1b.join() == 2
+        assert m0.gather("result") == {}
+        m1b.post("result", "post-restart")
+        assert m0.gather("result") == {1: "post-restart"}
+
+    def test_fenced_exchange_death_vs_timeout(self, clock, co):
+        m0, m1 = _member(0, 2, co, clock), _member(1, 2, co, clock)
+        m0.join(), m1.join()
+        # peer's lease expires while we wait → RankDead long before the
+        # exchange deadline (the mocked clock proves no timeout burn)
+        clock.advance(LEASE_MS / 1000.0 + 0.5)
+        m0.heartbeat()
+        t0 = clock.t
+        with pytest.raises(RankDead):
+            m0.exchange("step", 1, timeout=3600.0)
+        assert clock.t - t0 < 1.0
+        # fresh peer that simply never posts → plain CoordinationTimeout
+        # at the deadline, missing ranks attributed
+        m0b = _member(0, 2, co, clock, lease_ms=10 ** 7)
+        m1b = _member(1, 2, co, clock, lease_ms=10 ** 7)
+        m0b.join(), m1b.join()
+        with pytest.raises(CoordinationTimeout) as ei:
+            m0b.exchange("step2", 1, timeout=2.0)
+        assert ei.value.missing == (1,)
+        assert not isinstance(ei.value, RankDead)
+
+    def test_transport_exchanges_are_death_aware(self, clock, tmp_path):
+        """With a process-global membership registered, the RAW
+        coordinator exchange (the path every barrier in the library
+        takes) aborts with RankDead instead of burning its timeout."""
+        for co in (LocalCoordinator(), FileCoordinator(str(tmp_path))):
+            m0, m1 = _member(0, 2, co, clock), _member(1, 2, co, clock)
+            m0.join(), m1.join()
+            clock.advance(LEASE_MS / 1000.0 + 0.5)
+            m0.heartbeat()
+            set_membership(m0)
+            try:
+                t0 = time.monotonic()
+                with pytest.raises(RankDead):
+                    co.exchange("barrier", 0, "vote", 2, timeout=30.0)
+                assert time.monotonic() - t0 < 5.0
+            finally:
+                set_membership(None)
+
+
+# ---------------------------------------------------------------------------
+# degradation policy: transient → retry, RankDead → escalate immediately
+# ---------------------------------------------------------------------------
+
+class _FlakyCoord:
+    def __init__(self, fails, exc):
+        self.calls = 0
+        self.fails = int(fails)
+        self.exc = exc
+
+    def exchange(self, name, rank, value, n, timeout=30.0):
+        self.calls += 1
+        if self.calls <= self.fails:
+            raise self.exc
+        return {r: value for r in range(int(n))}
+
+
+class TestRetryClassification:
+    def test_is_transient(self):
+        assert is_transient_error(CoordinationTimeout("slow peer", [1]))
+        assert is_transient_error(TornCoordFile("/x.json", "crc"))
+        assert not is_transient_error(RankDead(1, 0.0, 1))
+
+    def test_resilient_exchange_retries_transient(self):
+        co = _FlakyCoord(1, CoordinationTimeout("slow", [1]))
+        out = resilient_exchange(co, "x", 0, 7, 2, timeout=1.0)
+        assert out == {0: 7, 1: 7}
+        assert co.calls == 2            # one retry, then through
+
+    def test_resilient_exchange_escalates_rank_dead(self):
+        co = _FlakyCoord(99, RankDead(1, 0.0, 1))
+        with pytest.raises(RankDead):
+            resilient_exchange(co, "x", 0, 7, 2, timeout=1.0)
+        assert co.calls == 1            # fatal: no retry burned
+
+    def test_budget_is_split_not_multiplied(self):
+        seen = []
+
+        class _Co:
+            def exchange(self, name, rank, value, n, timeout=30.0):
+                seen.append(timeout)
+                raise CoordinationTimeout("slow", [1])
+
+        with pytest.raises(CoordinationTimeout):
+            resilient_exchange(_Co(), "x", 0, 7, 2, timeout=1.0)
+        assert sum(seen) <= 1.0 + 1e-9  # deadline holds across attempts
+
+
+# ---------------------------------------------------------------------------
+# torn coordination files: TRANSIENT, retried, counted — never fatal
+# ---------------------------------------------------------------------------
+
+class TestTornCoordFiles:
+    def test_torn_write_degrades_to_missing_and_heals(self, tmp_path):
+        co = FileCoordinator(str(tmp_path))
+        torn = TornCoordWrite(co, failures=1)
+        _prof.reset_counters()
+        torn.post("vote", 0, {"a": 1})
+        assert (torn.calls, torn.fails) == (1, 1)
+        # one verification attempt sees the typed transient
+        with pytest.raises(TornCoordFile):
+            co._read_once(co._path("vote", 0))
+        # the production read retries, then degrades to "missing"
+        assert co.peek("vote", 0) is None
+        assert _prof.resilience_counters().get("coord_torn_reads") == 1
+        # the writer's clean re-post (the atomic path) heals in place
+        torn.post("vote", 0, {"a": 1})
+        assert co.peek("vote", 0) == {"a": 1}
+
+    def test_crc_roundtrip_and_bare_back_compat(self, tmp_path):
+        co = FileCoordinator(str(tmp_path))
+        co.post("x", 0, {"nested": [1, 2, "three"]})
+        assert co.peek("x", 0) == {"nested": [1, 2, "three"]}
+        # a pre-round-20 bare payload (no CRC envelope) still reads
+        with open(co._path("x", 1), "w") as f:
+            json.dump(5, f)
+        assert co.peek("x", 1) == 5
+
+    def test_racing_writer_heals_within_the_retry_budget(self, tmp_path):
+        """The tear the CRC exists for: a reader that catches a torn
+        file while the writer is still alive sees the clean re-post
+        within its retry budget — no counter, no missing rank."""
+        co = FileCoordinator(str(tmp_path))
+        TornCoordWrite(co, failures=1).post("v", 0, "payload")
+        reads = {"n": 0}
+        real = co._read_once
+
+        def healing_read(path):
+            reads["n"] += 1
+            if reads["n"] == 2:         # between attempts: writer re-posts
+                co.post("v", 0, "payload")
+            return real(path)
+
+        co._read_once = healing_read
+        _prof.reset_counters()
+        assert co.peek("v", 0) == "payload"
+        assert _prof.resilience_counters().get("coord_torn_reads") is None
+
+
+# ---------------------------------------------------------------------------
+# death → capacity → rejoin: the healing flow, counters asserted
+# ---------------------------------------------------------------------------
+
+class TestDeathToCapacity:
+    def test_poll_publishes_shrunk_target_then_heals(self, clock, co):
+        from dislib_tpu.runtime import capacity_target, clear_capacity
+        m0 = _member(0, 2, co, clock, devices=8, heal_capacity=True)
+        m1 = _member(1, 2, co, clock)
+        m0.join(), m1.join()
+        last = clock.t
+        _prof.reset_counters()
+        try:
+            assert m0.poll() == []
+            clock.advance(LEASE_MS / 1000.0 + 1.0)
+            m0.heartbeat()
+            assert m0.poll() == [("death", 1, last)]
+            # shrunk per-host target: 8 devices · 1 live // 2 ranks
+            assert capacity_target() == 4
+            assert m0.stats()["dead_ranks"] == [1]
+            assert _prof.resilience_counters().get("rank_deaths") == 1
+            assert m0.poll() == []      # idempotent per lease epoch
+            # the restarted rank rejoins under a bumped epoch
+            m1b = _member(1, 2, co, clock)
+            assert m1b.join() == 2
+            assert m0.poll() == [("rejoin", 1, 2)]
+            assert capacity_target() is None    # whole fleet back
+            assert m0.stats()["dead_ranks"] == []
+            assert _prof.resilience_counters().get("rank_rejoins") == 1
+        finally:
+            clear_capacity()
+
+    def test_lease_keeper_gate_drives_a_flap(self, clock, co):
+        """A LeaseExpiry-gated keeper skips exactly the scheduled beats:
+        peers observe death, then the rejoin when beating resumes."""
+        m0 = _member(0, 2, co, clock)
+        m1 = _member(1, 2, co, clock)
+        m0.join(), m1.join()
+        gate = LeaseExpiry(after=1, beats=2)
+        keeper = LeaseKeeper(m1, watch=False, gate=gate)
+        _prof.reset_counters()
+        assert keeper.step() == []      # beat 1: renews
+        clock.advance(LEASE_MS / 1000.0 + 0.5)
+        m0.heartbeat()
+        keeper.step()                   # beat 2: GATED — lease expires
+        assert [e[0] for e in m0.poll()] == ["death"]
+        keeper.step()                   # beat 3: still gated
+        assert m0.poll() == []
+        keeper.step()                   # beat 4: resumes → fresh lease
+        assert [e[0] for e in m0.poll()] == ["rejoin"]
+        assert gate.calls == 4
+        r = _prof.resilience_counters()
+        assert (r.get("rank_deaths"), r.get("rank_rejoins")) == (1, 1)
+
+    def test_lease_keeper_thread_never_hangs(self, co):
+        """The real daemon keeper (real clock, short lease): renews while
+        running, stops promptly, and its death is observed by a peer."""
+        m0 = Membership(0, 2, coord=co, lease_ms=400, devices=2,
+                        heal_capacity=False)
+        m1 = Membership(1, 2, coord=co, lease_ms=400, devices=2,
+                        heal_capacity=False)
+        m0.join(), m1.join()
+        keeper = LeaseKeeper(m1, interval_s=0.05, watch=False)
+        keeper.start()
+        try:
+            time.sleep(0.6)             # > lease: only renewals keep it
+            assert m0.dead() == []
+        finally:
+            keeper.stop()
+        assert not keeper.is_alive()
+        deadline = time.monotonic() + 10.0
+        while not m0.dead():
+            assert time.monotonic() < deadline, "lease never expired"
+            time.sleep(0.02)
+        assert m0.dead()[0][0] == 1
+
+
+class TestHeadHome:
+    """Pressure lifted → head home: the rejoin heal CLEARS the capacity
+    target rather than publishing a bigger level, so a capacity-shrunk
+    fit/server must treat None-after-shrink as 'grow back toward the
+    home mesh' (an elastic-tier remediation shrink stays sticky)."""
+
+    def test_fit_heads_home_when_pressure_lifts(self, tmp_path):
+        import dislib_tpu as ds
+        from dislib_tpu.cluster import KMeans
+        from dislib_tpu.parallel import mesh as _mesh
+        from dislib_tpu.runtime import clear_capacity, request_capacity
+        from dislib_tpu.utils import FitCheckpoint, faults
+        ds.init((8, 1))
+        rng = np.random.RandomState(0)
+        centers = rng.rand(3, 4) * 10
+        x_np = np.vstack([centers[i] + 0.3 * rng.randn(66, 4)
+                          for i in range(3)]).astype(np.float32)
+        kw = dict(n_clusters=3,
+                  init=np.ascontiguousarray(x_np[[0, 70, 140]]),
+                  max_iter=12, tol=0.0)
+        oracle = KMeans(**kw).fit(
+            ds.array(x_np),
+            checkpoint=FitCheckpoint(str(tmp_path / "o.npz"), every=2))
+        try:
+            request_capacity(4)         # a host died before the fit
+            ck = faults.CallbackCheckpoint(
+                str(tmp_path / "h.npz"), every=2, after=2,
+                callback=clear_capacity)    # ...and rejoins mid-fit
+            est = KMeans(**kw).fit(ds.array(x_np), checkpoint=ck)
+        finally:
+            clear_capacity()
+            ds.init()
+        info = est.fit_info_
+        assert (info["mesh_shrinks"], info["mesh_grows"]) == (1, 1)
+        np.testing.assert_allclose(est.centers_, oracle.centers_,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_server_heads_home_when_pressure_lifts(self):
+        import dislib_tpu as ds
+        from dislib_tpu.parallel import mesh as _mesh
+        from dislib_tpu.runtime import clear_capacity, request_capacity
+        from dislib_tpu.serving import PredictServer, ServePipeline
+        ds.init((8, 1))
+        lr = ds.LinearRegression()
+        lr.coef_ = np.ones((4, 1), np.float32)
+        lr.intercept_ = np.zeros(1, np.float32)
+        pipe = ServePipeline(lr, n_features=4)
+        x = np.ones((2, 4), np.float32)
+        _prof.reset_counters()
+
+        def _resized(srv, n, what):
+            deadline = time.monotonic() + 30.0
+            while srv.stats()["mesh_resizes"] < n:
+                assert time.monotonic() < deadline, f"{what} never landed"
+                time.sleep(0.02)
+
+        srv = PredictServer(pipeline=pipe, buckets=(1, 4), elastic=True,
+                            capacity_poll_s=0.01, name="headhome")
+        try:
+            with srv:
+                assert srv.predict(x).shape == (2, 1)
+                request_capacity(4)
+                _resized(srv, 1, "shrink")
+                assert _mesh.mesh_shape(_mesh.get_mesh()) == (4, 1)
+                clear_capacity()        # pressure lifts — NO grow target
+                _resized(srv, 2, "head-home grow")
+                assert _mesh.mesh_shape(_mesh.get_mesh()) == (8, 1)
+                assert srv.predict(x).shape == (2, 1)
+        finally:
+            clear_capacity()
+            ds.init()
+        r = _prof.resilience_counters()
+        assert r.get("serve_mesh_shrinks") == 1
+        assert r.get("serve_mesh_grows") == 1
+
+
+# ---------------------------------------------------------------------------
+# the load barrier: one dead host aborts ALL hosts typed — never a hang
+# ---------------------------------------------------------------------------
+
+class TestBarrierAbort:
+    def test_typed_abort_on_every_surviving_rank(self, co):
+        from dislib_tpu.serving.bundle import _barrier_exchange
+        _prof.reset_counters()
+        errs, done = {}, []
+
+        def run(rank):
+            try:
+                _barrier_exchange(co, "bundle-load:m", rank, {"ok": 1},
+                                  3, 0.6, "m.dsb.npz")
+            except CoordinationTimeout as e:
+                errs[rank] = e
+            done.append(rank)
+
+        ts = [threading.Thread(target=run, args=(r,)) for r in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30.0)        # rank 2 never arrives
+        assert sorted(done) == [0, 1]   # zero hangs
+        assert sorted(errs) == [0, 1]   # BOTH survivors abort...
+        for e in errs.values():         # ...typed and attributed
+            assert "load barrier ABORTED" in str(e)
+            assert "zero hosts serve" in str(e)
+            assert 2 in e.missing
+        assert _prof.resilience_counters()["bundle_barrier_abort"] == 2
+
+
+# ---------------------------------------------------------------------------
+# serving: a dead peer's shard drains instead of serving torn results
+# ---------------------------------------------------------------------------
+
+class TestShardDrain:
+    def _pipe(self):
+        import dislib_tpu as ds
+        from dislib_tpu.serving import ServePipeline
+        lr = ds.LinearRegression()
+        lr.coef_ = np.ones((4, 1), np.float32)
+        lr.intercept_ = np.zeros(1, np.float32)
+        return ServePipeline(lr, n_features=4)
+
+    def _await(self, srv, draining, what):
+        deadline = time.monotonic() + 30.0
+        while srv.stats()["draining"] != draining:
+            assert time.monotonic() < deadline, f"{what} never observed"
+            time.sleep(0.02)
+
+    def test_drain_and_resume(self, clock, co):
+        from dislib_tpu.serving import PredictServer, ShardDrained
+        m0, m1 = _member(0, 2, co, clock), _member(1, 2, co, clock)
+        m0.join(), m1.join()
+        _prof.reset_counters()
+        srv = PredictServer(pipeline=self._pipe(), buckets=(1, 4),
+                            membership=m0, name="drainer")
+        srv.start()
+        try:
+            q = np.ones((2, 4), np.float32)
+            assert srv.predict(q).shape == (2, 1)   # healthy fleet
+            clock.advance(LEASE_MS / 1000.0 + 1.0)
+            m0.heartbeat()              # peer 1's lease expires
+            self._await(srv, True, "drain")
+            with pytest.raises(ShardDrained) as ei:
+                srv.submit(q)
+            assert ei.value.rank == 1
+            st = srv.stats()
+            assert st["shard_drains"] == 1 and st["draining"]
+            assert _prof.resilience_counters()["serve_shard_drains"] == 1
+            m1.heartbeat()              # the peer comes back
+            self._await(srv, False, "resume")
+            assert srv.predict(q).shape == (2, 1)   # serving resumes
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the injectors themselves
+# ---------------------------------------------------------------------------
+
+class TestInjectors:
+    def test_kill_rank_at_schedule(self):
+        kills = []
+        inj = KillRankAt(at_call=3, pid=4242,
+                         kill=lambda pid, sig: kills.append((pid, sig)))
+        for _ in range(5):
+            inj("any", seam="args")
+        assert (inj.calls, inj.fired) == (5, 1)
+        assert kills == [(4242, signal.SIGKILL)]
+
+    def test_kill_rank_at_defaults_to_self(self):
+        kills = []
+        inj = KillRankAt(kill=lambda pid, sig: kills.append((pid, sig)))
+        inj()
+        import os
+        assert kills == [(os.getpid(), signal.SIGKILL)]
+
+    def test_lease_expiry_window(self):
+        gate = LeaseExpiry(after=2, beats=3)
+        assert [gate() for _ in range(8)] == [True, True, False, False,
+                                              False, True, True, True]
+        assert gate.calls == 8
+
+    def test_torn_coord_write_narrows_by_name(self, tmp_path):
+        co = FileCoordinator(str(tmp_path))
+        torn = TornCoordWrite(co, failures=2, name="victim")
+        torn.post("healthy", 0, "ok")
+        assert co.peek("healthy", 0) == "ok"    # untouched exchange
+        torn.post("victim", 0, "gone")
+        assert co.peek("victim", 0) is None     # torn on the final path
+        assert (torn.calls, torn.fails) == (2, 1)
+        # non-post methods pass through untouched
+        assert torn.peek("healthy", 0) == "ok"

@@ -42,6 +42,11 @@ def rng():
     return np.random.RandomState(7)
 
 
+def _mesh_now():
+    from dislib_tpu.parallel import mesh as _mesh
+    return _mesh.get_mesh()
+
+
 def _crafted(rng, n=N, d=D, nlist=NLIST, dtype=np.float32, empty=(),
              **kw):
     """Build an index through the layout seam ``_build`` — crafted
@@ -210,11 +215,26 @@ class TestPadDiscipline:
         ix4, _ = _crafted(rng, list_quantum=4)
         assert ix4.pad_waste["quantum"] == 4
 
-    def test_mesh_change_demands_refit(self, rng):
+    def test_mesh_change_heals_or_demands_refit(self, rng):
+        """Round 20: a mesh change under a fitted index auto-heals —
+        search re-stripes from the retained host layout inputs (counted
+        ``retrieval_rebinds``) and keeps its full-probe exactness.  Only
+        an index whose host inputs were dropped still raises the typed
+        refit demand."""
         ix, x = _crafted(rng)
+        q = x[:MQ]
+        _, oi = _oracle(q, x, K)
         ds.init((4, 2))
+        prof.reset_counters()
+        _, idx = ix.search(ds.array(q), k=K, nprobe=NLIST)
+        assert _recall(idx.collect(), oi) == 1.0
+        assert prof.resilience_counters().get("retrieval_rebinds") == 1
+        assert ix._fitted_mesh == (4, 2)
+        # host inputs dropped → the pre-round-20 typed demand survives
+        ix._items_h = None
+        ds.init((8, 1))
         with pytest.raises(RuntimeError, match="refit"):
-            ix.search(ds.array(x[:MQ]), k=K)
+            ix.search(ds.array(q), k=K)
 
     def test_unfitted_and_bad_inputs_are_typed(self, rng):
         with pytest.raises(RuntimeError, match="not fitted"):
@@ -312,6 +332,61 @@ class TestRetrievalServing:
         assert stats["dispatches_per_batch_max"] == 1
         assert out.shape == (5, 2 * K)
         np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    def test_pipeline_rebind_through_data_rebind(self, rng):
+        """Round-20 elastic rebind: ``fitloop.data_rebind`` delegates to
+        a holder exposing ``rebind_mesh`` — the pipeline re-stripes the
+        index onto the new mesh and drops its quantum-shaped bucket
+        canvases, and the re-striped serve answers match the pre-resize
+        ones on the surviving device set."""
+        from dislib_tpu.runtime.fitloop import data_rebind
+        ds.init((8, 1))
+        ix, x = _crafted(rng)
+        pipe = RetrievalPipeline(ix, k=K, nprobe=NLIST)
+        q = x[:MQ]
+        before = pipe.predict_bucket(q, 8)
+        assert pipe._templates           # canvases built on the old mesh
+        ds.init((4, 2))                  # the elastic rung's resize
+        hook = data_rebind({"x": pipe})
+        prof.reset_counters()
+        hook(None)                       # pre-switch force phase: no-op
+        assert prof.resilience_counters().get("retrieval_rebinds") is None
+        hook(_mesh_now())
+        assert prof.resilience_counters().get("retrieval_rebinds") == 1
+        assert ix._fitted_mesh == (4, 2)
+        assert not pipe._templates       # stale canvases dropped
+        after = pipe.predict_bucket(q, 8)
+        # full probe on both meshes: identical retrieved sets; distances
+        # agree to the kernel's near-zero cancellation tolerance (the
+        # q²−2qf+f² form — same bound as the recall oracle above)
+        np.testing.assert_array_equal(before[:, :K], after[:, :K])
+        np.testing.assert_allclose(before[:, K:], after[:, K:], atol=2e-2)
+        # a second hook on an unchanged mesh is a no-op
+        hook(_mesh_now())
+        assert prof.resilience_counters().get("retrieval_rebinds") == 1
+
+    def test_serve_path_heals_after_external_mesh_move(self, rng):
+        """Round-20 regression (found by the multi-host soak): when the
+        mesh moves UNDER a serving pipeline — a co-resident fit loop
+        resizing on a capacity event, no elastic hook wired — the next
+        ``predict_bucket`` must heal end-to-end: the index auto-rebinds
+        in ``_check_fitted`` AND the quantum-shaped bucket canvases
+        follow.  A canvas cached for the old pad staged queries into the
+        wrong shape and every subsequent request tore on a dot_general
+        mismatch."""
+        ds.init((8, 1))
+        ix, x = _crafted(rng)
+        pipe = RetrievalPipeline(ix, k=K, nprobe=NLIST)
+        q = x[:MQ]
+        before = pipe.predict_bucket(q, 8)
+        assert pipe._templates
+        ds.init((4, 2))                  # external resize, nobody told us
+        prof.reset_counters()
+        after = pipe.predict_bucket(q, 8)    # must not tear
+        assert prof.resilience_counters().get("retrieval_rebinds") == 1
+        assert ix._fitted_mesh == (4, 2)
+        np.testing.assert_array_equal(before[:, :K], after[:, :K])
+        np.testing.assert_allclose(before[:, K:], after[:, K:], atol=2e-2)
 
     def test_router_tenancy_composes(self, rng):
         from dislib_tpu.serving import ModelRouter, PredictServer

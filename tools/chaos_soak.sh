@@ -8,6 +8,7 @@
 #         tools/chaos_soak.sh --matrix [SEED] [OUT_JSONL]
 #         tools/chaos_soak.sh --oscillate [SEED]
 #         tools/chaos_soak.sh --trainer [SEED] [OUT_JSONL]
+#         tools/chaos_soak.sh --multihost [SEED] [OUT_JSONL]
 #
 # Default mode runs the `slow`-marked tests/test_chaos_soak.py (excluded
 # from tier-1) and echoes the machine-readable summary line; append it to
@@ -30,8 +31,35 @@
 # canary gate trip, preemption, capacity shrink/grow, explicit rollback)
 # while client threads decode (tenant, generation) from every response —
 # and APPENDS the summary to OUT_JSONL (default BENCH_local_r15.jsonl).
+# --multihost (round-20) runs the MULTI-HOST SURVIVAL soak: repeated
+# kill → resume → rejoin → grow-back episodes (lease-based membership
+# over a FileCoordinator, death published as a capacity level, the
+# head-home grow on rejoin) under live retrieval client traffic — every
+# dead-window failure must be TYPED (ShardDrained), the healed model
+# must equal the unfaulted oracle, and the rank_deaths/rank_rejoins
+# counters are asserted per episode.  APPENDS the summary to OUT_JSONL
+# (default BENCH_local_r19.jsonl).
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
+if [ "$1" = "--multihost" ]; then
+    SEED="${2:-0}"
+    OUT="${3:-BENCH_local_r19.jsonl}"
+    LOG="$(mktemp)"
+    env JAX_PLATFORMS=cpu DSLIB_SOAK_SEED="$SEED" \
+        timeout -k 10 900 \
+        python -m pytest tests/test_chaos_soak.py::test_chaos_mh_soak \
+        -q -m slow -s -p no:cacheprovider 2>&1 | tee "$LOG"
+    rc=${PIPESTATUS[0]}
+    echo "-- multihost soak summary --"
+    grep -a "^CHAOS_MH_SUMMARY" "$LOG" | sed 's/^CHAOS_MH_SUMMARY //'
+    if [ "$rc" -eq 0 ]; then
+        grep -a "^CHAOS_MH_SUMMARY" "$LOG" \
+            | sed 's/^CHAOS_MH_SUMMARY //' >> "$OUT"
+        echo "appended to $OUT"
+    fi
+    rm -f "$LOG"
+    exit $rc
+fi
 if [ "$1" = "--trainer" ]; then
     SEED="${2:-0}"
     OUT="${3:-BENCH_local_r15.jsonl}"

@@ -88,7 +88,7 @@ SECTIONS = [
      ["ServePipeline", "PredictServer", "ServeResponse", "ModelPool",
       "ProgramCache", "bucket_ladder", "bucket_for", "split_rows",
       "SparseFoldInPipeline", "pack_sparse_rows",
-      "BucketLadderError", "QueueFull"]),
+      "BucketLadderError", "QueueFull", "ShardDrained"]),
     ("Deployment bundles (AOT serving artifacts)", "dislib_tpu.serving",
      ["export_bundle", "load_bundle", "runtime_fingerprint",
       "BundlePipeline", "LoadedBundle"]),
@@ -98,6 +98,9 @@ SECTIONS = [
     ("Coordination service (multi-host control plane)", "dislib_tpu.runtime",
      ["get_coordinator", "LocalCoordinator", "FileCoordinator",
       "KVCoordinator", "CoordinationTimeout", "CapacityLedger"]),
+    ("Membership & lease-based fault tolerance", "dislib_tpu.runtime",
+     ["Membership", "LeaseKeeper", "RankDead", "TornCoordFile",
+      "resilient_exchange", "set_membership", "current_membership"]),
     ("Multi-tenant routing", "dislib_tpu.serving",
      ["ModelRouter", "TenantQuotaExceeded", "DeadlineShed"]),
     ("Vector retrieval (IVF-ANN search tier)", "dislib_tpu.retrieval",
@@ -113,7 +116,8 @@ SECTIONS = [
       "FlakyCall", "FlakyOpen",
       "NaNAtChunk", "DivergenceRamp", "HangAtChunk", "TripAtChunk",
       "FaultAtTier", "CapacityAtSave", "oscillation_schedule",
-      "TornBundleWrite", "CanaryGateTrip"]),
+      "TornBundleWrite", "CanaryGateTrip",
+      "KillRankAt", "LeaseExpiry", "TornCoordWrite"]),
     ("Profiling", "dislib_tpu.utils.profiling",
      ["trace", "annotate", "op_graph", "profiled_jit", "dispatch_count",
       "trace_count", "transfer_count", "counters", "reset_counters",

@@ -14,8 +14,29 @@
 # (tests/test_multihost_dataplane.py).  DSLIB_FORCE_MP_TESTS=1 forces
 # the collective phase regardless.
 #
-#   tools/run_multihost.sh
+# --chaos (round 20) runs the process-killing survival drill instead:
+# ``tools/mh_dryrun.py --chaos`` SIGKILLs one of two real coordinated
+# processes mid-fit, restarts it, delays heartbeats, tears coordination/
+# ledger files, and kills it again at the load barrier — green means the
+# survivor's resumed model matches the shrunk-fleet oracle, the rejoin
+# grows back under a bumped epoch, every abort is typed, and nothing
+# hangs (the driver hard-bounds every wait).
+#
+#   tools/run_multihost.sh [--chaos]
 cd "$(dirname "$0")/.." || exit 1
+
+if [ "$1" = "--chaos" ]; then
+  LOG=$(mktemp)
+  env JAX_PLATFORMS=cpu timeout -k 10 600 \
+      python tools/mh_dryrun.py --chaos 2>&1 | tee "$LOG"
+  rc=${PIPESTATUS[0]}
+  if [ "$rc" -eq 0 ] && grep -q "MULTIHOST CHAOS: PASS" "$LOG"; then
+    rm -f "$LOG"; exit 0
+  fi
+  rm -f "$LOG"
+  echo "MULTIHOST CHAOS: FAIL (rc=$rc)"
+  exit 1
+fi
 
 WORK=$(mktemp -d)
 trap 'rm -rf "$WORK"' EXIT
